@@ -1,0 +1,22 @@
+"""seldon_core_tpu: a TPU-native model-serving framework.
+
+A ground-up re-design of the Seldon Core feature set
+(reference: /root/reference, Seldon Core v0.5) for Cloud TPU:
+
+  * wire contract with a zero-copy RawTensor encoding  (`proto/`, `payload`)
+  * microservice runtime wrapping user components      (`user_model`, `wrapper`,
+    `microservice`) — predict() is a jit-compiled XLA executable
+  * inference-graph engine with routers/combiners/
+    transformers, dynamic micro-batching, feedback     (`graph/`)
+  * prepackaged model servers                          (`servers/`)
+  * bandit routers & outlier detectors                 (`routers/`, `outliers/`)
+  * flagship JAX models (ResNet-50, BERT, LLM)         (`models/`)
+  * Pallas TPU kernels                                 (`ops/`)
+  * mesh parallelism: dp/tp/pp/sp/ep + ring attention  (`parallel/`)
+  * deployment schema + local scheduler                (`deploy/`)
+"""
+
+__version__ = "0.1.0"
+
+from . import metrics, payload, seldon_methods, user_model  # noqa: F401
+from .user_model import JAXComponent, SeldonComponent  # noqa: F401

@@ -1,0 +1,9 @@
+"""Inference-graph engine: spec, executor, units, batching, compiler.
+
+TPU-native re-design of the reference's Java engine (reference: engine/,
+~5.6k LoC — graph bootstrap EnginePredictor.java, recursive async walk
+PredictiveUnitBean.java, internal RPC InternalPredictionService.java).
+"""
+
+from .spec import PredictiveUnit, PredictorSpec, UnitType, GraphSpecError  # noqa: F401
+from .executor import GraphExecutor  # noqa: F401

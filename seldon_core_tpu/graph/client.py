@@ -1,0 +1,230 @@
+"""Internal unit clients: in-process, REST, gRPC.
+
+Counterpart of the engine's InternalPredictionService
+(reference: engine/.../service/InternalPredictionService.java:186-453 —
+per-type method dispatch, URI caches, 3 retries, per-annotation timeouts,
+cached gRPC channels via grpc/GrpcChannelHandler.java).
+
+The TPU-native twist is the IN-PROCESS transport: graph units co-located
+with the engine (the common case when the whole graph lives on one TPU
+host) are plain Python objects, so a hop costs a function call instead of
+a pod-network round trip. REST/gRPC transports cover units on other
+hosts/slices (DCN boundary).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Dict, Optional
+
+from .. import seldon_methods
+from ..payload import json_to_proto, proto_to_json
+from ..proto import prediction_pb2 as pb
+
+logger = logging.getLogger(__name__)
+
+RETRIES = 3  # reference: InternalPredictionService.java:87-91
+DEFAULT_TIMEOUT_S = 5.0
+
+# method name -> REST path + (service, rpc) for gRPC
+METHOD_TABLE = {
+    "predict": ("/predict", ("Model", "Predict")),
+    "transform_input": ("/transform-input", ("Transformer", "TransformInput")),
+    "transform_output": ("/transform-output", ("OutputTransformer", "TransformOutput")),
+    "route": ("/route", ("Router", "Route")),
+    "aggregate": ("/aggregate", ("Combiner", "Aggregate")),
+    "send_feedback": ("/send-feedback", ("Model", "SendFeedback")),
+}
+
+
+class UnitClient:
+    """Calls one graph unit. Messages are JSON-style dicts internally."""
+
+    async def call(self, method: str, message: Dict[str, Any]) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    async def ready(self) -> bool:
+        return True
+
+    async def close(self) -> None:
+        pass
+
+
+class InProcessClient(UnitClient):
+    def __init__(self, user_object, executor=None):
+        self.user_object = user_object
+        self._executor = executor
+
+    async def call(self, method: str, message: Dict[str, Any]) -> Dict[str, Any]:
+        fn = getattr(seldon_methods, method)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self._executor, fn, self.user_object, message)
+
+    async def ready(self) -> bool:
+        from ..user_model import client_health_status
+
+        try:
+            client_health_status(self.user_object)
+            return True
+        except Exception:
+            return False
+
+
+class RestClient(UnitClient):
+    """Keep-alive HTTP/1.1 client on raw asyncio streams (no aiohttp in image)."""
+
+    def __init__(self, host: str, port: int, timeout: float = DEFAULT_TIMEOUT_S):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._pool: asyncio.Queue = asyncio.Queue()
+
+    async def _connection(self):
+        try:
+            while True:
+                reader, writer = self._pool.get_nowait()
+                if not writer.is_closing():
+                    return reader, writer
+        except asyncio.QueueEmpty:
+            pass
+        return await asyncio.open_connection(self.host, self.port, limit=64 * 1024 * 1024)
+
+    async def _request(self, path: str, body: bytes) -> Dict[str, Any]:
+        reader, writer = await self._connection()
+        pooled = False
+        try:
+            head = (
+                f"POST {path} HTTP/1.1\r\nHost: {self.host}\r\n"
+                f"Content-Type: application/json\r\nContent-Length: {len(body)}\r\n\r\n"
+            ).encode()
+            writer.write(head + body)
+            await writer.drain()
+            status_line = await reader.readline()
+            status = int(status_line.split(b" ", 2)[1])
+            length = 0
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b""):
+                    break
+                k, _, v = line.decode("latin-1").partition(":")
+                if k.strip().lower() == "content-length":
+                    length = int(v)
+            payload = await reader.readexactly(length)
+            self._pool.put_nowait((reader, writer))
+            pooled = True
+            if status >= 400:
+                raise UnitCallError(status, payload.decode("utf-8", "replace"))
+            return json.loads(payload)
+        finally:
+            # Anything that prevented pooling (connection error, timeout
+            # cancellation from wait_for, parse error) closes the socket —
+            # a half-read connection must never return to the pool.
+            if not pooled:
+                writer.close()
+
+    async def call(self, method: str, message: Dict[str, Any]) -> Dict[str, Any]:
+        path, _ = METHOD_TABLE[method]
+        body = json.dumps(message, separators=(",", ":")).encode()
+        last_err: Optional[Exception] = None
+        for attempt in range(RETRIES):
+            try:
+                return await asyncio.wait_for(self._request(path, body), self.timeout)
+            except UnitCallError:
+                raise  # application error: do not retry
+            except Exception as e:  # connection/timeout: retry
+                last_err = e
+                logger.warning(
+                    "REST %s:%d%s attempt %d failed: %s", self.host, self.port, path, attempt, e
+                )
+        raise UnitCallError(503, f"unit unreachable after {RETRIES} tries: {last_err}")
+
+    async def ready(self) -> bool:
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port), 2.0
+            )
+            writer.write(b"GET /ready HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n")
+            await writer.drain()
+            line = await reader.readline()
+            writer.close()
+            return b" 200 " in line
+        except Exception:
+            return False
+
+    async def close(self) -> None:
+        while not self._pool.empty():
+            _, writer = self._pool.get_nowait()
+            writer.close()
+
+
+class GrpcClient(UnitClient):
+    """grpc.aio channel with generic method stubs; dict<->proto at the edge."""
+
+    def __init__(self, host: str, port: int, timeout: float = DEFAULT_TIMEOUT_S,
+                 max_message_bytes: Optional[int] = None):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self.max_message_bytes = max_message_bytes
+        self._channel = None
+        self._stubs: Dict[str, Any] = {}
+
+    @property
+    def channel(self):
+        # Lazily created: grpc.aio channels bind to the running event loop,
+        # and the executor is constructed before the loop starts.
+        if self._channel is None:
+            import grpc
+
+            options = []
+            if self.max_message_bytes:
+                options = [
+                    ("grpc.max_send_message_length", self.max_message_bytes),
+                    ("grpc.max_receive_message_length", self.max_message_bytes),
+                ]
+            self._channel = grpc.aio.insecure_channel(
+                f"{self.host}:{self.port}", options=options
+            )
+        return self._channel
+
+    def _stub(self, method: str):
+        if method not in self._stubs:
+            from ..proto import services as svc
+
+            _, (service, rpc) = METHOD_TABLE[method]
+            req_cls, resp_cls = svc.SERVICES[service][rpc]
+            self._stubs[method] = (
+                self.channel.unary_unary(
+                    svc.method_path(service, rpc),
+                    request_serializer=lambda m: m.SerializeToString(),
+                    response_deserializer=resp_cls.FromString,
+                ),
+                req_cls,
+            )
+        return self._stubs[method]
+
+    async def call(self, method: str, message: Dict[str, Any]) -> Dict[str, Any]:
+        stub, req_cls = self._stub(method)
+        proto_req = json_to_proto(message, req_cls)
+        resp = await stub(proto_req, timeout=self.timeout)
+        return proto_to_json(resp)
+
+    async def ready(self) -> bool:
+        try:
+            await asyncio.wait_for(self.channel.channel_ready(), 2.0)
+            return True
+        except Exception:
+            return False
+
+    async def close(self) -> None:
+        if self._channel is not None:
+            await self._channel.close()
+
+
+class UnitCallError(RuntimeError):
+    def __init__(self, status: int, info: str):
+        super().__init__(info)
+        self.status = status
+        self.info = info

@@ -1,0 +1,121 @@
+"""Engine-side metrics registry with Prometheus text exposition.
+
+Parity with the reference engine's Micrometer setup: auto-timed server/
+client request timers with percentile histograms and model/image tags
+(reference: engine/src/main/resources/application.properties:4-11,
+engine/.../metrics/CustomMetricsManager.java:27-70 for dynamic
+counters/gauges/timers fed from ``Meta.metrics``), scraped at
+``:8082/prometheus``. Here: stdlib-only registry, exposed by the engine app
+at ``/prometheus`` (and ``/metrics``).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+# latency buckets in seconds (log-spaced 100us..10s, like Micrometer SLO defaults)
+_BUCKETS = [
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+]
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _labels_key(labels: Dict[str, str]) -> LabelKey:
+    return tuple(sorted(labels.items()))
+
+
+def _fmt_labels(key: LabelKey, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Dict[LabelKey, float]] = defaultdict(lambda: defaultdict(float))
+        self._gauges: Dict[str, Dict[LabelKey, float]] = defaultdict(dict)
+        # name -> labels -> [bucket counts..., sum, count]
+        self._histograms: Dict[str, Dict[LabelKey, List[float]]] = defaultdict(dict)
+
+    def counter_inc(self, name: str, labels: Dict[str, str] | None = None, value: float = 1.0):
+        with self._lock:
+            self._counters[name][_labels_key(labels or {})] += value
+
+    def gauge_set(self, name: str, value: float, labels: Dict[str, str] | None = None):
+        with self._lock:
+            self._gauges[name][_labels_key(labels or {})] = value
+
+    def observe(self, name: str, seconds: float, labels: Dict[str, str] | None = None):
+        key = _labels_key(labels or {})
+        with self._lock:
+            h = self._histograms[name].get(key)
+            if h is None:
+                h = [0.0] * (len(_BUCKETS) + 2)
+                self._histograms[name][key] = h
+            for i, b in enumerate(_BUCKETS):
+                if seconds <= b:
+                    h[i] += 1
+            h[-2] += seconds
+            h[-1] += 1
+
+    def record_custom(self, metrics: List[Dict], labels: Dict[str, str] | None = None):
+        """Sink for Meta.metrics emitted by components
+        (reference: PredictiveUnitBean.addCustomMetrics:318-344)."""
+        for m in metrics or []:
+            tags = dict(labels or {})
+            tags.update(m.get("tags") or {})
+            mtype = m.get("type", "COUNTER")
+            key = m.get("key", "custom")
+            val = float(m.get("value", 0))
+            if mtype == "COUNTER":
+                self.counter_inc(f"seldon_custom_{key}", tags, val)
+            elif mtype == "GAUGE":
+                self.gauge_set(f"seldon_custom_{key}", val, tags)
+            elif mtype == "TIMER":
+                self.observe(f"seldon_custom_{key}", val / 1000.0, tags)
+
+    def quantile(self, name: str, q: float, labels: Dict[str, str] | None = None) -> float:
+        """Approximate quantile from histogram buckets (for tests/bench)."""
+        key = _labels_key(labels or {})
+        with self._lock:
+            h = self._histograms.get(name, {}).get(key)
+            if not h or h[-1] == 0:
+                return math.nan
+            target = q * h[-1]
+            prev = 0.0
+            for i, b in enumerate(_BUCKETS):
+                if h[i] >= target:
+                    return b
+                prev = b
+            return prev
+
+    def expose(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            for name, series in self._counters.items():
+                lines.append(f"# TYPE {name} counter")
+                for key, v in series.items():
+                    lines.append(f"{name}{_fmt_labels(key)} {v}")
+            for name, series in self._gauges.items():
+                lines.append(f"# TYPE {name} gauge")
+                for key, v in series.items():
+                    lines.append(f"{name}{_fmt_labels(key)} {v}")
+            for name, series in self._histograms.items():
+                lines.append(f"# TYPE {name} histogram")
+                for key, h in series.items():
+                    for i, b in enumerate(_BUCKETS):
+                        lines.append(f'{name}_bucket{_fmt_labels(key, f'le="{b}"')} {h[i]}')
+                    lines.append(f'{name}_bucket{_fmt_labels(key, 'le="+Inf"')} {h[-1]}')
+                    lines.append(f"{name}_sum{_fmt_labels(key)} {h[-2]}")
+                    lines.append(f"{name}_count{_fmt_labels(key)} {h[-1]}")
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = MetricsRegistry()

@@ -1,0 +1,269 @@
+"""Async inference-graph executor.
+
+Behavior parity with the engine's recursive walk (reference:
+engine/.../predictors/PredictiveUnitBean.java:81-241):
+
+  request -> transformInput (MODEL=>predict, TRANSFORMER=>transform-input)
+          -> route (ROUTER; branch -1 = broadcast to all children)
+          -> child subtrees concurrently (asyncio.gather ~= Spring @Async
+             fan-out, PredictiveUnitBean.java:169-180)
+          -> aggregate (COMBINER; single child passes through; multiple
+             children without a combiner is an error)
+          -> transformOutput (OUTPUT_TRANSFORMER)
+
+with per-request meta accumulation: ``routing`` (unit -> branch),
+``requestPath`` (unit -> implementation id), merged ``tags`` and appended
+``metrics`` (reference: mergeMeta PredictiveUnitBean.java:354-372), puid
+assignment (reference: PredictionService.PuidGenerator:77), and the
+feedback walk that replays the routing map
+(reference: sendFeedbackAsync:204-241).
+
+Differences by design: units co-located with the engine are in-process
+objects (zero serialization); MODEL units can sit behind a dynamic
+micro-batcher (batching.py) so concurrent unary requests share one XLA
+launch — the reference had no counterpart (strictly unary per hop).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import importlib
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .client import GrpcClient, InProcessClient, RestClient, UnitCallError, UnitClient
+from .spec import PredictorSpec, PredictiveUnit, UnitType, PREPACKAGED_SERVERS
+from .units import BUILTIN_IMPLEMENTATIONS
+
+
+class RequestCtx:
+    """Per-request meta accumulator (the reference used ConcurrentHashMaps
+    on the bean, PredictiveUnitBean.java:82-96)."""
+
+    __slots__ = ("puid", "tags", "metrics", "routing", "request_path")
+
+    def __init__(self, puid: str):
+        self.puid = puid
+        self.tags: Dict[str, Any] = {}
+        self.metrics: List[Dict] = []
+        self.routing: Dict[str, int] = {}
+        self.request_path: Dict[str, str] = {}
+
+    def absorb(self, unit_name: str, response: Dict[str, Any]) -> None:
+        meta = response.get("meta") or {}
+        self.tags.update(meta.get("tags") or {})
+        self.metrics.extend(meta.get("metrics") or [])
+
+    def to_meta(self) -> Dict[str, Any]:
+        meta: Dict[str, Any] = {"puid": self.puid}
+        if self.tags:
+            meta["tags"] = self.tags
+        if self.metrics:
+            meta["metrics"] = self.metrics
+        if self.routing:
+            meta["routing"] = self.routing
+        if self.request_path:
+            meta["requestPath"] = self.request_path
+        return meta
+
+
+class UnitRuntime:
+    """A spec node bound to a client + its children runtimes."""
+
+    def __init__(self, unit: PredictiveUnit, client: Optional[UnitClient], children):
+        self.unit = unit
+        self.client = client
+        self.children: List[UnitRuntime] = children
+        self.name = unit.name
+        self.type = unit.type or UnitType.MODEL
+
+    @property
+    def identity(self) -> str:
+        return self.unit.implementation or self.unit.model_uri or self.name
+
+
+def _branch_index(route_response: Dict[str, Any]) -> int:
+    """Decode branch from the router's response tensor
+    (reference: getBranchIndex PredictiveUnitBean.java:301-312)."""
+    data = route_response.get("data") or {}
+    if "ndarray" in data:
+        v = np.asarray(data["ndarray"]).ravel()
+    elif "tensor" in data:
+        v = np.asarray(data["tensor"].get("values", [])).ravel()
+    else:
+        raise UnitCallError(500, "router response has no tensor/ndarray data")
+    if v.size == 0:
+        raise UnitCallError(500, "router returned empty branch tensor")
+    return int(v[0])
+
+
+class GraphExecutor:
+    def __init__(
+        self,
+        spec: PredictorSpec,
+        registry: Optional[Dict[str, Any]] = None,
+        timeout_s: float = 5.0,
+        batching: Optional[Dict[str, Dict]] = None,
+    ):
+        """registry: unit name -> user object for INPROCESS units that are
+        neither builtin implementations nor prepackaged servers.
+        batching: unit name -> kwargs for MicroBatcher (see batching.py)."""
+        self.spec = spec
+        self._registry = registry or {}
+        self._timeout = timeout_s
+        self._batching = batching or {}
+        self.root = self._build(spec.graph)
+
+    # -- construction -------------------------------------------------------
+
+    def _build(self, unit: PredictiveUnit) -> UnitRuntime:
+        children = [self._build(c) for c in unit.children]
+        client = self._make_client(unit)
+        return UnitRuntime(unit, client, children)
+
+    def _make_client(self, unit: PredictiveUnit) -> UnitClient:
+        transport = (unit.endpoint.transport or "INPROCESS").upper()
+        if transport in ("REST", "HTTP"):
+            client: UnitClient = RestClient(
+                unit.endpoint.service_host, unit.endpoint.service_port, self._timeout
+            )
+        elif transport == "GRPC":
+            client = GrpcClient(
+                unit.endpoint.service_host, unit.endpoint.grpc_port, self._timeout
+            )
+        else:
+            client = InProcessClient(self._resolve_object(unit))
+        if unit.name in self._batching and (unit.type in (None, UnitType.MODEL)):
+            from .batching import MicroBatchingClient
+
+            client = MicroBatchingClient(client, **self._batching[unit.name])
+        return client
+
+    def _resolve_object(self, unit: PredictiveUnit):
+        if unit.name in self._registry:
+            return self._registry[unit.name]
+        impl = unit.implementation
+        params = {p.name: p.value for p in unit.parameters}
+        if impl in BUILTIN_IMPLEMENTATIONS:
+            cls = BUILTIN_IMPLEMENTATIONS[impl]
+            try:
+                return cls(**params) if params else cls()
+            except TypeError:
+                return cls()
+        if impl in PREPACKAGED_SERVERS:
+            module_name, cls_name = PREPACKAGED_SERVERS[impl].rsplit(".", 1)
+            cls = getattr(importlib.import_module(module_name), cls_name)
+            obj = cls(model_uri=unit.model_uri, **params)
+            if hasattr(obj, "load"):
+                obj.load()
+            return obj
+        raise ValueError(
+            f"unit {unit.name!r}: no in-process object in registry and "
+            f"implementation {impl!r} is not builtin/prepackaged"
+        )
+
+    # -- predict path -------------------------------------------------------
+
+    async def predict(self, message: Dict[str, Any]) -> Dict[str, Any]:
+        meta_in = message.get("meta") or {}
+        puid = meta_in.get("puid") or uuid.uuid4().hex
+        ctx = RequestCtx(puid)
+        ctx.tags.update(meta_in.get("tags") or {})
+        out = await self._get_output(self.root, message, ctx)
+        out["meta"] = ctx.to_meta()
+        return out
+
+    async def _call(self, rt: UnitRuntime, method: str, message, ctx: RequestCtx):
+        response = await rt.client.call(method, message)
+        ctx.absorb(rt.name, response)
+        return response
+
+    async def _get_output(self, rt: UnitRuntime, message: Dict[str, Any], ctx: RequestCtx):
+        ctx.request_path[rt.name] = rt.identity
+
+        # 1. input transform
+        if rt.type == UnitType.MODEL:
+            message = await self._call(rt, "predict", message, ctx)
+        elif rt.type == UnitType.TRANSFORMER:
+            message = await self._call(rt, "transform_input", message, ctx)
+
+        # 2/3. routing + children
+        if rt.children:
+            if rt.type == UnitType.ROUTER:
+                route_resp = await self._call(rt, "route", message, ctx)
+                branch = _branch_index(route_resp)
+                if branch >= len(rt.children) or branch < -1:
+                    raise UnitCallError(
+                        500, f"router {rt.name} chose branch {branch} of {len(rt.children)}"
+                    )
+                ctx.routing[rt.name] = branch
+                selected = rt.children if branch == -1 else [rt.children[branch]]
+            else:
+                selected = rt.children
+            outputs = await asyncio.gather(
+                *(self._get_output(c, message, ctx) for c in selected)
+            )
+
+            # 4. aggregation
+            if rt.type == UnitType.COMBINER:
+                merged = await self._call(
+                    rt, "aggregate", {"seldonMessages": list(outputs)}, ctx
+                )
+            elif len(outputs) == 1:
+                merged = outputs[0]
+            else:
+                raise UnitCallError(
+                    500, f"unit {rt.name} has {len(outputs)} child outputs but is no combiner"
+                )
+            message = merged
+
+        # 5. output transform
+        if rt.type == UnitType.OUTPUT_TRANSFORMER:
+            message = await self._call(rt, "transform_output", message, ctx)
+        return message
+
+    # -- feedback path ------------------------------------------------------
+
+    async def send_feedback(self, feedback: Dict[str, Any]) -> Dict[str, Any]:
+        routing = ((feedback.get("response") or {}).get("meta") or {}).get("routing") or {}
+        reward = float(feedback.get("reward", 0.0))
+        await self._feedback_walk(self.root, feedback, routing)
+        return {
+            "meta": {"tags": {}, "metrics": []},
+            "status": {"code": 200, "status": "SUCCESS"},
+            "reward": reward,
+        }
+
+    async def _feedback_walk(self, rt: UnitRuntime, feedback: Dict[str, Any], routing):
+        try:
+            await rt.client.call("send_feedback", feedback)
+        except UnitCallError:
+            pass  # units without the hook are fine (reference: doSendFeedback:288)
+        if not rt.children:
+            return
+        branch = routing.get(rt.name)
+        if rt.type == UnitType.ROUTER and branch is not None and branch != -1:
+            targets = [rt.children[branch]] if 0 <= branch < len(rt.children) else []
+        else:
+            targets = rt.children
+        await asyncio.gather(*(self._feedback_walk(c, feedback, routing) for c in targets))
+
+    # -- readiness ----------------------------------------------------------
+
+    async def ready(self) -> bool:
+        """All units reachable (reference: SeldonGraphReadyChecker.java:45-115)."""
+        checks = await asyncio.gather(
+            *(rt.client.ready() for rt in self._walk(self.root))
+        )
+        return all(checks)
+
+    def _walk(self, rt: UnitRuntime):
+        yield rt
+        for c in rt.children:
+            yield from self._walk(c)
+
+    async def close(self) -> None:
+        await asyncio.gather(*(rt.client.close() for rt in self._walk(self.root)))

@@ -1,0 +1,228 @@
+"""Engine application: external REST/gRPC API over a GraphExecutor.
+
+Parity with the reference engine's external surface:
+  * ``POST /api/v0.1/predictions`` and ``/api/v1.0/predictions``
+    (reference: engine/.../api/rest/RestClientController.java:136-291)
+  * ``POST /api/v0.1/feedback``
+  * ``/ping /ready /live /pause /unpause``
+  * gRPC ``Seldon.Predict`` / ``Seldon.SendFeedback``
+    (reference: engine/.../grpc/SeldonGrpcServer.java:40-143)
+  * periodic graph readiness check gating /ready
+    (reference: SeldonGraphReadyChecker.java:24-115, 5s fixedDelay)
+  * request/response pair logging hook
+    (reference: PredictionService.java:121-190 CloudEvents)
+  * Prometheus exposition at /prometheus (reference: :8082/prometheus)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Any, Dict, Optional
+
+from ..http_server import HTTPServer, Request, Response, error_body
+from ..payload import json_to_proto, proto_to_json
+from ..proto import prediction_pb2 as pb
+from .client import UnitCallError
+from .engine_metrics import REGISTRY, MetricsRegistry
+from .executor import GraphExecutor
+from .spec import PredictorSpec
+
+logger = logging.getLogger(__name__)
+
+READINESS_PERIOD_S = 5.0
+
+
+class RequestLogger:
+    """Pluggable request/response pair sink (CloudEvents-style dicts)."""
+
+    def __init__(self, sink=None):
+        self.sink = sink
+
+    def log(self, puid: str, request: Dict, response: Dict) -> None:
+        if self.sink is None:
+            return
+        try:
+            self.sink(
+                {
+                    "specversion": "1.0",
+                    "type": "seldon.message.pair",
+                    "id": puid,
+                    "data": {"request": request, "response": response},
+                }
+            )
+        except Exception as e:  # noqa: BLE001 - logging must not break serving
+            logger.warning("request logging failed: %s", e)
+
+
+class EngineApp:
+    def __init__(
+        self,
+        spec: PredictorSpec,
+        registry: Optional[Dict[str, Any]] = None,
+        metrics: MetricsRegistry = REGISTRY,
+        request_logger: Optional[RequestLogger] = None,
+        batching: Optional[Dict[str, Dict]] = None,
+    ):
+        self.spec = spec
+        self.executor = GraphExecutor(spec, registry=registry, batching=batching)
+        self.metrics = metrics
+        self.request_logger = request_logger or RequestLogger()
+        self.paused = False
+        self.graph_ready = True
+        self._ready_task: Optional[asyncio.Task] = None
+
+    # -- core entrypoints (shared by REST and gRPC fronts) ------------------
+
+    async def predict(self, message: Dict[str, Any]) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        labels = {"deployment": self.spec.name}
+        try:
+            out = await self.executor.predict(message)
+        except UnitCallError as e:
+            self.metrics.counter_inc("seldon_api_engine_server_errors", labels)
+            raise
+        finally:
+            self.metrics.observe(
+                "seldon_api_engine_server_requests_seconds", time.perf_counter() - t0, labels
+            )
+        self.metrics.counter_inc("seldon_api_engine_server_requests", labels)
+        self.metrics.record_custom((out.get("meta") or {}).get("metrics"), labels)
+        self.request_logger.log((out.get("meta") or {}).get("puid", ""), message, out)
+        return out
+
+    async def send_feedback(self, feedback: Dict[str, Any]) -> Dict[str, Any]:
+        out = await self.executor.send_feedback(feedback)
+        self.metrics.counter_inc(
+            "seldon_api_engine_server_feedback_reward",
+            {"deployment": self.spec.name},
+            float(feedback.get("reward", 0.0)),
+        )
+        return out
+
+    # -- readiness loop -----------------------------------------------------
+
+    async def _readiness_loop(self):
+        while True:
+            try:
+                self.graph_ready = await self.executor.ready()
+            except Exception:
+                self.graph_ready = False
+            await asyncio.sleep(READINESS_PERIOD_S)
+
+    def start_readiness_loop(self):
+        self._ready_task = asyncio.ensure_future(self._readiness_loop())
+
+    # -- REST front ---------------------------------------------------------
+
+    def rest_app(self) -> HTTPServer:
+        app = HTTPServer("engine-rest")
+
+        async def predictions(req: Request) -> Response:
+            if self.paused:
+                return Response(error_body(503, "paused"), 503)
+            body = req.json()
+            if body is None:
+                return Response(error_body(400, "empty request body"), 400)
+            try:
+                return Response(await self.predict(body))
+            except UnitCallError as e:
+                return Response(error_body(e.status, e.info), e.status)
+
+        async def feedback(req: Request) -> Response:
+            body = req.json()
+            if body is None:
+                return Response(error_body(400, "empty request body"), 400)
+            return Response(await self.send_feedback(body))
+
+        async def ready(req: Request) -> Response:
+            if self.paused or not self.graph_ready:
+                return Response(error_body(503, "not ready"), 503)
+            return Response({"status": "ok"})
+
+        async def live(req: Request) -> Response:
+            return Response({"status": "ok"})
+
+        async def ping(req: Request) -> Response:
+            return Response("pong", content_type="text/plain")
+
+        async def pause(req: Request) -> Response:
+            self.paused = True
+            return Response({"status": "paused"})
+
+        async def unpause(req: Request) -> Response:
+            self.paused = False
+            return Response({"status": "ok"})
+
+        async def prometheus(req: Request) -> Response:
+            return Response(self.metrics.expose(), content_type="text/plain; version=0.0.4")
+
+        app.add_route("/api/v0.1/predictions", predictions)
+        app.add_route("/api/v1.0/predictions", predictions)
+        app.add_route("/predict", predictions)
+        app.add_route("/api/v0.1/feedback", feedback)
+        app.add_route("/api/v1.0/feedback", feedback)
+        app.add_route("/ready", ready)
+        app.add_route("/live", live)
+        app.add_route("/ping", ping)
+        app.add_route("/pause", pause)
+        app.add_route("/unpause", unpause)
+        app.add_route("/metrics", prometheus)
+        app.add_route("/prometheus", prometheus)
+        return app
+
+    # -- gRPC front ---------------------------------------------------------
+
+    def grpc_server(self, max_workers: int = 4, max_message_bytes: Optional[int] = None):
+        """grpc.aio server registering the Seldon service
+        (reference: SeldonGrpcServer.java:40-143)."""
+        import grpc
+
+        options = []
+        if max_message_bytes:
+            options = [
+                ("grpc.max_send_message_length", max_message_bytes),
+                ("grpc.max_receive_message_length", max_message_bytes),
+            ]
+        server = grpc.aio.server(options=options)
+        app = self
+
+        async def predict_rpc(request: pb.SeldonMessage, context):
+            try:
+                out = await app.predict(proto_to_json(request))
+                return json_to_proto(out)
+            except UnitCallError as e:
+                await context.abort(grpc.StatusCode.INTERNAL, e.info)
+
+        async def feedback_rpc(request: pb.Feedback, context):
+            out = await app.send_feedback(proto_to_json(request))
+            return json_to_proto(out)
+
+        handlers = {
+            "Predict": grpc.unary_unary_rpc_method_handler(
+                predict_rpc,
+                request_deserializer=pb.SeldonMessage.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            ),
+            "SendFeedback": grpc.unary_unary_rpc_method_handler(
+                feedback_rpc,
+                request_deserializer=pb.Feedback.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            ),
+        }
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler("seldontpu.Seldon", handlers),)
+        )
+        return server
+
+    async def serve(self, host: str = "0.0.0.0", http_port: int = 8000,
+                    grpc_port: Optional[int] = 5001):
+        self.start_readiness_loop()
+        servers = [self.rest_app().serve_forever(host, http_port)]
+        if grpc_port:
+            gsrv = self.grpc_server()
+            gsrv.add_insecure_port(f"{host}:{grpc_port}")
+            await gsrv.start()
+        await asyncio.gather(*servers)

@@ -1,0 +1,260 @@
+"""Inference-graph schema: PredictiveUnit tree + PredictorSpec + deployment.
+
+Schema parity with the reference CRD graph types
+(reference: proto/seldon_deployment.proto:89-162 and Go mirror
+operator/api/v1alpha2/seldondeployment_types.go:246-370):
+unit types ROUTER/COMBINER/MODEL/TRANSFORMER/OUTPUT_TRANSFORMER,
+implementations SIMPLE_MODEL/SIMPLE_ROUTER/RANDOM_ABTEST/AVERAGE_COMBINER
+plus prepackaged SKLEARN_SERVER/XGBOOST_SERVER/MLFLOW_SERVER/
+TENSORFLOW_SERVER (ours adds JAX_SERVER), typed parameters, endpoints.
+
+Defaulting + validation mirror the admission webhook
+(reference: operator/api/v1alpha2/seldondeployment_webhook.go:137-411):
+port allocation from 9000, endpoint host defaulting, graph/type inference,
+modelUri required for prepackaged servers, traffic weights sum to 100,
+duplicate predictor names rejected.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Dict, List, Optional
+
+
+class GraphSpecError(ValueError):
+    pass
+
+
+class UnitType(str, Enum):
+    UNKNOWN_TYPE = "UNKNOWN_TYPE"
+    ROUTER = "ROUTER"
+    COMBINER = "COMBINER"
+    MODEL = "MODEL"
+    TRANSFORMER = "TRANSFORMER"
+    OUTPUT_TRANSFORMER = "OUTPUT_TRANSFORMER"
+
+
+class UnitImplementation(str, Enum):
+    UNKNOWN_IMPLEMENTATION = "UNKNOWN_IMPLEMENTATION"
+    SIMPLE_MODEL = "SIMPLE_MODEL"
+    SIMPLE_ROUTER = "SIMPLE_ROUTER"
+    RANDOM_ABTEST = "RANDOM_ABTEST"
+    AVERAGE_COMBINER = "AVERAGE_COMBINER"
+
+
+# Prepackaged server implementations (reference:
+# operator/controllers/seldondeployment_prepackaged_servers.go:30-176,
+# default images operator/constants/constants.go:3-14). JAX_SERVER is the
+# TPU-native addition per BASELINE.json's north star.
+PREPACKAGED_SERVERS = {
+    "SKLEARN_SERVER": "seldon_core_tpu.servers.sklearnserver.SKLearnServer",
+    "XGBOOST_SERVER": "seldon_core_tpu.servers.xgboostserver.XGBoostServer",
+    "MLFLOW_SERVER": "seldon_core_tpu.servers.mlflowserver.MLFlowServer",
+    "TENSORFLOW_SERVER": "seldon_core_tpu.servers.tfserver.TFServer",
+    "JAX_SERVER": "seldon_core_tpu.servers.jaxserver.JAXServer",
+}
+
+FIRST_PORT = 9000
+FIRST_GRPC_PORT = 9500
+
+
+@dataclass
+class Endpoint:
+    # empty host means "not yet defaulted"; default_predictor fills it with
+    # localhost (co-located) or the predictor-scoped DNS name (separate pods)
+    service_host: str = ""
+    service_port: int = 0
+    grpc_port: int = 0
+    transport: str = "INPROCESS"  # INPROCESS | REST | GRPC
+
+
+@dataclass
+class Parameter:
+    name: str
+    value: str
+    type: str = "STRING"
+
+    def to_dict(self) -> Dict[str, str]:
+        return {"name": self.name, "value": str(self.value), "type": self.type}
+
+
+@dataclass
+class PredictiveUnit:
+    name: str
+    type: Optional[UnitType] = None
+    implementation: Optional[str] = None
+    children: List["PredictiveUnit"] = field(default_factory=list)
+    endpoint: Endpoint = field(default_factory=Endpoint)
+    parameters: List[Parameter] = field(default_factory=list)
+    model_uri: Optional[str] = None
+    service_account: Optional[str] = None
+    # explicit method set override (reference: PredictiveUnitState methods)
+    methods: Optional[List[str]] = None
+
+    def walk(self):
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "PredictiveUnit":
+        if "name" not in d:
+            raise GraphSpecError("graph node missing name")
+        ep = d.get("endpoint") or {}
+        return PredictiveUnit(
+            name=d["name"],
+            type=UnitType(d["type"]) if d.get("type") else None,
+            implementation=d.get("implementation"),
+            children=[PredictiveUnit.from_dict(c) for c in d.get("children", [])],
+            endpoint=Endpoint(
+                service_host=ep.get("service_host", ep.get("serviceHost", "")),
+                service_port=int(ep.get("service_port", ep.get("servicePort", 0))),
+                grpc_port=int(ep.get("grpc_port", ep.get("grpcPort", 0))),
+                transport=ep.get("transport", ep.get("type", "INPROCESS")).replace("GRPC", "GRPC"),
+            ),
+            parameters=[
+                Parameter(p["name"], str(p["value"]), p.get("type", "STRING"))
+                for p in d.get("parameters", [])
+            ],
+            model_uri=d.get("modelUri") or d.get("model_uri"),
+            service_account=d.get("serviceAccountName"),
+            methods=d.get("methods"),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"name": self.name}
+        if self.type:
+            out["type"] = self.type.value
+        if self.implementation:
+            out["implementation"] = self.implementation
+        if self.children:
+            out["children"] = [c.to_dict() for c in self.children]
+        if self.parameters:
+            out["parameters"] = [p.to_dict() for p in self.parameters]
+        if self.model_uri:
+            out["modelUri"] = self.model_uri
+        out["endpoint"] = {
+            "service_host": self.endpoint.service_host,
+            "service_port": self.endpoint.service_port,
+            "grpc_port": self.endpoint.grpc_port,
+            "transport": self.endpoint.transport,
+        }
+        return out
+
+
+@dataclass
+class PredictorSpec:
+    name: str
+    graph: PredictiveUnit
+    replicas: int = 1
+    traffic: int = 100
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    # TPU placement: mesh shape this predictor wants, e.g. {"data": 1, "model": 8}
+    tpu_mesh: Optional[Dict[str, int]] = None
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "PredictorSpec":
+        if "graph" not in d:
+            raise GraphSpecError(f"predictor {d.get('name')!r} missing graph")
+        return PredictorSpec(
+            name=d.get("name", "default"),
+            graph=PredictiveUnit.from_dict(d["graph"]),
+            replicas=int(d.get("replicas", 1)),
+            traffic=int(d.get("traffic", 100)),
+            labels=d.get("labels", {}),
+            annotations=d.get("annotations", {}),
+            tpu_mesh=d.get("tpuMesh") or d.get("tpu_mesh"),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "graph": self.graph.to_dict(),
+            "replicas": self.replicas,
+            "traffic": self.traffic,
+            "labels": self.labels,
+            "annotations": self.annotations,
+            **({"tpuMesh": self.tpu_mesh} if self.tpu_mesh else {}),
+        }
+
+    @staticmethod
+    def from_env_b64(blob: str) -> "PredictorSpec":
+        """Decode the base64 JSON the scheduler injects, like the engine's
+        ENGINE_PREDICTOR env (reference: engine/.../EnginePredictor.java:58-108)."""
+        return PredictorSpec.from_dict(json.loads(base64.b64decode(blob)))
+
+    def to_env_b64(self) -> str:
+        return base64.b64encode(json.dumps(self.to_dict()).encode()).decode()
+
+
+# ---------------------------------------------------------------------------
+# Defaulting (webhook parity:
+# operator/api/v1alpha2/seldondeployment_webhook.go:137-338)
+# ---------------------------------------------------------------------------
+
+
+def default_predictor(spec: PredictorSpec, separate_pods: bool = False) -> PredictorSpec:
+    """Fill in types, implementations and ports.
+
+    * infer type from implementation for builtin units
+    * prepackaged servers: inject implementation class parameter + model_uri
+    * allocate REST ports from 9000 / gRPC from 9500 in graph walk order
+      (reference: seldondeployment_webhook.go:139-150)
+    * endpoint host defaults: localhost when co-located, predictor-scoped
+      DNS name when separate (reference: webhook.go:211-217,285-295)
+    """
+    port, grpc_port = FIRST_PORT, FIRST_GRPC_PORT
+    for unit in spec.graph.walk():
+        if unit.type is None:
+            impl = unit.implementation or ""
+            if impl in ("SIMPLE_MODEL",) or impl in PREPACKAGED_SERVERS:
+                unit.type = UnitType.MODEL
+            elif impl in ("SIMPLE_ROUTER", "RANDOM_ABTEST"):
+                unit.type = UnitType.ROUTER
+            elif impl == "AVERAGE_COMBINER":
+                unit.type = UnitType.COMBINER
+            else:
+                unit.type = UnitType.MODEL
+        if unit.endpoint.service_port == 0:
+            unit.endpoint.service_port = port
+            port += 1
+        if unit.endpoint.grpc_port == 0:
+            unit.endpoint.grpc_port = grpc_port
+            grpc_port += 1
+        if unit.endpoint.service_host in ("", None):
+            unit.endpoint.service_host = (
+                f"{spec.name}-{unit.name}" if separate_pods else "localhost"
+            )
+    return spec
+
+
+def validate_predictor(spec: PredictorSpec) -> None:
+    """Reference checks: seldondeployment_webhook.go:388-411."""
+    names = [u.name for u in spec.graph.walk()]
+    if len(names) != len(set(names)):
+        raise GraphSpecError(f"duplicate unit names in graph: {names}")
+    for unit in spec.graph.walk():
+        if unit.implementation in PREPACKAGED_SERVERS and not unit.model_uri:
+            raise GraphSpecError(
+                f"unit {unit.name}: modelUri is required for {unit.implementation}"
+            )
+        if unit.type == UnitType.COMBINER and not unit.children:
+            raise GraphSpecError(f"combiner {unit.name} has no children")
+        if unit.type == UnitType.ROUTER and not unit.children:
+            raise GraphSpecError(f"router {unit.name} has no children")
+
+
+def validate_deployment(predictors: List[PredictorSpec]) -> None:
+    names = [p.name for p in predictors]
+    if len(names) != len(set(names)):
+        raise GraphSpecError(f"duplicate predictor names: {names}")
+    if len(predictors) > 1:
+        total = sum(p.traffic for p in predictors)
+        if total != 100:
+            raise GraphSpecError(f"traffic weights must sum to 100, got {total}")
+    for p in predictors:
+        validate_predictor(p)

@@ -1,0 +1,180 @@
+"""Minimal asyncio HTTP/1.1 server for the microservice and engine fronts.
+
+The reference serves REST through Flask/gunicorn
+(reference: python/seldon_core/microservice.py:153-264); this image has no
+flask, and a hand-rolled asyncio loop with keep-alive beats WSGI on the
+single-core hosts TPU VMs typically pair with anyway. Supports:
+keep-alive, pipelining (sequential), Content-Length bodies, JSON and
+form-encoded (``json=``) request bodies, and query-string ``?json=`` GETs
+for reference-client compatibility
+(reference: engine/.../service/InternalPredictionService.java:364-453 posts
+form-encoded ``json=``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import traceback
+from typing import Awaitable, Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, unquote, urlsplit
+
+logger = logging.getLogger(__name__)
+
+Handler = Callable[["Request"], Awaitable["Response"]]
+
+_STATUS_TEXT = {
+    200: "OK",
+    400: "Bad Request",
+    404: "Not Found",
+    405: "Method Not Allowed",
+    500: "Internal Server Error",
+    503: "Service Unavailable",
+}
+
+
+class Request:
+    __slots__ = ("method", "path", "query", "headers", "body")
+
+    def __init__(self, method: str, path: str, query: str, headers: Dict[str, str], body: bytes):
+        self.method = method
+        self.path = path
+        self.query = query
+        self.headers = headers
+        self.body = body
+
+    def json(self):
+        """Decode the payload: JSON body, form-encoded ``json=``, or query ``json=``."""
+        ctype = self.headers.get("content-type", "")
+        if self.body:
+            if ctype.startswith("application/x-www-form-urlencoded"):
+                form = parse_qs(self.body.decode("utf-8"))
+                if "json" in form:
+                    return json.loads(form["json"][0])
+                raise ValueError("form body missing json field")
+            return json.loads(self.body)
+        if self.query:
+            q = parse_qs(self.query)
+            if "json" in q:
+                return json.loads(q["json"][0])
+        return None
+
+
+class Response:
+    __slots__ = ("status", "body", "content_type")
+
+    def __init__(self, body, status: int = 200, content_type: str = "application/json"):
+        if isinstance(body, (dict, list)):
+            body = json.dumps(body, separators=(",", ":")).encode()
+        elif isinstance(body, str):
+            body = body.encode()
+        self.body = body or b""
+        self.status = status
+        self.content_type = content_type
+
+    def encode(self, keep_alive: bool) -> bytes:
+        reason = _STATUS_TEXT.get(self.status, "Unknown")
+        conn = "keep-alive" if keep_alive else "close"
+        head = (
+            f"HTTP/1.1 {self.status} {reason}\r\n"
+            f"Content-Type: {self.content_type}\r\n"
+            f"Content-Length: {len(self.body)}\r\n"
+            f"Connection: {conn}\r\n\r\n"
+        )
+        return head.encode() + self.body
+
+
+class HTTPServer:
+    """Exact-path router + asyncio serve loop."""
+
+    def __init__(self, name: str = "http"):
+        self.name = name
+        self.routes: Dict[str, Handler] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    def route(self, path: str):
+        def deco(fn: Handler) -> Handler:
+            self.routes[path] = fn
+            return fn
+
+        return deco
+
+    def add_route(self, path: str, fn: Handler) -> None:
+        self.routes[path] = fn
+
+    async def _dispatch(self, req: Request) -> Response:
+        handler = self.routes.get(req.path)
+        if handler is None:
+            return Response({"status": {"info": f"no route {req.path}", "code": 404, "status": "FAILURE"}}, 404)
+        try:
+            return await handler(req)
+        except (ValueError, KeyError) as e:
+            return Response(error_body(400, str(e)), 400)
+        except Exception as e:  # surface the traceback for debuggability
+            logger.error("handler %s failed: %s\n%s", req.path, e, traceback.format_exc())
+            return Response(error_body(500, f"{type(e).__name__}: {e}"), 500)
+
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            while True:
+                try:
+                    header_blob = await reader.readuntil(b"\r\n\r\n")
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    break
+                except asyncio.LimitOverrunError:
+                    writer.write(Response(error_body(400, "headers too large"), 400).encode(False))
+                    break
+                lines = header_blob.decode("latin-1").split("\r\n")
+                try:
+                    method, target, _version = lines[0].split(" ", 2)
+                except ValueError:
+                    writer.write(Response(error_body(400, "bad request line"), 400).encode(False))
+                    break
+                headers: Dict[str, str] = {}
+                for line in lines[1:]:
+                    if not line:
+                        continue
+                    k, _, v = line.partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                try:
+                    length = int(headers.get("content-length", 0))
+                except ValueError:
+                    length = -1
+                if length < 0:
+                    writer.write(Response(error_body(400, "bad Content-Length"), 400).encode(False))
+                    break
+                body = await reader.readexactly(length) if length else b""
+                parts = urlsplit(target)
+                req = Request(method, unquote(parts.path), parts.query, headers, body)
+                keep = headers.get("connection", "keep-alive").lower() != "close"
+                resp = await self._dispatch(req)
+                writer.write(resp.encode(keep))
+                await writer.drain()
+                if not keep:
+                    break
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def start(self, host: str, port: int):
+        self._server = await asyncio.start_server(
+            self._handle_conn, host, port, limit=64 * 1024 * 1024
+        )
+        logger.info("%s listening on %s:%d", self.name, host, port)
+        return self._server
+
+    async def serve_forever(self, host: str, port: int):
+        await self.start(host, port)
+        async with self._server:
+            await self._server.serve_forever()
+
+    def close(self):
+        if self._server is not None:
+            self._server.close()
+
+
+def error_body(code: int, info: str) -> dict:
+    return {"status": {"code": code, "info": info, "status": "FAILURE"}}

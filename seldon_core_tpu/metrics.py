@@ -1,0 +1,55 @@
+"""Custom-metrics helpers shipped in ``Meta.metrics``.
+
+Parity with reference: python/seldon_core/metrics.py:8-88 (COUNTER/GAUGE/
+TIMER dicts validated then merged into the response meta), consumed by the
+engine's metrics sink (reference:
+engine/src/main/java/io/seldon/engine/metrics/CustomMetricsManager.java:27-70).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+COUNTER = "COUNTER"
+GAUGE = "GAUGE"
+TIMER = "TIMER"
+
+_TYPES = (COUNTER, GAUGE, TIMER)
+
+
+def create_counter(key: str, value: float, tags: Dict[str, str] | None = None) -> Dict:
+    m = {"key": key, "type": COUNTER, "value": value}
+    if tags:
+        m["tags"] = tags
+    return m
+
+
+def create_gauge(key: str, value: float, tags: Dict[str, str] | None = None) -> Dict:
+    m = {"key": key, "type": GAUGE, "value": value}
+    if tags:
+        m["tags"] = tags
+    return m
+
+
+def create_timer(key: str, value: float, tags: Dict[str, str] | None = None) -> Dict:
+    m = {"key": key, "type": TIMER, "value": value}
+    if tags:
+        m["tags"] = tags
+    return m
+
+
+def validate_metrics(metrics: List[Dict]) -> bool:
+    if not isinstance(metrics, (list, tuple)):
+        return False
+    for m in metrics:
+        if not isinstance(m, dict):
+            return False
+        if "key" not in m or "value" not in m:
+            return False
+        if m.get("type", COUNTER) not in _TYPES:
+            return False
+        try:
+            float(m["value"])
+        except (TypeError, ValueError):
+            return False
+    return True

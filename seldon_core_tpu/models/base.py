@@ -1,0 +1,46 @@
+"""Served-model protocol: pure init/apply + sharding rules.
+
+Every model family exposes:
+  * ``init_params(seed) -> params`` pytree
+  * ``apply(params, x) -> y``  — pure, jit-friendly, static shapes
+  * ``input_sharding(mesh)`` / ``param_sharding(mesh, params)`` —
+    PartitionSpec layout so one served model spans a slice (TP over ICI)
+  * ``example_input_shape`` (without batch) for warmup
+  * optionally ``loss(params, batch)`` and ``train_step`` pieces used by
+    the fine-tune/feedback path and the multi-chip dry run.
+
+Design note: plain parameter pytrees + pure functions (not framework
+Module objects) keep jit/pjit boundaries and sharding annotations explicit;
+that is the property the whole serving stack relies on.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+
+class ServedModel:
+    example_input_shape: Tuple[int, ...] = ()
+    # dtype for activations; params stay in param_dtype
+    compute_dtype = "bfloat16"
+    param_dtype = "float32"
+
+    def init_params(self, seed: int = 0):
+        raise NotImplementedError
+
+    def apply(self, params, x):
+        raise NotImplementedError
+
+    def input_sharding(self, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        # batch rides the data axis when present
+        axis = "data" if "data" in mesh.axis_names else None
+        return NamedSharding(mesh, PartitionSpec(axis))
+
+    def param_sharding(self, mesh, params):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        repl = NamedSharding(mesh, PartitionSpec())
+        return jax.tree_util.tree_map(lambda _: repl, params)

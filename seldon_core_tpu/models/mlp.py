@@ -1,0 +1,52 @@
+"""MLP classifier family — the smallest ServedModel (tests, iris parity).
+
+Counterpart in spirit of the reference's sklearn iris demo
+(reference: servers/sklearnserver/ + notebooks): a small dense net served
+as a jit-compiled XLA executable.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+from .base import ServedModel
+
+
+class MLP(ServedModel):
+    def __init__(
+        self,
+        in_features: int = 4,
+        hidden: Sequence[int] = (64, 64),
+        num_classes: int = 3,
+        seed: int = 0,
+        **_ignored,
+    ):
+        self.in_features = int(in_features)
+        self.hidden = tuple(int(h) for h in hidden)
+        self.num_classes = int(num_classes)
+        self.example_input_shape = (self.in_features,)
+
+    def init_params(self, seed: int = 0):
+        import jax
+
+        dims = (self.in_features, *self.hidden, self.num_classes)
+        keys = jax.random.split(jax.random.PRNGKey(seed), len(dims) - 1)
+        params = []
+        for k, (d_in, d_out) in zip(keys, zip(dims[:-1], dims[1:])):
+            w = jax.random.normal(k, (d_in, d_out), dtype="float32") * (2.0 / d_in) ** 0.5
+            b = np.zeros((d_out,), dtype="float32")
+            params.append({"w": w, "b": b})
+        return params
+
+    def apply(self, params, x):
+        import jax
+        import jax.numpy as jnp
+
+        h = x.astype(self.compute_dtype)
+        for i, layer in enumerate(params):
+            h = h @ layer["w"].astype(self.compute_dtype) + layer["b"].astype(self.compute_dtype)
+            if i < len(params) - 1:
+                h = jnp.maximum(h, 0)
+        return jax.nn.softmax(h.astype("float32"), axis=-1)

@@ -1,0 +1,42 @@
+"""Generated wire-contract bindings.
+
+`prediction_pb2` is produced by `protoc` from
+``seldon_core_tpu/protos/prediction.proto`` (regenerate with
+``make proto`` at the repo root). The message schema is a TPU-first
+re-design of the reference contract (reference: proto/prediction.proto:14-130).
+"""
+
+import os
+import sys
+
+# protoc emits a flat import; make the generated module importable both as
+# `seldon_core_tpu.proto.prediction_pb2` and bare `prediction_pb2`.
+sys.path.insert(0, os.path.dirname(__file__))
+
+from . import prediction_pb2  # noqa: E402
+
+SeldonMessage = prediction_pb2.SeldonMessage
+SeldonMessageList = prediction_pb2.SeldonMessageList
+SeldonMessageBatch = prediction_pb2.SeldonMessageBatch
+Feedback = prediction_pb2.Feedback
+DefaultData = prediction_pb2.DefaultData
+Tensor = prediction_pb2.Tensor
+RawTensor = prediction_pb2.RawTensor
+Meta = prediction_pb2.Meta
+Metric = prediction_pb2.Metric
+Status = prediction_pb2.Status
+
+__all__ = [
+    "prediction_pb2",
+    "SeldonMessage",
+    "SeldonMessageList",
+    "SeldonMessageBatch",
+    "Feedback",
+    "DefaultData",
+    "Tensor",
+    "RawTensor",
+    "Meta",
+    "Metric",
+    "Status",
+    "services",
+]

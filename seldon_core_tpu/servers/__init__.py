@@ -1,0 +1,13 @@
+"""Prepackaged model servers.
+
+Counterparts of the reference's servers/ tree (reference:
+servers/sklearnserver/sklearnserver/SKLearnServer.py:15-43,
+servers/xgboostserver/xgboostserver/XGBoostServer.py,
+servers/mlflowserver/mlflowserver/MLFlowServer.py,
+integrations/tfserving/TfServingProxy.py:21-60) plus the TPU-native
+JAXServer (new — BASELINE.json north star: serve SavedModel/flax
+checkpoints as jit-compiled XLA executables on TPU).
+
+SDKs not present in this image (xgboost, mlflow, tensorflow-serving)
+are import-gated: the server class exists, raises a clear error on load.
+"""

@@ -1,0 +1,102 @@
+"""JAX prepackaged server — the TPU-native flagship.
+
+No reference counterpart by design: the reference served GPU/CPU models
+via TFServing/Triton proxies (reference: integrations/tfserving/
+TfServingProxy.py:21-60, integrations/nvidia-inference-server/TRTProxy.py);
+this server runs models directly as jit-compiled XLA executables on TPU
+(BASELINE.json north star: "add a servers/jaxserver prepackaged server").
+
+Model URI layout::
+
+    <model_uri>/jax_config.json   {"family": "resnet50"|"bert"|"llm"|"mlp",
+                                   "config": {...model kwargs...},
+                                   "checkpoint": "ckpt"}   # optional orbax dir
+    <model_uri>/ckpt/             orbax checkpoint of params (optional; random
+                                  init with config["seed"] when absent — used
+                                  by benchmarks and tests)
+
+Sharding: when constructed with a mesh (or ``tpu_mesh`` spec), params are
+laid out by the model family's ``param_sharding`` rule and inputs by
+``input_sharding`` — tensor parallelism over ICI, no code change in the
+model. (reference's only analogue was K8s replica scaling.)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..storage import Storage
+from ..user_model import JAXComponent
+
+logger = logging.getLogger(__name__)
+
+
+class JAXServer(JAXComponent):
+    def __init__(self, model_uri: str, mesh=None, batch_size_hint: int = 8, **kwargs):
+        super().__init__(mesh=mesh)
+        self.model_uri = model_uri
+        self.batch_size_hint = int(batch_size_hint)
+        self._extra = kwargs
+        self._family = None
+        self._config: Dict[str, Any] = {}
+        self._model = None
+
+    # -- JAXComponent --
+
+    def build(self):
+        from .. import models as model_zoo
+
+        model_dir = Storage.download(self.model_uri)
+        cfg_path = os.path.join(model_dir, "jax_config.json")
+        if not os.path.exists(cfg_path):
+            raise RuntimeError(f"no jax_config.json under {self.model_uri}")
+        with open(cfg_path) as f:
+            cfg = json.load(f)
+        self._family = cfg["family"]
+        self._config = cfg.get("config", {})
+        self._model = model_zoo.build(self._family, **self._config)
+        params = None
+        ckpt_rel = cfg.get("checkpoint")
+        if ckpt_rel:
+            ckpt_dir = os.path.join(model_dir, ckpt_rel)
+            if os.path.isdir(ckpt_dir):
+                params = self._restore_checkpoint(ckpt_dir)
+        if params is None:
+            seed = int(self._config.get("seed", 0))
+            params = self._model.init_params(seed)
+            logger.info("jaxserver %s: random-initialised params (seed=%d)", self._family, seed)
+        return self._model.apply, params
+
+    def _restore_checkpoint(self, ckpt_dir: str):
+        import orbax.checkpoint as ocp
+
+        with ocp.PyTreeCheckpointer() as ckptr:
+            restored = ckptr.restore(ckpt_dir)
+        logger.info("jaxserver: restored checkpoint from %s", ckpt_dir)
+        return restored
+
+    def input_sharding(self, mesh):
+        return self._model.input_sharding(mesh)
+
+    def param_sharding(self, mesh, params):
+        return self._model.param_sharding(mesh, params)
+
+    @property
+    def warmup_shape(self):
+        return self._model.example_input_shape if self._model else None
+
+    @warmup_shape.setter
+    def warmup_shape(self, _v):  # JAXComponent sets it as a class attr default
+        pass
+
+    def class_names(self):
+        names = self._config.get("class_names")
+        return list(names) if names else []
+
+    def tags(self):
+        return {"family": self._family or "?", "server": "jaxserver"}

@@ -1,0 +1,33 @@
+"""MLflow prepackaged server (import-gated; mlflow absent in this image).
+
+Parity with reference: servers/mlflowserver/mlflowserver/MLFlowServer.py
+(MLmodel-format pyfunc load).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..storage import Storage
+from ..user_model import SeldonComponent
+
+
+class MLFlowServer(SeldonComponent):
+    def __init__(self, model_uri: str, **kwargs):
+        self.model_uri = model_uri
+        self._model = None
+
+    def load(self) -> None:
+        try:
+            from mlflow import pyfunc
+        except ImportError as e:
+            raise RuntimeError(
+                "MLFLOW_SERVER requires the mlflow package, not present in this image"
+            ) from e
+        model_dir = Storage.download(self.model_uri)
+        self._model = pyfunc.load_model(model_dir)
+
+    def predict(self, X, names, meta=None):
+        if self._model is None:
+            self.load()
+        return np.asarray(self._model.predict(np.asarray(X)))

@@ -1,0 +1,48 @@
+"""TensorFlow model server: SavedModel served through the TPU path.
+
+The reference bridged to an external TF-Serving container over gRPC/REST
+(reference: integrations/tfserving/TfServingProxy.py:21-60 and the
+TENSORFLOW_SERVER wiring in operator/controllers/
+seldondeployment_prepackaged_servers.go:30-107). TPU-native design: no
+sidecar — load the SavedModel and execute it with jax2tf round-trip or,
+when tensorflow is absent (this image), fail with a clear error telling
+users to export to the jaxserver format instead.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..storage import Storage
+from ..user_model import SeldonComponent
+
+
+class TFServer(SeldonComponent):
+    def __init__(self, model_uri: str, signature: str = "serving_default", **kwargs):
+        self.model_uri = model_uri
+        self.signature = signature
+        self._fn = None
+
+    def load(self) -> None:
+        try:
+            import tensorflow as tf  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "TENSORFLOW_SERVER requires tensorflow (absent in this image). "
+                "Export the SavedModel to jaxserver format (jax_config.json + "
+                "orbax checkpoint) and use JAX_SERVER instead."
+            ) from e
+        import tensorflow as tf
+
+        model_dir = Storage.download(self.model_uri)
+        loaded = tf.saved_model.load(model_dir)
+        self._fn = loaded.signatures[self.signature]
+
+    def predict(self, X, names, meta=None):
+        import tensorflow as tf
+
+        if self._fn is None:
+            self.load()
+        out = self._fn(tf.constant(np.asarray(X)))
+        first = next(iter(out.values()))
+        return first.numpy()

@@ -1,0 +1,111 @@
+"""Model artifact download: file://, gs://, s3://, http(s)://.
+
+Parity with reference: python/seldon_core/storage.py:37-160 (GCS/S3/Azure/
+file pulls into a local dir used by prepackaged servers). Cloud SDKs are
+not in this image, so gs:// and s3:// are gated behind optional imports and
+raise a clear error when the SDK is missing; file:// and plain paths work
+everywhere (and are what the tests and local scheduler use).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import tempfile
+from urllib.parse import urlparse
+
+logger = logging.getLogger(__name__)
+
+
+class Storage:
+    @staticmethod
+    def download(uri: str, out_dir: str | None = None) -> str:
+        logger.info("Copying contents of %s to local", uri)
+        if out_dir is None:
+            out_dir = tempfile.mkdtemp()
+        scheme = urlparse(uri).scheme
+        if scheme in ("", "file"):
+            return Storage._download_local(uri, out_dir)
+        if scheme == "gs":
+            return Storage._download_gcs(uri, out_dir)
+        if scheme == "s3":
+            return Storage._download_s3(uri, out_dir)
+        if scheme in ("http", "https"):
+            return Storage._download_http(uri, out_dir)
+        raise ValueError(
+            f"cannot recognize storage type for {uri}; supported: file://, gs://, s3://, http(s)://"
+        )
+
+    @staticmethod
+    def _download_local(uri: str, out_dir: str) -> str:
+        path = uri[len("file://"):] if uri.startswith("file://") else uri
+        if not os.path.exists(path):
+            raise RuntimeError(f"local path {path} does not exist")
+        if os.path.isdir(path):
+            for item in os.listdir(path):
+                src = os.path.join(path, item)
+                dst = os.path.join(out_dir, item)
+                if os.path.isdir(src):
+                    shutil.copytree(src, dst, dirs_exist_ok=True)
+                else:
+                    shutil.copy2(src, dst)
+        else:
+            shutil.copy2(path, out_dir)
+        return out_dir
+
+    @staticmethod
+    def _download_gcs(uri: str, out_dir: str) -> str:
+        try:
+            from google.cloud import storage as gcs  # type: ignore
+        except ImportError as e:
+            raise RuntimeError(
+                "gs:// model URIs need google-cloud-storage, not present in this image"
+            ) from e
+        parsed = urlparse(uri)
+        client = gcs.Client()
+        bucket = client.bucket(parsed.netloc)
+        prefix = parsed.path.lstrip("/")
+        blobs = list(bucket.list_blobs(prefix=prefix))
+        if not blobs:
+            raise RuntimeError(f"no objects under {uri}")
+        for blob in blobs:
+            rel = os.path.relpath(blob.name, prefix)
+            dst = os.path.join(out_dir, rel if rel != "." else os.path.basename(blob.name))
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            blob.download_to_filename(dst)
+        return out_dir
+
+    @staticmethod
+    def _download_s3(uri: str, out_dir: str) -> str:
+        try:
+            import boto3  # type: ignore
+        except ImportError as e:
+            raise RuntimeError("s3:// model URIs need boto3, not present in this image") from e
+        parsed = urlparse(uri)
+        s3 = boto3.client(
+            "s3",
+            endpoint_url=os.environ.get("S3_ENDPOINT") or None,
+        )
+        prefix = parsed.path.lstrip("/")
+        paginator = s3.get_paginator("list_objects_v2")
+        n = 0
+        for page in paginator.paginate(Bucket=parsed.netloc, Prefix=prefix):
+            for obj in page.get("Contents", []):
+                rel = os.path.relpath(obj["Key"], prefix)
+                dst = os.path.join(out_dir, rel if rel != "." else os.path.basename(obj["Key"]))
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                s3.download_file(parsed.netloc, obj["Key"], dst)
+                n += 1
+        if n == 0:
+            raise RuntimeError(f"no objects under {uri}")
+        return out_dir
+
+    @staticmethod
+    def _download_http(uri: str, out_dir: str) -> str:
+        import urllib.request
+
+        dst = os.path.join(out_dir, os.path.basename(urlparse(uri).path) or "artifact")
+        with urllib.request.urlopen(uri) as r, open(dst, "wb") as f:
+            shutil.copyfileobj(r, f)
+        return out_dir

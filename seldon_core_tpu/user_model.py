@@ -1,0 +1,294 @@
+"""User-facing component API.
+
+Feature parity with the reference's ``SeldonComponent``
+(reference: python/seldon_core/user_model.py:18-78): optional hooks
+``predict``, ``transform_input``, ``transform_output``, ``route``,
+``aggregate``, ``send_feedback`` plus ``metrics``/``tags``/``class_names``/
+``load``/``health_status`` and proto-level ``*_raw`` variants. Components
+missing a hook degrade gracefully (identity transform / passthrough), like
+the reference's ``client_*`` adapters
+(reference: python/seldon_core/user_model.py:134-361).
+
+TPU-first addition: :class:`JAXComponent` — a component whose ``predict`` is
+a jit-compiled XLA executable over HBM-resident params, with an optional
+``jax.sharding.Mesh`` so a single served model spans the chips of a slice
+(tensor parallelism over ICI). This is the ``device=tpu`` path the reference
+never had (its leaf compute was whatever numpy code the user wrote).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Iterable, List, Optional, Union
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class SeldonComponent:
+    """Base class for graph components. All hooks are optional."""
+
+    def load(self) -> None:
+        """Called once per worker before serving (model/params load site)."""
+
+    # --- tensor-level hooks (X is np.ndarray | jax.Array | bytes | str | json) ---
+
+    def predict(self, X, names: Iterable[str], meta: Optional[Dict] = None):
+        raise NotImplementedError
+
+    def transform_input(self, X, names: Iterable[str], meta: Optional[Dict] = None):
+        raise NotImplementedError
+
+    def transform_output(self, X, names: Iterable[str], meta: Optional[Dict] = None):
+        raise NotImplementedError
+
+    def route(self, X, names: Iterable[str], meta: Optional[Dict] = None) -> int:
+        raise NotImplementedError
+
+    def aggregate(self, Xs: List[Any], names: List[List[str]], metas: Optional[List[Dict]] = None):
+        raise NotImplementedError
+
+    def send_feedback(self, X, names: Iterable[str], reward: float, truth, routing: Optional[int] = None):
+        raise NotImplementedError
+
+    # --- proto-level hooks (full SeldonMessage in/out, bypass marshaling) ---
+
+    def predict_raw(self, msg):
+        raise NotImplementedError
+
+    def transform_input_raw(self, msg):
+        raise NotImplementedError
+
+    def transform_output_raw(self, msg):
+        raise NotImplementedError
+
+    def route_raw(self, msg):
+        raise NotImplementedError
+
+    def aggregate_raw(self, msgs):
+        raise NotImplementedError
+
+    def send_feedback_raw(self, feedback):
+        raise NotImplementedError
+
+    # --- metadata hooks ---
+
+    def metrics(self) -> List[Dict]:
+        raise NotImplementedError
+
+    def tags(self) -> Dict:
+        raise NotImplementedError
+
+    def class_names(self) -> List[str]:
+        raise NotImplementedError
+
+    def feature_names(self) -> List[str]:
+        raise NotImplementedError
+
+    def health_status(self):
+        """Optional liveness probe payload; exceptions mark unhealthy."""
+        raise NotImplementedError
+
+
+def _has_hook(user_model, name: str) -> bool:
+    """True if user_model provides `name` (overridden or duck-typed)."""
+    hook = getattr(user_model, name, None)
+    if hook is None or not callable(hook):
+        return False
+    if isinstance(user_model, SeldonComponent):
+        return getattr(type(user_model), name, None) is not getattr(SeldonComponent, name, None)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# client_* adapters: call the hook if present, degrade gracefully otherwise
+# (reference: python/seldon_core/user_model.py:134-361)
+# ---------------------------------------------------------------------------
+
+
+class SeldonNotImplementedError(NotImplementedError):
+    """Raised by client_* when neither typed nor raw hook exists."""
+
+
+def client_has_raw(user_model, method: str) -> bool:
+    return _has_hook(user_model, method + "_raw")
+
+
+def client_raw(user_model, method: str, *args):
+    return getattr(user_model, method + "_raw")(*args)
+
+
+def client_predict(user_model, X, names, meta=None):
+    if _has_hook(user_model, "predict"):
+        try:
+            return user_model.predict(X, names, meta)
+        except TypeError:
+            return user_model.predict(X, names)
+    raise SeldonNotImplementedError("predict not implemented")
+
+
+def client_transform_input(user_model, X, names, meta=None):
+    if _has_hook(user_model, "transform_input"):
+        try:
+            return user_model.transform_input(X, names, meta)
+        except TypeError:
+            return user_model.transform_input(X, names)
+    return X  # identity (reference: user_model.py:239-260)
+
+
+def client_transform_output(user_model, X, names, meta=None):
+    if _has_hook(user_model, "transform_output"):
+        try:
+            return user_model.transform_output(X, names, meta)
+        except TypeError:
+            return user_model.transform_output(X, names)
+    return X
+
+
+def client_route(user_model, X, names, meta=None) -> int:
+    if _has_hook(user_model, "route"):
+        try:
+            branch = user_model.route(X, names, meta)
+        except TypeError:
+            branch = user_model.route(X, names)
+        if not isinstance(branch, (int, np.integer)):
+            raise ValueError(f"route() must return int, got {type(branch).__name__}")
+        return int(branch)
+    raise SeldonNotImplementedError("route not implemented")
+
+
+def client_aggregate(user_model, Xs, names_list, metas=None):
+    if _has_hook(user_model, "aggregate"):
+        try:
+            return user_model.aggregate(Xs, names_list, metas)
+        except TypeError:
+            return user_model.aggregate(Xs, names_list)
+    raise SeldonNotImplementedError("aggregate not implemented")
+
+
+def client_send_feedback(user_model, X, names, reward, truth, routing=None):
+    if _has_hook(user_model, "send_feedback"):
+        return user_model.send_feedback(X, names, reward, truth, routing=routing)
+    return None
+
+
+def client_custom_metrics(user_model) -> List[Dict]:
+    if _has_hook(user_model, "metrics"):
+        from .metrics import validate_metrics
+
+        out = user_model.metrics()
+        if not validate_metrics(out):
+            raise ValueError(f"invalid custom metrics: {out}")
+        return out
+    return []
+
+
+def client_custom_tags(user_model) -> Dict:
+    if _has_hook(user_model, "tags"):
+        return user_model.tags() or {}
+    return {}
+
+
+def client_class_names(user_model, result) -> List[str]:
+    if _has_hook(user_model, "class_names"):
+        return list(user_model.class_names())
+    arr = np.asarray(result) if isinstance(result, (list, tuple)) else result
+    if hasattr(arr, "ndim") and getattr(arr, "ndim", 0) > 1:
+        return [f"t:{i}" for i in range(arr.shape[-1])]
+    return []
+
+
+def client_health_status(user_model):
+    if _has_hook(user_model, "health_status"):
+        return user_model.health_status()
+    return "ok"
+
+
+# ---------------------------------------------------------------------------
+# TPU-native component
+# ---------------------------------------------------------------------------
+
+
+class JAXComponent(SeldonComponent):
+    """A component whose forward pass is a jit-compiled XLA executable.
+
+    Subclasses implement :meth:`build` returning ``(apply_fn, params)`` where
+    ``apply_fn(params, x) -> y`` is pure and jit-friendly. ``load()`` compiles
+    it, places params in HBM (sharded over ``mesh`` if given) and warms the
+    executable so first-request latency excludes XLA compile (~20-40 s).
+
+    On request, incoming host arrays take the zero-copy path
+    (payload.to_device) and outputs stay on device until serialization —
+    there is no numpy detour inside the hot loop.
+    """
+
+    # dtype for activations/params; bf16 keeps the MXU fed at full rate.
+    compute_dtype = "bfloat16"
+    # example input shape (without batch) used to warm the executable
+    warmup_shape: Optional[tuple] = None
+    warmup_dtype = "float32"
+
+    def __init__(self, mesh=None, donate_input: bool = False):
+        self._mesh = mesh
+        self._donate = donate_input
+        self._apply = None
+        self.params = None
+
+    # -- to implement --
+    def build(self):
+        raise NotImplementedError
+
+    def input_sharding(self, mesh):
+        """Sharding for the request batch; default replicated."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(mesh, PartitionSpec())
+
+    def param_sharding(self, mesh, params):
+        """Shardings pytree for params; default fully replicated."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        repl = NamedSharding(mesh, PartitionSpec())
+        return jax.tree_util.tree_map(lambda _: repl, params)
+
+    # -- SeldonComponent --
+    def load(self) -> None:
+        import jax
+
+        apply_fn, params = self.build()
+        if self._mesh is not None:
+            shardings = self.param_sharding(self._mesh, params)
+            params = jax.device_put(params, shardings)
+        else:
+            params = jax.device_put(params)
+        self.params = params
+        donate = (1,) if self._donate else ()
+        self._apply = jax.jit(apply_fn, donate_argnums=donate)
+        if self.warmup_shape is not None:
+            x = np.zeros((1, *self.warmup_shape), dtype=self.warmup_dtype)
+            jax.block_until_ready(self._apply(self.params, self._to_dev(x)))
+        logger.info("JAXComponent %s compiled and warm", type(self).__name__)
+
+    def _to_dev(self, X):
+        from . import payload
+
+        sharding = self.input_sharding(self._mesh) if self._mesh is not None else None
+        # float inputs are downcast host-side to compute_dtype (bf16 by
+        # default): halves the host->HBM DMA and feeds the MXU at full rate
+        dtype = (
+            self.compute_dtype
+            if getattr(X, "dtype", None) is not None and np.issubdtype(np.asarray(X).dtype, np.floating)
+            else None
+        )
+        return payload.to_device(X, sharding=sharding, dtype=dtype)
+
+    def predict(self, X, names, meta=None):
+        if self._apply is None:
+            self.load()
+        import jax
+
+        if isinstance(X, np.ndarray):
+            X = self._to_dev(X)
+        return jax.block_until_ready(self._apply(self.params, X))

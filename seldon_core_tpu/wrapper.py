@@ -1,0 +1,163 @@
+"""REST and gRPC fronts around a user component.
+
+Parity with reference: python/seldon_core/wrapper.py:18-142 — REST routes
+``/predict``, ``/transform-input``, ``/transform-output``, ``/route``,
+``/aggregate``, ``/send-feedback`` (+ ``/health/status``, ``/ready``,
+``/live``, ``/pause``, ``/unpause``) and a gRPC server registered as
+*every* component service (Generic/Model/Router/... — the reference
+registers Generic+Model, wrapper.py:132-141; we register the full set so a
+single wrapped component can sit at any graph position).
+
+gRPC uses generic method handlers from the canonical table in
+``proto/services.py`` (no grpc_tools in the image).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from concurrent import futures
+from typing import Optional
+
+from . import seldon_methods
+from .http_server import HTTPServer, Request, Response, error_body
+from .proto import services as svc
+from .proto import prediction_pb2 as pb
+
+logger = logging.getLogger(__name__)
+
+
+class ServerState:
+    """Pause/drain flag (reference: RestClientController.java:120-132)."""
+
+    def __init__(self):
+        self.paused = False
+        self.ready = True
+
+
+def get_rest_microservice(user_object, state: Optional[ServerState] = None) -> HTTPServer:
+    app = HTTPServer("microservice-rest")
+    state = state or ServerState()
+
+    def _sync(fn, *args):
+        # Hooks are sync (numpy/jax); run on the loop's default executor so
+        # a slow model doesn't starve health probes.
+        return asyncio.get_running_loop().run_in_executor(None, fn, *args)
+
+    def endpoint(method_fn, needs_body=True):
+        async def handler(req: Request) -> Response:
+            if state.paused:
+                return Response(error_body(503, "paused"), 503)
+            body = req.json()
+            if body is None and needs_body:
+                return Response(error_body(400, "empty request body"), 400)
+            out = await _sync(method_fn, user_object, body)
+            return Response(out)
+
+        return handler
+
+    app.add_route("/predict", endpoint(seldon_methods.predict))
+    app.add_route("/api/v1.0/predictions", endpoint(seldon_methods.predict))
+    app.add_route("/transform-input", endpoint(seldon_methods.transform_input))
+    app.add_route("/transform-output", endpoint(seldon_methods.transform_output))
+    app.add_route("/route", endpoint(seldon_methods.route))
+    app.add_route("/aggregate", endpoint(seldon_methods.aggregate))
+    app.add_route("/send-feedback", endpoint(seldon_methods.send_feedback))
+
+    async def health(req: Request) -> Response:
+        out = await _sync(seldon_methods.health_status, user_object)
+        return Response(out)
+
+    async def live(req: Request) -> Response:
+        return Response({"status": "ok"})
+
+    async def ready(req: Request) -> Response:
+        if state.paused or not state.ready:
+            return Response(error_body(503, "not ready"), 503)
+        return Response({"status": "ok"})
+
+    async def pause(req: Request) -> Response:
+        state.paused = True
+        return Response({"status": "paused"})
+
+    async def unpause(req: Request) -> Response:
+        state.paused = False
+        return Response({"status": "ok"})
+
+    app.add_route("/health/status", health)
+    app.add_route("/live", live)
+    app.add_route("/ready", ready)
+    app.add_route("/pause", pause)
+    app.add_route("/unpause", unpause)
+    return app
+
+
+# ---------------------------------------------------------------------------
+# gRPC
+# ---------------------------------------------------------------------------
+
+_METHOD_IMPL = {
+    "Predict": seldon_methods.predict,
+    "TransformInput": seldon_methods.transform_input,
+    "TransformOutput": seldon_methods.transform_output,
+    "Route": seldon_methods.route,
+    "Aggregate": seldon_methods.aggregate,
+    "SendFeedback": seldon_methods.send_feedback,
+}
+
+
+def _make_handler(user_object, method: str, req_cls, grpc):
+    impl = _METHOD_IMPL[method]
+
+    def run(request, context):
+        try:
+            return impl(user_object, request)
+        except Exception as e:  # noqa: BLE001 - wire errors back to caller
+            logger.error("grpc %s failed: %s", method, e, exc_info=True)
+            context.set_code(grpc.StatusCode.INTERNAL)
+            context.set_details(f"{type(e).__name__}: {e}")
+            return pb.SeldonMessage()
+
+    return grpc.unary_unary_rpc_method_handler(
+        run,
+        request_deserializer=req_cls.FromString,
+        response_serializer=lambda m: m.SerializeToString(),
+    )
+
+
+def get_grpc_server(
+    user_object,
+    max_workers: int = 4,
+    max_message_bytes: Optional[int] = None,
+    service_names=None,
+):
+    import grpc
+
+    options = []
+    if max_message_bytes:
+        options += [
+            ("grpc.max_send_message_length", max_message_bytes),
+            ("grpc.max_receive_message_length", max_message_bytes),
+        ]
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers), options=options)
+    for service, methods in svc.SERVICES.items():
+        if service_names and service not in service_names:
+            continue
+        handlers = {
+            m: _make_handler(user_object, m, req_cls, grpc)
+            for m, (req_cls, _resp_cls) in methods.items()
+        }
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(svc.full_service_name(service), handlers),)
+        )
+    return server
+
+
+def grpc_stub(channel, service: str, method: str):
+    """Client callable for a component method (replaces generated stubs)."""
+    req_cls, resp_cls = svc.SERVICES[service][method]
+    return channel.unary_unary(
+        svc.method_path(service, method),
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=resp_cls.FromString,
+    )

@@ -1,0 +1,42 @@
+"""Test env: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip hardware is not available in CI; all sharding tests run against
+``--xla_force_host_platform_device_count=8`` (the driver separately
+dry-runs the multi-chip path via __graft_entry__.dryrun_multichip).
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import asyncio
+import json as _json
+
+import pytest
+
+from seldon_core_tpu.http_server import Request
+
+
+class RestTestClient:
+    """In-process REST client (no sockets), like flask's test_client
+    (reference tests: python/tests/test_model_microservice.py:1-40)."""
+
+    def __init__(self, app):
+        self.app = app
+
+    def call(self, path: str, body=None, method: str = "POST", query: str = ""):
+        raw = _json.dumps(body).encode() if body is not None else b""
+        headers = {"content-type": "application/json"} if raw else {}
+        req = Request(method, path, query, headers, raw)
+        resp = asyncio.run(self.app._dispatch(req))
+        payload = _json.loads(resp.body) if resp.body else None
+        return resp.status, payload
+
+
+@pytest.fixture
+def rest_client():
+    return RestTestClient

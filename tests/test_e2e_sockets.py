@@ -1,0 +1,148 @@
+"""Socket-level e2e: microservice behind a real port, engine fan-out over
+REST and gRPC transports (counterpart of the reference's kind-based e2e
+tier, scaled to one host — reference: testing/scripts/test_s2i_python.py).
+"""
+
+import asyncio
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.service import EngineApp
+from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
+from seldon_core_tpu.user_model import SeldonComponent
+from seldon_core_tpu.wrapper import get_grpc_server, get_rest_microservice
+
+
+class Doubler(SeldonComponent):
+    def predict(self, X, names, meta=None):
+        return np.asarray(X) * 2
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def rest_microservice_port():
+    port = free_port()
+    app = get_rest_microservice(Doubler())
+    loop = asyncio.new_event_loop()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(app.serve_forever("127.0.0.1", port))
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            s = socket.create_connection(("127.0.0.1", port), 0.2)
+            s.close()
+            break
+        except OSError:
+            time.sleep(0.02)
+    yield port
+    loop.call_soon_threadsafe(loop.stop)
+
+
+@pytest.fixture
+def grpc_microservice_port():
+    port = free_port()
+    server = get_grpc_server(Doubler())
+    server.add_insecure_port(f"127.0.0.1:{port}")
+    server.start()
+    yield port
+    server.stop(grace=0)
+
+
+def engine_for(transport: str, port: int) -> EngineApp:
+    spec = default_predictor(
+        PredictorSpec.from_dict(
+            {
+                "name": "e2e",
+                "graph": {
+                    "name": "m",
+                    "type": "MODEL",
+                    "endpoint": {
+                        "service_host": "127.0.0.1",
+                        "service_port": port if transport == "REST" else 0,
+                        "grpc_port": port if transport == "GRPC" else 0,
+                        "transport": transport,
+                    },
+                },
+            }
+        )
+    )
+    return EngineApp(spec)
+
+
+def test_engine_over_rest_transport(rest_microservice_port):
+    app = engine_for("REST", rest_microservice_port)
+
+    async def go():
+        out = await app.predict({"data": {"ndarray": [[1.0, 2.0]]}})
+        ready = await app.executor.ready()
+        await app.executor.close()
+        return out, ready
+
+    out, ready = asyncio.run(go())
+    assert out["data"]["ndarray"] == [[2.0, 4.0]]
+    assert out["meta"]["puid"]
+    assert ready is True
+
+
+def test_engine_over_grpc_transport(grpc_microservice_port):
+    app = engine_for("GRPC", grpc_microservice_port)
+
+    async def go():
+        out = await app.predict({"data": {"ndarray": [[1.0, 2.0]]}})
+        await app.executor.close()
+        return out
+
+    out = asyncio.run(go())
+    assert out["data"]["ndarray"] == [[2.0, 4.0]]
+
+
+def test_engine_rest_server_full_stack(rest_microservice_port):
+    """Client -> engine HTTP port -> microservice HTTP port -> back."""
+    import json
+    import urllib.request
+
+    engine_port = free_port()
+    app = engine_for("REST", rest_microservice_port)
+    loop = asyncio.new_event_loop()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(
+            app.rest_app().serve_forever("127.0.0.1", engine_port)
+        )
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            s = socket.create_connection(("127.0.0.1", engine_port), 0.2)
+            s.close()
+            break
+        except OSError:
+            time.sleep(0.02)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{engine_port}/api/v0.1/predictions",
+        data=json.dumps({"data": {"ndarray": [[3.0]]}}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        body = json.loads(r.read())
+    assert body["data"]["ndarray"] == [[6.0]]
+    loop.call_soon_threadsafe(loop.stop)

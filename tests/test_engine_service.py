@@ -1,0 +1,114 @@
+"""Engine app tests: REST/gRPC fronts, micro-batching, metrics, logging."""
+
+import asyncio
+
+import numpy as np
+
+from seldon_core_tpu.graph.engine_metrics import MetricsRegistry
+from seldon_core_tpu.graph.service import EngineApp, RequestLogger
+from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
+from seldon_core_tpu.user_model import SeldonComponent
+
+
+def make_app(**kw):
+    spec = default_predictor(
+        PredictorSpec.from_dict(
+            {"name": "dep", "graph": {"name": "m", "implementation": "SIMPLE_MODEL"}}
+        )
+    )
+    return EngineApp(spec, metrics=MetricsRegistry(), **kw)
+
+
+def test_rest_predictions_endpoint(rest_client):
+    app = make_app()
+    client = rest_client(app.rest_app())
+    status, body = client.call(
+        "/api/v0.1/predictions", {"data": {"ndarray": [[1.0, 2.0]]}}
+    )
+    assert status == 200
+    assert body["data"]["ndarray"] == [[0.9, 0.05, 0.05]]
+    status, body = client.call("/api/v1.0/predictions", {"data": {"ndarray": [[1.0]]}})
+    assert status == 200
+
+
+def test_rest_metrics_exposed(rest_client):
+    app = make_app()
+    client = rest_client(app.rest_app())
+    client.call("/api/v0.1/predictions", {"data": {"ndarray": [[1.0]]}})
+    req = __import__("seldon_core_tpu.http_server", fromlist=["Request"]).Request
+    resp = asyncio.run(app.rest_app()._dispatch(req("GET", "/prometheus", "", {}, b"")))
+    text = resp.body.decode()
+    assert "seldon_api_engine_server_requests" in text
+    assert 'deployment="dep"' in text
+
+
+def test_request_logger_receives_pairs():
+    events = []
+    app = make_app(request_logger=RequestLogger(events.append))
+    asyncio.run(app.predict({"data": {"ndarray": [[1.0]]}}))
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["type"] == "seldon.message.pair"
+    assert ev["data"]["request"]["data"]["ndarray"] == [[1.0]]
+    assert ev["data"]["response"]["data"]["ndarray"] == [[0.9, 0.05, 0.05]]
+
+
+def test_pause_unpause(rest_client):
+    app = make_app()
+    client = rest_client(app.rest_app())
+    assert client.call("/pause", None)[0] == 200
+    assert client.call("/api/v0.1/predictions", {"data": {"ndarray": [[1]]}})[0] == 503
+    assert client.call("/unpause", None)[0] == 200
+    assert client.call("/api/v0.1/predictions", {"data": {"ndarray": [[1]]}})[0] == 200
+
+
+class CountingBatchModel(SeldonComponent):
+    def __init__(self):
+        self.calls = []
+
+    def predict(self, X, names, meta=None):
+        arr = np.asarray(X)
+        self.calls.append(arr.shape[0])
+        return arr * 2
+
+
+def test_micro_batching_fuses_concurrent_requests():
+    model = CountingBatchModel()
+    spec = default_predictor(
+        PredictorSpec.from_dict({"name": "d", "graph": {"name": "m", "type": "MODEL"}})
+    )
+    app = EngineApp(
+        spec,
+        registry={"m": model},
+        metrics=MetricsRegistry(),
+        batching={"m": {"max_batch": 8, "timeout_ms": 20.0}},
+    )
+
+    async def fire():
+        reqs = [
+            app.predict({"data": {"ndarray": [[float(i), 0.0]]}}) for i in range(6)
+        ]
+        return await asyncio.gather(*reqs)
+
+    outs = asyncio.run(fire())
+    for i, out in enumerate(outs):
+        np.testing.assert_allclose(out["data"]["ndarray"], [[2.0 * i, 0.0]])
+    # fewer model invocations than requests => fusion happened
+    assert len(model.calls) < 6
+    assert sum(model.calls) >= 6  # padding allowed
+
+
+def test_micro_batching_single_request_passthrough():
+    model = CountingBatchModel()
+    spec = default_predictor(
+        PredictorSpec.from_dict({"name": "d", "graph": {"name": "m", "type": "MODEL"}})
+    )
+    app = EngineApp(
+        spec,
+        registry={"m": model},
+        metrics=MetricsRegistry(),
+        batching={"m": {"max_batch": 8, "timeout_ms": 1.0}},
+    )
+    out = asyncio.run(app.predict({"data": {"ndarray": [[3.0]]}}))
+    np.testing.assert_allclose(out["data"]["ndarray"], [[6.0]])
+    assert model.calls == [1]

@@ -1,0 +1,292 @@
+"""Graph algebra tests against builtin units — no sockets.
+
+Counterpart of the reference engine tests (reference:
+engine/src/test/java/.../predictors/SimpleModelUnitTest.java,
+AverageCombinerTest.java, RandomABTestUnitInternalTest.java and the
+mocked-RestTemplate slice tests TestRestClientControllerExternalGraphs.java).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph import GraphExecutor, PredictorSpec
+from seldon_core_tpu.graph.client import UnitCallError
+from seldon_core_tpu.graph.spec import (
+    GraphSpecError,
+    default_predictor,
+    validate_deployment,
+    validate_predictor,
+)
+from seldon_core_tpu.user_model import SeldonComponent
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_spec(graph_dict, name="p"):
+    spec = PredictorSpec.from_dict({"name": name, "graph": graph_dict})
+    return default_predictor(spec)
+
+
+REQ = {"data": {"ndarray": [[1.0, 2.0]]}}
+
+
+def test_single_simple_model():
+    ex = GraphExecutor(make_spec({"name": "m", "implementation": "SIMPLE_MODEL"}))
+    out = run(ex.predict(dict(REQ)))
+    assert out["data"]["ndarray"] == [[0.9, 0.05, 0.05]]
+    assert out["data"]["names"] == ["proba_0", "proba_1", "proba_2"]
+    assert out["meta"]["requestPath"] == {"m": "SIMPLE_MODEL"}
+    assert out["meta"]["puid"]
+
+
+def test_puid_propagates():
+    ex = GraphExecutor(make_spec({"name": "m", "implementation": "SIMPLE_MODEL"}))
+    out = run(ex.predict({"meta": {"puid": "fixed"}, **REQ}))
+    assert out["meta"]["puid"] == "fixed"
+
+
+def test_combiner_graph():
+    graph = {
+        "name": "combiner",
+        "implementation": "AVERAGE_COMBINER",
+        "children": [
+            {"name": "m1", "implementation": "SIMPLE_MODEL"},
+            {"name": "m2", "implementation": "SIMPLE_MODEL"},
+        ],
+    }
+    ex = GraphExecutor(make_spec(graph))
+    out = run(ex.predict(dict(REQ)))
+    np.testing.assert_allclose(out["data"]["ndarray"], [[0.9, 0.05, 0.05]])
+    assert set(out["meta"]["requestPath"]) == {"combiner", "m1", "m2"}
+
+
+def test_router_selects_branch_and_records_routing():
+    graph = {
+        "name": "router",
+        "implementation": "SIMPLE_ROUTER",
+        "children": [
+            {"name": "a", "implementation": "SIMPLE_MODEL"},
+            {"name": "b", "implementation": "SIMPLE_MODEL"},
+        ],
+    }
+    ex = GraphExecutor(make_spec(graph))
+    out = run(ex.predict(dict(REQ)))
+    assert out["meta"]["routing"] == {"router": 0}
+    assert "a" in out["meta"]["requestPath"]
+    assert "b" not in out["meta"]["requestPath"]
+
+
+def test_abtest_router_is_seeded_deterministic():
+    graph = {
+        "name": "ab",
+        "implementation": "RANDOM_ABTEST",
+        "parameters": [{"name": "ratio_a", "value": "0.5", "type": "FLOAT"}],
+        "children": [
+            {"name": "a", "implementation": "SIMPLE_MODEL"},
+            {"name": "b", "implementation": "SIMPLE_MODEL"},
+        ],
+    }
+    branches = []
+    ex = GraphExecutor(make_spec(graph))
+    for _ in range(20):
+        out = run(ex.predict(dict(REQ)))
+        branches.append(out["meta"]["routing"]["ab"])
+    assert set(branches) == {0, 1}  # both arms exercised
+    ex2 = GraphExecutor(make_spec(graph))
+    branches2 = [run(ex2.predict(dict(REQ)))["meta"]["routing"]["ab"] for _ in range(20)]
+    assert branches == branches2  # same seed, same sequence
+
+
+class BroadcastRouter(SeldonComponent):
+    def route(self, X, names, meta=None):
+        return -1
+
+
+class Doubler(SeldonComponent):
+    def predict(self, X, names, meta=None):
+        return np.asarray(X) * 2
+
+
+class Tripler(SeldonComponent):
+    def predict(self, X, names, meta=None):
+        return np.asarray(X) * 3
+
+
+def test_router_broadcast_minus_one_requires_combiner_semantics():
+    """-1 fans out to all children; with a combiner above it merges
+    (reference: PredictiveUnitBean.java:145-167)."""
+    graph = {
+        "name": "comb",
+        "implementation": "AVERAGE_COMBINER",
+        "children": [
+            {
+                "name": "r",
+                "type": "ROUTER",
+                "children": [{"name": "d", "type": "MODEL"}],
+            },
+            {"name": "t", "type": "MODEL"},
+        ],
+    }
+    spec = make_spec(graph)
+    ex = GraphExecutor(
+        spec, registry={"r": BroadcastRouter(), "d": Doubler(), "t": Tripler()}
+    )
+    out = run(ex.predict(dict(REQ)))
+    assert out["meta"]["routing"] == {"r": -1}
+    np.testing.assert_allclose(out["data"]["ndarray"], [[2.5, 5.0]])
+
+
+def test_multiple_children_without_combiner_fails():
+    graph = {
+        "name": "m",
+        "type": "MODEL",
+        "children": [
+            {"name": "x", "type": "MODEL"},
+            {"name": "y", "type": "MODEL"},
+        ],
+    }
+    ex = GraphExecutor(
+        make_spec(graph), registry={"m": Doubler(), "x": Doubler(), "y": Doubler()}
+    )
+    with pytest.raises(UnitCallError):
+        run(ex.predict(dict(REQ)))
+
+
+class InputShift(SeldonComponent):
+    def transform_input(self, X, names, meta=None):
+        return np.asarray(X) + 1
+
+
+class OutputNeg(SeldonComponent):
+    def transform_output(self, X, names, meta=None):
+        return -np.asarray(X)
+
+    def tags(self):
+        return {"negated": True}
+
+
+def test_transformer_chain():
+    graph = {
+        "name": "out",
+        "type": "OUTPUT_TRANSFORMER",
+        "children": [
+            {
+                "name": "in",
+                "type": "TRANSFORMER",
+                "children": [{"name": "model", "type": "MODEL"}],
+            }
+        ],
+    }
+    ex = GraphExecutor(
+        make_spec(graph),
+        registry={"in": InputShift(), "model": Doubler(), "out": OutputNeg()},
+    )
+    out = run(ex.predict(dict(REQ)))
+    # (X+1)*2 negated = [[-4, -6]]
+    np.testing.assert_allclose(out["data"]["ndarray"], [[-4.0, -6.0]])
+    assert out["meta"]["tags"]["negated"] is True
+
+
+class RewardRouter(SeldonComponent):
+    def __init__(self):
+        self.seen = []
+
+    def route(self, X, names, meta=None):
+        return 1
+
+    def send_feedback(self, X, names, reward, truth, routing=None):
+        self.seen.append((reward, routing))
+
+
+class RewardModel(SeldonComponent):
+    def __init__(self):
+        self.rewards = []
+
+    def predict(self, X, names, meta=None):
+        return np.asarray(X)
+
+    def send_feedback(self, X, names, reward, truth, routing=None):
+        self.rewards.append(reward)
+
+
+def test_feedback_follows_routing():
+    """Feedback replays only the routed branch
+    (reference: sendFeedbackAsync PredictiveUnitBean.java:204-241)."""
+    router, m_a, m_b = RewardRouter(), RewardModel(), RewardModel()
+    graph = {
+        "name": "r",
+        "type": "ROUTER",
+        "children": [
+            {"name": "a", "type": "MODEL"},
+            {"name": "b", "type": "MODEL"},
+        ],
+    }
+    ex = GraphExecutor(make_spec(graph), registry={"r": router, "a": m_a, "b": m_b})
+    out = run(ex.predict(dict(REQ)))
+    assert out["meta"]["routing"] == {"r": 1}
+    feedback = {
+        "request": dict(REQ),
+        "response": out,
+        "reward": 1.0,
+    }
+    run(ex.send_feedback(feedback))
+    assert router.seen == [(1.0, 1)]
+    assert m_b.rewards == [1.0]
+    assert m_a.rewards == []  # unrouted branch untouched
+
+
+def test_readiness():
+    ex = GraphExecutor(make_spec({"name": "m", "implementation": "SIMPLE_MODEL"}))
+    assert run(ex.ready()) is True
+
+
+# -- spec defaulting/validation (webhook parity) ----------------------------
+
+
+def test_default_allocates_ports_in_walk_order():
+    spec = make_spec(
+        {
+            "name": "a",
+            "type": "MODEL",
+            "children": [{"name": "b", "type": "MODEL"}],
+        }
+    )
+    units = list(spec.graph.walk())
+    assert [u.endpoint.service_port for u in units] == [9000, 9001]
+    assert [u.endpoint.grpc_port for u in units] == [9500, 9501]
+
+
+def test_validate_rejects_duplicate_names():
+    spec = make_spec(
+        {"name": "a", "type": "MODEL", "children": [{"name": "a", "type": "MODEL"}]}
+    )
+    with pytest.raises(GraphSpecError):
+        validate_predictor(spec)
+
+
+def test_validate_rejects_prepackaged_without_uri():
+    spec = make_spec({"name": "m", "implementation": "SKLEARN_SERVER"})
+    with pytest.raises(GraphSpecError, match="modelUri"):
+        validate_predictor(spec)
+
+
+def test_validate_traffic_weights():
+    a = make_spec({"name": "m", "implementation": "SIMPLE_MODEL"}, name="a")
+    b = make_spec({"name": "m", "implementation": "SIMPLE_MODEL"}, name="b")
+    a.traffic, b.traffic = 60, 30
+    with pytest.raises(GraphSpecError, match="traffic"):
+        validate_deployment([a, b])
+    b.traffic = 40
+    validate_deployment([a, b])
+
+
+def test_spec_b64_roundtrip():
+    spec = make_spec({"name": "m", "implementation": "SIMPLE_MODEL"})
+    blob = spec.to_env_b64()
+    back = PredictorSpec.from_env_b64(blob)
+    assert back.graph.name == "m"
+    assert back.graph.endpoint.service_port == 9000

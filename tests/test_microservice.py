@@ -1,0 +1,186 @@
+"""In-process REST + gRPC wrapper tests.
+
+Counterpart of reference python/tests/test_model_microservice.py,
+test_router_microservice.py, test_combiner_microservice.py — tiny user
+objects defined inline, exercised without sockets.
+"""
+
+import numpy as np
+
+from seldon_core_tpu import seldon_methods
+from seldon_core_tpu.metrics import create_counter
+from seldon_core_tpu.microservice import parse_parameters
+from seldon_core_tpu.proto import prediction_pb2 as pb
+from seldon_core_tpu.user_model import SeldonComponent
+from seldon_core_tpu.wrapper import get_rest_microservice
+
+
+class UserObject(SeldonComponent):
+    def predict(self, X, names, meta=None):
+        return np.asarray(X) * 2
+
+    def tags(self):
+        return {"mytag": 1}
+
+    def metrics(self):
+        return [create_counter("mycounter", 1)]
+
+
+class RouterObject(SeldonComponent):
+    def route(self, X, names, meta=None):
+        return 1
+
+
+class CombinerObject(SeldonComponent):
+    def aggregate(self, Xs, names, metas=None):
+        return np.mean([np.asarray(x) for x in Xs], axis=0)
+
+
+class FeedbackObject(SeldonComponent):
+    def __init__(self):
+        self.rewards = []
+
+    def send_feedback(self, X, names, reward, truth, routing=None):
+        self.rewards.append((reward, routing))
+
+
+def test_rest_predict(rest_client):
+    client = rest_client(get_rest_microservice(UserObject()))
+    status, body = client.call("/predict", {"data": {"ndarray": [[1.0, 2.0]]}})
+    assert status == 200
+    assert body["data"]["ndarray"] == [[2.0, 4.0]]
+    assert body["meta"]["tags"] == {"mytag": 1}
+    assert body["meta"]["metrics"][0]["key"] == "mycounter"
+
+
+def test_rest_predict_tensor_encoding_mirrored(rest_client):
+    client = rest_client(get_rest_microservice(UserObject()))
+    status, body = client.call(
+        "/predict", {"data": {"tensor": {"shape": [1, 2], "values": [1.0, 2.0]}}}
+    )
+    assert status == 200
+    assert body["data"]["tensor"] == {"shape": [1, 2], "values": [2.0, 4.0]}
+
+
+def test_rest_predict_get_query(rest_client):
+    client = rest_client(get_rest_microservice(UserObject()))
+    status, body = client.call(
+        "/predict", None, method="GET",
+        query='json={"data":{"ndarray":[[3.0]]}}',
+    )
+    assert status == 200
+    assert body["data"]["ndarray"] == [[6.0]]
+
+
+def test_rest_bad_body_is_400(rest_client):
+    client = rest_client(get_rest_microservice(UserObject()))
+    status, body = client.call("/predict", {"data": {"ndarray": [[1], [2, 3]]}})
+    assert status == 400
+    assert body["status"]["status"] == "FAILURE"
+
+
+def test_rest_route(rest_client):
+    client = rest_client(get_rest_microservice(RouterObject()))
+    status, body = client.call("/route", {"data": {"ndarray": [[1.0]]}})
+    assert status == 200
+    assert body["data"]["ndarray"] == [[1]]
+
+
+def test_rest_aggregate(rest_client):
+    client = rest_client(get_rest_microservice(CombinerObject()))
+    status, body = client.call(
+        "/aggregate",
+        {
+            "seldonMessages": [
+                {"data": {"ndarray": [[2.0]]}},
+                {"data": {"ndarray": [[4.0]]}},
+            ]
+        },
+    )
+    assert status == 200
+    assert body["data"]["ndarray"] == [[3.0]]
+
+
+def test_rest_feedback(rest_client):
+    user = FeedbackObject()
+    client = rest_client(get_rest_microservice(user))
+    status, _ = client.call(
+        "/send-feedback",
+        {
+            "request": {"data": {"ndarray": [[1.0]]}},
+            "response": {"meta": {"routing": {"router": 1}}},
+            "reward": 0.5,
+        },
+    )
+    assert status == 200
+    assert user.rewards == [(0.5, 1)]
+
+
+def test_rest_health_and_pause(rest_client):
+    from seldon_core_tpu.wrapper import ServerState
+
+    state = ServerState()
+    client = rest_client(get_rest_microservice(UserObject(), state))
+    assert client.call("/health/status", None, method="GET")[0] == 200
+    assert client.call("/ready", None, method="GET")[0] == 200
+    assert client.call("/pause", None)[0] == 200
+    assert client.call("/ready", None, method="GET")[0] == 503
+    assert client.call("/predict", {"data": {"ndarray": [[1]]}})[0] == 503
+    assert client.call("/unpause", None)[0] == 200
+    assert client.call("/ready", None, method="GET")[0] == 200
+
+
+def test_grpc_predict_direct():
+    msg = pb.SeldonMessage()
+    msg.data.tensor.shape.extend([1, 2])
+    msg.data.tensor.values.extend([1.0, 2.0])
+    out = seldon_methods.predict(UserObject(), msg)
+    assert isinstance(out, pb.SeldonMessage)
+    assert list(out.data.tensor.values) == [2.0, 4.0]
+    assert out.meta.tags["mytag"].number_value == 1
+
+
+def test_grpc_raw_tensor_predict():
+    arr = np.asarray([[1.0, 2.0]], dtype=np.float32)
+    msg = pb.SeldonMessage()
+    from seldon_core_tpu import payload
+
+    msg.data.CopyFrom(payload.array_to_proto_data(arr, ["a", "b"], "raw"))
+    out = seldon_methods.predict(UserObject(), msg)
+    assert out.data.WhichOneof("data_oneof") == "raw"
+    np.testing.assert_array_equal(
+        payload.raw_to_array(out.data.raw), arr * 2
+    )
+
+
+def test_grpc_aggregate_direct():
+    ml = pb.SeldonMessageList()
+    for v in (2.0, 4.0):
+        m = ml.seldon_messages.add()
+        m.data.ndarray.values.add().list_value.values.add().number_value = v
+    out = seldon_methods.aggregate(CombinerObject(), ml)
+    assert out.data.WhichOneof("data_oneof") == "ndarray"
+
+
+def test_raw_hook_precedence():
+    class RawObject(SeldonComponent):
+        def predict_raw(self, msg):
+            out = pb.SeldonMessage()
+            out.str_data = "raw-was-called"
+            return out
+
+        def predict(self, X, names, meta=None):
+            raise AssertionError("typed hook must not be called")
+
+    out = seldon_methods.predict(RawObject(), {"data": {"ndarray": [[1]]}})
+    assert out["strData"] == "raw-was-called"
+
+
+def test_parse_parameters():
+    params = [
+        {"name": "a", "value": "1", "type": "INT"},
+        {"name": "b", "value": "0.5", "type": "FLOAT"},
+        {"name": "c", "value": "true", "type": "BOOL"},
+        {"name": "d", "value": "x", "type": "STRING"},
+    ]
+    assert parse_parameters(params) == {"a": 1, "b": 0.5, "c": True, "d": "x"}

@@ -1,0 +1,127 @@
+"""Marshaling edge cases (counterpart of reference python/tests/test_utils.py)."""
+
+import base64
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu import payload
+from seldon_core_tpu.proto import prediction_pb2 as pb
+
+
+def test_raw_roundtrip_float32():
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    raw = payload.array_to_raw(arr)
+    out = payload.raw_to_array(raw)
+    np.testing.assert_array_equal(arr, out)
+    assert out.dtype == np.float32
+
+
+def test_raw_roundtrip_bfloat16():
+    import ml_dtypes
+
+    arr = np.asarray([[1.5, -2.0], [0.25, 3.0]], dtype=ml_dtypes.bfloat16)
+    raw = payload.array_to_raw(arr)
+    assert raw.dtype == "bfloat16"
+    out = payload.raw_to_array(raw)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_raw_size_mismatch_rejected():
+    raw = pb.RawTensor(dtype="float32", shape=[2, 2], data=b"\x00" * 15)
+    with pytest.raises(payload.PayloadError):
+        payload.raw_to_array(raw)
+
+
+def test_tensor_roundtrip():
+    arr = np.asarray([[1.0, 2.0], [3.0, 4.0]])
+    t = payload.array_to_tensor(arr)
+    out = payload.tensor_to_array(t)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_tensor_shape_mismatch_rejected():
+    with pytest.raises(payload.PayloadError):
+        payload.tensor_to_array(pb.Tensor(shape=[2, 2], values=[1.0, 2.0]))
+
+
+def test_json_ndarray_extraction():
+    parts = payload.extract_parts_json(
+        {"data": {"names": ["a", "b"], "ndarray": [[1, 2], [3, 4]]}}
+    )
+    assert parts.names == ["a", "b"]
+    assert parts.datadef_type == "ndarray"
+    np.testing.assert_array_equal(parts.array, [[1, 2], [3, 4]])
+
+
+def test_json_raw_extraction():
+    arr = np.asarray([1.0, 2.0, 3.0], dtype=np.float32)
+    body = {
+        "data": {
+            "raw": {
+                "dtype": "float32",
+                "shape": [3],
+                "data": base64.b64encode(arr.tobytes()).decode(),
+            }
+        }
+    }
+    parts = payload.extract_parts_json(body)
+    np.testing.assert_array_equal(parts.array, arr)
+    assert parts.datadef_type == "raw"
+
+
+def test_json_bin_str_jsondata():
+    assert payload.extract_parts_json(
+        {"binData": base64.b64encode(b"xyz").decode()}
+    ).binary == b"xyz"
+    assert payload.extract_parts_json({"strData": "hello"}).string == "hello"
+    assert payload.extract_parts_json({"jsonData": {"k": 1}}).jsondata == {"k": 1}
+
+
+def test_ragged_ndarray_rejected():
+    with pytest.raises(payload.PayloadError):
+        payload.extract_parts_json({"data": {"ndarray": [[1, 2], [3]]}})
+
+
+def test_proto_extraction_and_response_mirroring():
+    msg = pb.SeldonMessage()
+    msg.meta.puid = "p-1"
+    msg.data.names.extend(["x"])
+    msg.data.tensor.shape.extend([2, 1])
+    msg.data.tensor.values.extend([5.0, 6.0])
+    parts = payload.extract_parts_proto(msg)
+    assert parts.meta["puid"] == "p-1"
+    assert parts.datadef_type == "tensor"
+    resp = payload.build_proto_response(parts.array * 2, ["x"], parts.datadef_type, {"puid": "p-1"})
+    assert resp.data.WhichOneof("data_oneof") == "tensor"
+    assert list(resp.data.tensor.values) == [10.0, 12.0]
+    assert resp.meta.puid == "p-1"
+
+
+def test_bfloat16_forced_to_raw_in_json():
+    import ml_dtypes
+
+    arr = np.asarray([1.0, 2.0], dtype=ml_dtypes.bfloat16)
+    out = payload.build_json_response(arr, datadef_type="ndarray")
+    assert "raw" in out["data"]
+    assert out["data"]["raw"]["dtype"] == "bfloat16"
+
+
+def test_json_proto_transcode():
+    body = {
+        "meta": {"puid": "z", "routing": {"r": -1}},
+        "data": {"names": ["a"], "ndarray": [[1.0]]},
+    }
+    msg = payload.json_to_proto(body)
+    assert msg.meta.routing["r"] == -1
+    back = payload.proto_to_json(msg)
+    assert back["meta"]["puid"] == "z"
+
+
+def test_to_device_places_on_jax():
+    import jax
+
+    arr = np.ones((4, 4), dtype=np.float32)
+    dev = payload.to_device(arr, dtype="bfloat16")
+    assert isinstance(dev, jax.Array)
+    assert str(dev.dtype) == "bfloat16"

@@ -1,0 +1,122 @@
+"""Prepackaged server tests: sklearn (iris parity) + jaxserver (mlp family).
+
+Counterpart of the reference's server wiring tests and the sklearn iris
+config in BASELINE.json ("sklearnserver iris SeldonDeployment").
+"""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph import GraphExecutor
+from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
+
+
+@pytest.fixture(scope="module")
+def iris_model_dir(tmp_path_factory):
+    import joblib
+    from sklearn.datasets import load_iris
+    from sklearn.linear_model import LogisticRegression
+
+    d = tmp_path_factory.mktemp("iris")
+    X, y = load_iris(return_X_y=True)
+    clf = LogisticRegression(max_iter=200).fit(X, y)
+    joblib.dump(clf, d / "model.joblib")
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def mlp_model_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("mlp")
+    with open(d / "jax_config.json", "w") as f:
+        json.dump(
+            {
+                "family": "mlp",
+                "config": {"in_features": 4, "hidden": [8], "num_classes": 3, "seed": 0,
+                           "class_names": ["setosa", "versicolor", "virginica"]},
+            },
+            f,
+        )
+    return str(d)
+
+
+def test_sklearn_server_serves_iris(iris_model_dir):
+    spec = default_predictor(
+        PredictorSpec.from_dict(
+            {
+                "name": "iris",
+                "graph": {
+                    "name": "clf",
+                    "implementation": "SKLEARN_SERVER",
+                    "modelUri": iris_model_dir,
+                },
+            }
+        )
+    )
+    ex = GraphExecutor(spec)
+    out = asyncio.run(ex.predict({"data": {"ndarray": [[5.1, 3.5, 1.4, 0.2]]}}))
+    probs = np.asarray(out["data"]["ndarray"])
+    assert probs.shape == (1, 3)
+    np.testing.assert_allclose(probs.sum(), 1.0, atol=1e-6)
+    assert int(np.argmax(probs)) == 0  # setosa
+    assert out["data"]["names"] == ["t:0", "t:1", "t:2"]
+
+
+def test_jaxserver_serves_mlp(mlp_model_dir):
+    spec = default_predictor(
+        PredictorSpec.from_dict(
+            {
+                "name": "jax",
+                "graph": {
+                    "name": "model",
+                    "implementation": "JAX_SERVER",
+                    "modelUri": mlp_model_dir,
+                },
+            }
+        )
+    )
+    ex = GraphExecutor(spec)
+    out = asyncio.run(ex.predict({"data": {"ndarray": [[1.0, 2.0, 3.0, 4.0]]}}))
+    probs = np.asarray(out["data"]["ndarray"])
+    assert probs.shape == (1, 3)
+    np.testing.assert_allclose(probs.sum(), 1.0, atol=1e-3)
+    assert out["data"]["names"] == ["setosa", "versicolor", "virginica"]
+    assert out["meta"]["tags"]["server"] == "jaxserver"
+
+
+def test_jaxserver_checkpoint_roundtrip(tmp_path):
+    """Params saved with orbax are restored bit-exact and change outputs."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    from seldon_core_tpu.models import build
+
+    model = build("mlp", in_features=4, hidden=[8], num_classes=3)
+    params = model.init_params(seed=42)
+    ckpt_dir = tmp_path / "ckpt"
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(str(ckpt_dir), params)
+    with open(tmp_path / "jax_config.json", "w") as f:
+        json.dump(
+            {"family": "mlp", "config": {"in_features": 4, "hidden": [8], "num_classes": 3, "seed": 0},
+             "checkpoint": "ckpt"},
+            f,
+        )
+    from seldon_core_tpu.servers.jaxserver import JAXServer
+
+    srv = JAXServer(model_uri=str(tmp_path))
+    srv.load()
+    x = np.asarray([[1.0, 2.0, 3.0, 4.0]], dtype=np.float32)
+    got = np.asarray(srv.predict(x, []))
+    want = np.asarray(jax.jit(model.apply)(params, x))
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_gated_servers_give_clear_errors(tmp_path):
+    from seldon_core_tpu.servers.xgboostserver import XGBoostServer
+
+    with pytest.raises(RuntimeError, match="xgboost"):
+        XGBoostServer(model_uri=str(tmp_path)).load()
