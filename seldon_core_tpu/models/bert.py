@@ -1,0 +1,152 @@
+"""BERT-base text classifier family.
+
+Serves BASELINE.json's "BERT-base text classifier with input-transformer
+preprocessing graph" config. Post-LN encoder (original BERT), GELU FFN,
+learned position embeddings, [CLS] pooler + classification head. Padding
+mask derived from token id 0. bf16 compute; layers stacked + lax.scan.
+
+TP sharding rule shared with DecoderLM (heads/FFN columns over ``model``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import numpy as np
+
+from .base import ServedModel
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq: int = 512
+    type_vocab: int = 2
+    num_classes: int = 2
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+
+def _layer_norm(x, scale, bias, eps=1e-12):
+    import jax.numpy as jnp
+
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    out = (x32 - mu) * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (out * scale + bias).astype(x.dtype)
+
+
+class BertClassifier(ServedModel):
+    def __init__(self, **config):
+        fields = {f.name for f in dataclasses.fields(BertConfig)}
+        self.cfg = BertConfig(**{k: v for k, v in config.items() if k in fields})
+        self.example_input_shape = (64,)
+        self.compute_dtype = self.cfg.dtype
+
+    def init_params(self, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        D, L, F, V = cfg.d_model, cfg.n_layers, cfg.d_ff, cfg.vocab_size
+        keys = iter(jax.random.split(jax.random.PRNGKey(seed), 32))
+
+        def init(shape, scale=0.02):
+            return jax.random.normal(next(keys), shape, jnp.float32) * scale
+
+        return {
+            "tok_embed": init((V, D)),
+            "pos_embed": init((cfg.max_seq, D)),
+            "type_embed": init((cfg.type_vocab, D)),
+            "embed_ln": {"scale": jnp.ones((D,)), "bias": jnp.zeros((D,))},
+            "blocks": {
+                "wq": init((L, D, D)),
+                "wq_b": jnp.zeros((L, D)),
+                "wk": init((L, D, D)),
+                "wk_b": jnp.zeros((L, D)),
+                "wv": init((L, D, D)),
+                "wv_b": jnp.zeros((L, D)),
+                "wo": init((L, D, D)),
+                "wo_b": jnp.zeros((L, D)),
+                "ln1_scale": jnp.ones((L, D)),
+                "ln1_bias": jnp.zeros((L, D)),
+                "w1": init((L, D, F)),
+                "w1_b": jnp.zeros((L, F)),
+                "w2": init((L, F, D)),
+                "w2_b": jnp.zeros((L, D)),
+                "ln2_scale": jnp.ones((L, D)),
+                "ln2_bias": jnp.zeros((L, D)),
+            },
+            "pooler": {"w": init((D, D)), "b": jnp.zeros((D,))},
+            "classifier": {"w": init((D, cfg.num_classes)), "b": jnp.zeros((cfg.num_classes,))},
+        }
+
+    def apply(self, params, tokens):
+        """tokens [B, T] int32 (0 = PAD) -> class logits [B, num_classes]."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        tokens = tokens.astype(jnp.int32)
+        B, T = tokens.shape
+        mask = (tokens != 0)  # [B, T]
+        x = (
+            params["tok_embed"][tokens]
+            + params["pos_embed"][None, :T]
+            + params["type_embed"][0][None, None]
+        )
+        x = _layer_norm(x.astype(dt), params["embed_ln"]["scale"], params["embed_ln"]["bias"])
+        attn_bias = jnp.where(mask, 0.0, -1e30)[:, None, None, :]  # [B,1,1,T]
+
+        H, Dh = cfg.n_heads, cfg.head_dim
+
+        def block(x, p):
+            h = x
+            q = (h @ p["wq"].astype(dt) + p["wq_b"].astype(dt)).reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
+            k = (h @ p["wk"].astype(dt) + p["wk_b"].astype(dt)).reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
+            v = (h @ p["wv"].astype(dt) + p["wv_b"].astype(dt)).reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+            s = s / np.sqrt(Dh) + attn_bias
+            a = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bhkd->bhqd", a, v.astype(jnp.float32)).astype(dt)
+            o = o.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
+            o = o @ p["wo"].astype(dt) + p["wo_b"].astype(dt)
+            x = _layer_norm(x + o, p["ln1_scale"], p["ln1_bias"])
+            f = jax.nn.gelu(x @ p["w1"].astype(dt) + p["w1_b"].astype(dt))
+            f = f @ p["w2"].astype(dt) + p["w2_b"].astype(dt)
+            return _layer_norm(x + f, p["ln2_scale"], p["ln2_bias"]), None
+
+        x, _ = lax.scan(block, x, params["blocks"])
+        cls = x[:, 0]
+        pooled = jnp.tanh(cls @ params["pooler"]["w"].astype(dt) + params["pooler"]["b"].astype(dt))
+        logits = pooled.astype(jnp.float32) @ params["classifier"]["w"] + params["classifier"]["b"]
+        return logits
+
+    def param_sharding(self, mesh, params):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if "model" not in mesh.axis_names:
+            repl = NamedSharding(mesh, P())
+            return jax.tree_util.tree_map(lambda _: repl, params)
+
+        def spec_for(path, leaf):
+            name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+            if name in ("wq", "wk", "wv", "w1"):
+                return NamedSharding(mesh, P(None, None, "model"))
+            if name in ("wo", "w2"):
+                return NamedSharding(mesh, P(None, "model", None))
+            return NamedSharding(mesh, P())
+
+        return jax.tree_util.tree_map_with_path(spec_for, params)

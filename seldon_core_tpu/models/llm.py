@@ -1,0 +1,441 @@
+"""DecoderLM: llama-style decoder-only transformer (flagship model family).
+
+Serves BASELINE.json's "Llama-2-7B generate() with engine-side dynamic
+batching" config class. Architecture: RMSNorm, rotary embeddings, GQA,
+SwiGLU FFN (optionally Switch-MoE every k-th layer), tied-free unembed.
+Pure param-pytree + functions; layers stacked on a leading axis and
+executed with ``lax.scan`` so XLA compiles one block.
+
+Parallelism (models the scaling-book recipe, fully manual inside
+shard_map — see ``make_train_step``):
+  tp: heads/FFN columns sharded over ``model``; row-parallel mats psum
+  sp: sequence chunks over ``seq`` with ring attention (parallel/ring.py)
+  pp: layer stages over ``stage`` via GPipe ppermute (parallel/pipeline.py)
+  dp: batch over ``data``; gradient psum over (data, seq)
+  ep: experts all_to_all over the combined (data, seq) ranks (parallel/moe.py)
+
+The reference has no counterpart for any of this (SURVEY.md §2: its only
+parallelism is pod replicas / HTTP fan-out); this is the TPU-native
+capability that replaces it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .base import ServedModel
+
+
+@dataclasses.dataclass
+class LLMConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 8
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    d_ff: int = 1408
+    max_seq: int = 2048
+    rope_theta: float = 10000.0
+    # MoE: 0 experts = dense SwiGLU everywhere
+    n_experts: int = 0
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def _rms_norm(x, w, eps=1e-5):
+    import jax.numpy as jnp
+
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jnp.reciprocal(jnp.sqrt(var + eps))).astype(x.dtype) * w
+
+
+def _rope(x, positions, theta: float):
+    """x: [B, H, T, Dh]; positions: [B, T] or [T]."""
+    import jax.numpy as jnp
+
+    dh = x.shape[-1]
+    half = dh // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    if positions.ndim == 1:
+        angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, half]
+        angles = angles[None, None]  # [1,1,T,half]
+    else:
+        angles = positions[:, None, :, None].astype(jnp.float32) * freqs[None, None, None, :]
+    sin, cos = jnp.sin(angles), jnp.cos(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
+
+
+class DecoderLM(ServedModel):
+    def __init__(self, **config):
+        cfg_fields = {f.name for f in dataclasses.fields(LLMConfig)}
+        extra = {k: v for k, v in config.items() if k not in cfg_fields}
+        self.cfg = LLMConfig(**{k: v for k, v in config.items() if k in cfg_fields})
+        self._extra = extra
+        self.example_input_shape = (16,)  # token ids
+        self.compute_dtype = self.cfg.dtype
+
+    # ------------------------------------------------------------------
+    # params
+    # ------------------------------------------------------------------
+
+    def init_params(self, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        k = jax.random.PRNGKey(seed)
+        keys = jax.random.split(k, 16)
+        D, H, KV, Dh, F, L, V = (
+            cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim,
+            cfg.d_ff, cfg.n_layers, cfg.vocab_size,
+        )
+
+        def init(key, shape, scale):
+            return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(jnp.float32)
+
+        s = 1.0 / np.sqrt(D)
+        blocks: Dict[str, Any] = {
+            "ln1": jnp.ones((L, D), jnp.float32),
+            "wq": init(keys[1], (L, D, H * Dh), s),
+            "wk": init(keys[2], (L, D, KV * Dh), s),
+            "wv": init(keys[3], (L, D, KV * Dh), s),
+            "wo": init(keys[4], (L, H * Dh, D), 1.0 / np.sqrt(H * Dh)),
+            "ln2": jnp.ones((L, D), jnp.float32),
+        }
+        if cfg.n_experts > 0:
+            E = cfg.n_experts
+            blocks["router"] = init(keys[5], (L, D, E), s)
+            blocks["w1e"] = init(keys[6], (L, E, D, F), s)
+            blocks["w2e"] = init(keys[7], (L, E, F, D), 1.0 / np.sqrt(F))
+        else:
+            blocks["w1"] = init(keys[5], (L, D, F), s)
+            blocks["w3"] = init(keys[6], (L, D, F), s)
+            blocks["w2"] = init(keys[7], (L, F, D), 1.0 / np.sqrt(F))
+        return {
+            "embed": init(keys[0], (V, D), 1.0),
+            "blocks": blocks,
+            "ln_f": jnp.ones((D,), jnp.float32),
+            "unembed": init(keys[8], (D, V), s),
+        }
+
+    # ------------------------------------------------------------------
+    # forward building blocks (axis-parametrised: None => single chip)
+    # ------------------------------------------------------------------
+
+    def _attention(self, p, x, positions, *, tp_axis=None, sp_axis=None, kv_cache=None):
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..parallel.ring import full_attention, ring_attention
+
+        cfg = self.cfg
+        dt = x.dtype
+        B, T, D = x.shape
+        h = _rms_norm(x, p["ln1"].astype(dt))
+        q = h @ p["wq"].astype(dt)  # [B,T,Hl*Dh] (Hl = local heads under tp)
+        k = h @ p["wk"].astype(dt)
+        v = h @ p["wv"].astype(dt)
+        Hl = q.shape[-1] // cfg.head_dim
+        KVl = k.shape[-1] // cfg.head_dim
+        q = q.reshape(B, T, Hl, cfg.head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(B, T, KVl, cfg.head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(B, T, KVl, cfg.head_dim).transpose(0, 2, 1, 3)
+        # decode passes per-batch positions [B]; lift to [B, T=1] so _rope
+        # takes the batched branch (1-D means "shared [T] positions")
+        rope_pos = positions[:, None] if (kv_cache is not None and positions.ndim == 1) else positions
+        q = _rope(q, rope_pos, cfg.rope_theta)
+        k = _rope(k, rope_pos, cfg.rope_theta)
+        new_cache = None
+        if kv_cache is not None:
+            # decode: append this step's k/v at position `positions`
+            ck, cv, cache_pos = kv_cache
+            ck = lax.dynamic_update_slice(ck, k, (0, 0, cache_pos, 0))
+            cv = lax.dynamic_update_slice(cv, v, (0, 0, cache_pos, 0))
+            k, v = ck, cv
+            new_cache = (ck, cv)
+        if KVl < Hl:  # GQA: repeat kv groups
+            rep = Hl // KVl
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        if kv_cache is not None:
+            # decode attention: q [B,H,1,Dh] over full cache with position mask
+            Tc = k.shape[2]
+            s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+            s = s / np.sqrt(cfg.head_dim)
+            mask = jnp.arange(Tc)[None, None, None, :] <= positions[:, None, None, None]
+            s = jnp.where(mask, s, -1e30)
+            import jax
+
+            o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v.astype(jnp.float32)).astype(dt)
+        elif sp_axis is not None:
+            o = ring_attention(q, k, v, sp_axis, causal=True)
+        else:
+            o = full_attention(q, k, v, causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, Hl * cfg.head_dim)
+        o = o @ p["wo"].astype(dt)  # row-parallel under tp
+        if tp_axis is not None:
+            o = lax.psum(o, tp_axis)
+        return o, new_cache
+
+    def _ffn(self, p, x, *, tp_axis=None, ep_axes=None):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        cfg = self.cfg
+        dt = x.dtype
+        h = _rms_norm(x, p["ln2"].astype(dt))
+        if cfg.n_experts > 0:
+            from ..parallel.moe import moe_ffn
+
+            B, T, D = h.shape
+            out, aux = moe_ffn(
+                h.reshape(B * T, D),
+                p["router"].astype(dt),
+                p["w1e"].astype(dt),
+                p["w2e"].astype(dt),
+                ep_axes,
+                cfg.capacity_factor,
+            )
+            return out.reshape(B, T, D), aux
+        a = h @ p["w1"].astype(dt)
+        g = h @ p["w3"].astype(dt)
+        out = (jax.nn.silu(a) * g) @ p["w2"].astype(dt)
+        if tp_axis is not None:
+            out = lax.psum(out, tp_axis)
+        return out, jnp.float32(0.0)
+
+    def _block(self, p, x, positions, *, tp_axis=None, sp_axis=None, ep_axes=None):
+        attn_out, _ = self._attention(p, x, positions, tp_axis=tp_axis, sp_axis=sp_axis)
+        x = x + attn_out
+        ffn_out, aux = self._ffn(p, x, tp_axis=tp_axis, ep_axes=ep_axes)
+        return x + ffn_out, aux
+
+    def backbone(self, blocks, x, positions, *, tp_axis=None, sp_axis=None, ep_axes=None):
+        """Scan all (local) layers. blocks: leading-axis-stacked params."""
+        from jax import lax
+
+        def body(carry, layer_p):
+            x, aux = carry
+            x, aux_l = self._block(
+                layer_p, x, positions, tp_axis=tp_axis, sp_axis=sp_axis, ep_axes=ep_axes
+            )
+            return (x, aux + aux_l), None
+
+        import jax.numpy as jnp
+
+        from ..parallel.vma import pvary, tree_vma, vma_of
+
+        # The scan carry must vary over every axis the block OUTPUT varies
+        # over: the params' varying axes (e.g. 'stage' for stage-sharded
+        # blocks) minus the tp axis, whose variance both sublayers remove
+        # with their closing psum.
+        need = tree_vma(blocks) - vma_of(x) - {tp_axis}
+        x = pvary(x, tuple(need))
+        aux0 = pvary(jnp.float32(0.0), tuple(vma_of(x)))
+        (x, aux), _ = lax.scan(body, (x, aux0), blocks)
+        return x, aux
+
+    # ------------------------------------------------------------------
+    # single-chip serving forward
+    # ------------------------------------------------------------------
+
+    def apply(self, params, tokens):
+        """tokens [B, T] int32 -> logits [B, T, V] (float32)."""
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        tokens = tokens.astype(jnp.int32)
+        x = params["embed"][tokens].astype(dt)
+        positions = jnp.arange(tokens.shape[1])
+        x, _ = self.backbone(params["blocks"], x, positions)
+        x = _rms_norm(x, params["ln_f"].astype(dt))
+        return (x @ params["unembed"].astype(dt)).astype(jnp.float32)
+
+    # ------------------------------------------------------------------
+    # KV-cache generate (single chip; engine-side continuous batching sits
+    # in front of this via graph/batching.py)
+    # ------------------------------------------------------------------
+
+    def init_cache(self, batch: int, max_seq: Optional[int] = None):
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        T = max_seq or cfg.max_seq
+        shape = (cfg.n_layers, batch, cfg.n_kv_heads, T, cfg.head_dim)
+        dt = jnp.dtype(cfg.dtype)
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    def decode_step(self, params, cache, tokens, pos):
+        """One decode step: tokens [B, 1], pos scalar int. Returns
+        (logits [B, V], updated cache). jit-friendly: static shapes."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        x = params["embed"][tokens.astype(jnp.int32)].astype(dt)  # [B,1,D]
+        positions = jnp.full((tokens.shape[0],), pos, jnp.int32)
+
+        def body(x, inputs):
+            layer_p, ck, cv = inputs
+            attn_out, new_cache = self._attention(
+                layer_p, x, positions, kv_cache=(ck, cv, pos)
+            )
+            x = x + attn_out
+            ffn_out, _ = self._ffn(layer_p, x)
+            return x + ffn_out, new_cache
+
+        x, (nk, nv) = lax.scan(
+            body, x, (params["blocks"], cache["k"], cache["v"])
+        )
+        x = _rms_norm(x, params["ln_f"].astype(dt))
+        logits = (x[:, 0] @ params["unembed"].astype(dt)).astype(jnp.float32)
+        return logits, {"k": nk, "v": nv}
+
+    def prefill(self, params, prompt, max_seq: int):
+        """Batched prefill: ONE forward over the whole prompt, K/V for all
+        positions computed in parallel and written into a fresh cache of
+        length ``max_seq``. Returns (last-position logits [B, V], cache).
+        ~Tp x cheaper time-to-first-token than stepping decode_step."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        B, Tp = prompt.shape
+        x = params["embed"][prompt.astype(jnp.int32)].astype(dt)
+        positions = jnp.arange(Tp)
+
+        def body(x, layer_p):
+            h = _rms_norm(x, layer_p["ln1"].astype(dt))
+            q = h @ layer_p["wq"].astype(dt)
+            k = h @ layer_p["wk"].astype(dt)
+            v = h @ layer_p["wv"].astype(dt)
+            Hl = q.shape[-1] // cfg.head_dim
+            KVl = k.shape[-1] // cfg.head_dim
+            q = q.reshape(B, Tp, Hl, cfg.head_dim).transpose(0, 2, 1, 3)
+            k = k.reshape(B, Tp, KVl, cfg.head_dim).transpose(0, 2, 1, 3)
+            v = v.reshape(B, Tp, KVl, cfg.head_dim).transpose(0, 2, 1, 3)
+            q = _rope(q, positions, cfg.rope_theta)
+            k = _rope(k, positions, cfg.rope_theta)
+            kr, vr = k, v
+            if KVl < Hl:
+                rep = Hl // KVl
+                kr = jnp.repeat(k, rep, axis=1)
+                vr = jnp.repeat(v, rep, axis=1)
+            from ..parallel.ring import full_attention
+
+            o = full_attention(q, kr, vr, causal=True)
+            o = o.transpose(0, 2, 1, 3).reshape(B, Tp, Hl * cfg.head_dim)
+            x = x + o @ layer_p["wo"].astype(dt)
+            ffn_out, _ = self._ffn(layer_p, x)
+            # pad this layer's K/V out to the full cache length
+            pad = max_seq - Tp
+            k_cache = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+            v_cache = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+            return x + ffn_out, (k_cache, v_cache)
+
+        x, (ck, cv) = lax.scan(body, x, params["blocks"])
+        x = _rms_norm(x, params["ln_f"].astype(dt))
+        logits = (x[:, -1] @ params["unembed"].astype(dt)).astype(jnp.float32)
+        return logits, {"k": ck, "v": cv}
+
+    def generate(self, params, prompt, max_new_tokens: int, temperature: float = 0.0, seed: int = 0):
+        """Greedy/temperature sampling. prompt [B, Tp] -> [B, Tp+N]."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        B, Tp = prompt.shape
+        if max_new_tokens <= 0:
+            return prompt
+        total = Tp + max_new_tokens
+        logits, cache = self.prefill(params, prompt, total)
+
+        def sample(logits, key):
+            if temperature <= 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+
+        def decode_body(carry, t):
+            cache, prev_tok, key = carry
+            key, sub = jax.random.split(key)
+            logits, cache = self.decode_step(params, cache, prev_tok[:, None], t)
+            nxt = sample(logits, sub)
+            return (cache, nxt, key), nxt
+
+        first = sample(logits, jax.random.PRNGKey(seed))
+        (_, _, _), toks = lax.scan(
+            decode_body,
+            (cache, first, jax.random.PRNGKey(seed + 1)),
+            jnp.arange(Tp, total - 1),
+        )
+        out = jnp.concatenate(
+            [prompt, first[:, None], toks.T.astype(jnp.int32)], axis=1
+        )
+        return out
+
+    # ------------------------------------------------------------------
+    # loss / train step
+    # ------------------------------------------------------------------
+
+    def loss_fn(self, params, tokens):
+        """Next-token CE (+ MoE load-balancing aux) on a single chip."""
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        inputs = tokens[:, :-1].astype(jnp.int32)
+        x = params["embed"][inputs].astype(dt)
+        x, aux = self.backbone(params["blocks"], x, jnp.arange(inputs.shape[1]))
+        x = _rms_norm(x, params["ln_f"].astype(dt))
+        logits = (x @ params["unembed"].astype(dt)).astype(jnp.float32)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, tokens[:, 1:])
+        return ce.mean() + cfg.aux_loss_weight * aux
+
+    def input_sharding(self, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axis = "data" if "data" in mesh.axis_names else None
+        return NamedSharding(mesh, P(axis, None))
+
+    def param_sharding(self, mesh, params):
+        """TP layout over the ``model`` axis for pjit-style serving."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if "model" not in mesh.axis_names:
+            repl = NamedSharding(mesh, P())
+            return jax.tree_util.tree_map(lambda _: repl, params)
+
+        def spec_for(path, leaf):
+            name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+            col = {"wq", "wk", "wv", "w1", "w3"}
+            row = {"wo", "w2"}
+            nd = leaf.ndim
+            if name in col:
+                return NamedSharding(mesh, P(*([None] * (nd - 1)), "model"))
+            if name in row:
+                return NamedSharding(mesh, P(*([None] * (nd - 2)), "model", None))
+            if name == "w1e":
+                return NamedSharding(mesh, P(None, None, None, "model"))
+            if name == "w2e":
+                return NamedSharding(mesh, P(None, None, "model", None))
+            return NamedSharding(mesh, P())
+
+        return jax.tree_util.tree_map_with_path(spec_for, params)

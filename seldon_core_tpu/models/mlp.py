@@ -20,12 +20,13 @@ class MLP(ServedModel):
         in_features: int = 4,
         hidden: Sequence[int] = (64, 64),
         num_classes: int = 3,
-        seed: int = 0,
-        **_ignored,
+        dtype: str = "bfloat16",
+        **_config_extras,
     ):
         self.in_features = int(in_features)
         self.hidden = tuple(int(h) for h in hidden)
         self.num_classes = int(num_classes)
+        self.compute_dtype = dtype
         self.example_input_shape = (self.in_features,)
 
     def init_params(self, seed: int = 0):

@@ -1,0 +1,131 @@
+"""ResNet-50 (v1.5) image classifier family.
+
+Serves BASELINE.json's "ResNet-50 image classifier (tfserving SavedModel ->
+jaxserver on TPU)" config. Pure-JAX NHWC convs (`lax.conv_general_dilated`
+maps straight onto the MXU), bf16 compute, inference-mode batch norm folded
+into scale/shift (serving-first; fine-tuning swaps in train-mode stats).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from .base import ServedModel
+
+# (blocks, channels) per stage — standard ResNet-50
+STAGES = [(3, 256), (4, 512), (6, 1024), (3, 2048)]
+
+
+class ResNet50(ServedModel):
+    def __init__(self, num_classes: int = 1000, image_size: int = 224,
+                 dtype: str = "bfloat16", **_config_extras):
+        # _config_extras absorbs jax_config.json keys consumed elsewhere
+        # (seed -> init_params, class_names -> JAXServer)
+        self.num_classes = int(num_classes)
+        self.image_size = int(image_size)
+        self.compute_dtype = dtype
+        self.example_input_shape = (image_size, image_size, 3)
+
+    # -- params ---------------------------------------------------------
+
+    def init_params(self, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        key = jax.random.PRNGKey(seed)
+
+        def conv_init(key, shape):  # HWIO
+            fan_in = shape[0] * shape[1] * shape[2]
+            return jax.random.normal(key, shape, jnp.float32) * np.sqrt(2.0 / fan_in)
+
+        def bn_init(c):
+            return {
+                "scale": jnp.ones((c,), jnp.float32),
+                "bias": jnp.zeros((c,), jnp.float32),
+                "mean": jnp.zeros((c,), jnp.float32),
+                "var": jnp.ones((c,), jnp.float32),
+            }
+
+        keys = iter(jax.random.split(key, 256))
+        params: Dict[str, Any] = {
+            "stem": {"conv": conv_init(next(keys), (7, 7, 3, 64)), "bn": bn_init(64)},
+            "stages": [],
+        }
+        c_in = 64
+        for stage_idx, (blocks, c_out) in enumerate(STAGES):
+            stage: List[Dict[str, Any]] = []
+            width = c_out // 4
+            for b in range(blocks):
+                blk = {
+                    "conv1": conv_init(next(keys), (1, 1, c_in, width)),
+                    "bn1": bn_init(width),
+                    "conv2": conv_init(next(keys), (3, 3, width, width)),
+                    "bn2": bn_init(width),
+                    "conv3": conv_init(next(keys), (1, 1, width, c_out)),
+                    "bn3": bn_init(c_out),
+                }
+                if b == 0:
+                    blk["proj"] = conv_init(next(keys), (1, 1, c_in, c_out))
+                    blk["proj_bn"] = bn_init(c_out)
+                stage.append(blk)
+                c_in = c_out
+            params["stages"].append(stage)
+        params["fc"] = {
+            "w": jax.random.normal(next(keys), (2048, self.num_classes), jnp.float32) * 0.01,
+            "b": jnp.zeros((self.num_classes,), jnp.float32),
+        }
+        return params
+
+    # -- forward --------------------------------------------------------
+
+    @staticmethod
+    def _bn(x, bn, dt):
+        import jax.numpy as jnp
+
+        # inference BN folded to one multiply-add (XLA fuses into the conv)
+        inv = jnp.reciprocal(jnp.sqrt(bn["var"] + 1e-5)) * bn["scale"]
+        return x * inv.astype(dt) + (bn["bias"] - bn["mean"] * inv).astype(dt)
+
+    @staticmethod
+    def _conv(x, w, stride, dt):
+        from jax import lax
+
+        return lax.conv_general_dilated(
+            x,
+            w.astype(dt),
+            window_strides=(stride, stride),
+            padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+    def apply(self, params, x):
+        """x [B, H, W, 3] (float; any scale) -> logits [B, classes]."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        dt = jnp.dtype(self.compute_dtype)
+        x = x.astype(dt)
+        x = self._conv(x, params["stem"]["conv"], 2, dt)
+        x = jax.nn.relu(self._bn(x, params["stem"]["bn"], dt))
+        x = lax.reduce_window(
+            x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+        )
+        for stage_idx, stage in enumerate(params["stages"]):
+            for b, blk in enumerate(stage):
+                stride = 2 if (b == 0 and stage_idx > 0) else 1
+                shortcut = x
+                if "proj" in blk:
+                    shortcut = self._bn(
+                        self._conv(x, blk["proj"], stride, dt), blk["proj_bn"], dt
+                    )
+                y = jax.nn.relu(self._bn(self._conv(x, blk["conv1"], 1, dt), blk["bn1"], dt))
+                # v1.5: stride lives on the 3x3
+                y = jax.nn.relu(self._bn(self._conv(y, blk["conv2"], stride, dt), blk["bn2"], dt))
+                y = self._bn(self._conv(y, blk["conv3"], 1, dt), blk["bn3"], dt)
+                x = jax.nn.relu(y + shortcut)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        logits = x.astype(jnp.float32) @ params["fc"]["w"] + params["fc"]["b"]
+        return logits

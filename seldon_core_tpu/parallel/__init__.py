@@ -1,0 +1,20 @@
+"""Mesh parallelism: dp / tp / pp / sp(ring attention) / ep over a Mesh.
+
+The reference's only parallelism was request-level concurrency, K8s replica
+scaling and traffic splitting (SURVEY.md §2: Spring @Async fan-out,
+reference: engine/.../PredictiveUnitBean.java:169-180; HPA replicas,
+reference: operator/controllers/seldondeployment_controller.go:87-109).
+Model sharding did not exist. Here a single served/trained model spans the
+chips of a slice, the scaling-book way: pick a mesh, annotate shardings or
+write the collectives manually in shard_map, let ICI carry the traffic.
+
+Axes (by convention):
+  data  — batch (DP; gradients psum here)
+  stage — pipeline stages (PP; ppermute activation ring)
+  seq   — sequence chunks (SP; ring attention over ppermute)
+  model — attention heads / FFN columns (TP; psum after row-parallel mats)
+  expert parallelism rides the combined (data, seq) axes via all_to_all.
+"""
+
+from .mesh import factor_devices, make_mesh  # noqa: F401
+from .ring import ring_attention  # noqa: F401

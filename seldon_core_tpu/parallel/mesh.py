@@ -1,0 +1,47 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+
+def factor_devices(n: int) -> Dict[str, int]:
+    """Factor n devices into (data, stage, seq, model) prioritising: tp,
+    then pp, then dp, then sp. All five strategies stay *wired* at any n
+    (expert parallelism rides data x seq); axes degrade to 1 when chips run
+    out. 8 chips -> {data:2, stage:2, seq:1, model:2}; 16 -> all 2;
+    32 -> model 4.
+    """
+    axes = {"data": 1, "stage": 1, "seq": 1, "model": 1}
+    order = ["model", "stage", "data", "seq"]
+    i = 0
+    while n > 1:
+        axis = order[i % len(order)]
+        if n % 2 == 0:
+            axes[axis] *= 2
+            n //= 2
+        else:  # odd remainder goes to data
+            axes["data"] *= n
+            n = 1
+        i += 1
+    return axes
+
+
+def make_mesh(shape: Dict[str, int], devices=None):
+    """Build a Mesh with named axes from {axis: size}.
+
+    Axis order follows the dict order; callers should put the slowest-
+    varying (DCN-adjacent) axis first so ICI carries tp/sp collectives.
+    """
+    import jax
+    import numpy as np
+
+    if devices is None:
+        devices = jax.devices()
+    total = 1
+    for s in shape.values():
+        total *= s
+    if total > len(devices):
+        raise ValueError(f"mesh {shape} needs {total} devices, have {len(devices)}")
+    arr = np.asarray(devices[:total]).reshape(tuple(shape.values()))
+    return jax.sharding.Mesh(arr, tuple(shape.keys()))

@@ -1,0 +1,91 @@
+"""Mixture-of-Experts layer with expert parallelism (all_to_all over ICI).
+
+Experts are sharded over the expert axis (by convention the combined
+(data, seq) axes — expert parallelism reuses the data-parallel ranks, the
+standard deployment). Dense dispatch/combine tensors keep everything
+static-shaped for XLA: tokens route top-1 with a capacity buffer, overflow
+drops (standard Switch-style routing).
+
+Inside shard_map: x is the rank-local token slab; the two all_to_alls are
+the only cross-chip traffic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisNames = Union[str, Sequence[str]]
+
+
+def _axis_size(axis_names: AxisNames) -> int:
+    if isinstance(axis_names, str):
+        return lax.axis_size(axis_names)
+    n = 1
+    for a in axis_names:
+        n *= lax.axis_size(a)
+    return n
+
+
+def moe_ffn(
+    x,  # [N, D] rank-local tokens
+    router_w,  # [D, E] replicated
+    w1,  # [E_local, D, F] rank-local experts
+    w2,  # [E_local, F, D]
+    ep_axes: Optional[AxisNames],
+    capacity_factor: float = 1.25,
+):
+    """Top-1 switch MoE. Returns ([N, D] outputs, aux load-balancing loss)."""
+    N, D = x.shape
+    E = router_w.shape[1]
+    ep = _axis_size(ep_axes) if ep_axes else 1
+    e_local = w1.shape[0]
+    assert e_local * ep == E, f"experts {E} != {e_local} x ep {ep}"
+
+    gate_logits = (x @ router_w).astype(jnp.float32)  # [N, E]
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # [N]
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]  # [N]
+
+    # Switch aux loss: E * sum_e(fraction_tokens_e * mean_prob_e)
+    one_hot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [N, E]
+    density = one_hot.mean(0)
+    density_proxy = probs.mean(0)
+    aux_loss = E * jnp.sum(density * density_proxy)
+
+    capacity = max(1, int(capacity_factor * N / E))
+    # position of each token within its expert's buffer
+    pos_in_expert = (jnp.cumsum(one_hot, axis=0) - 1.0) * one_hot  # [N, E]
+    keep = (pos_in_expert < capacity) & (one_hot > 0)
+    pos = jnp.sum(pos_in_expert * one_hot, axis=-1).astype(jnp.int32)  # [N]
+    kept = jnp.any(keep, axis=-1)  # [N]
+
+    # dispatch [N, E, C] one-hot; combine adds the gate weight
+    dispatch = (
+        jax.nn.one_hot(expert_idx, E, dtype=x.dtype)[:, :, None]
+        * jax.nn.one_hot(pos, capacity, dtype=x.dtype)[:, None, :]
+        * kept[:, None, None].astype(x.dtype)
+    )
+    combine = dispatch * gate.astype(x.dtype)[:, None, None]
+
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, x)  # [E, C, D]
+    if ep_axes:
+        # [E, C, D] -> [ep, E_local, C, D]; trade the expert dim for the
+        # rank dim so each rank ends with [E_local, ep*C, D]
+        expert_in = expert_in.reshape(ep, e_local, capacity, D)
+        # tiled=True concatenates received blocks along concat_axis in rank
+        # order (tiled=False would insert a new axis at the wrong position)
+        expert_in = lax.all_to_all(expert_in, ep_axes, split_axis=0, concat_axis=2, tiled=True)
+        expert_in = expert_in.reshape(e_local, ep * capacity, D)
+    h = jnp.einsum("ecd,edf->ecf", expert_in, w1)
+    h = jax.nn.gelu(h)
+    expert_out = jnp.einsum("ecf,efd->ecd", h, w2)  # [E_local, ep*C, D]
+    if ep_axes:
+        expert_out = expert_out.reshape(e_local, ep, capacity, D)
+        expert_out = lax.all_to_all(expert_out, ep_axes, split_axis=1, concat_axis=0, tiled=True)
+        expert_out = expert_out.reshape(ep * e_local, capacity, D)
+    out = jnp.einsum("nec,ecd->nd", combine, expert_out)
+    return out, aux_loss

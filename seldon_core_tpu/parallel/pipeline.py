@@ -1,0 +1,62 @@
+"""GPipe pipeline parallelism inside shard_map.
+
+Stages live on the ranks of the ``stage`` mesh axis; activations flow
+stage->stage over a ``ppermute`` ring while microbatches stream in, giving
+the classic (M + S - 1)-tick schedule. The scan body is uniform (every rank
+computes every tick; injection/collection are masked by rank index) which
+keeps it a single static XLA program — no data-dependent control flow.
+
+Gradients flow backwards through the ppermute chain automatically (its
+transpose is the reverse permutation), so ``jax.grad`` of a loss computed
+from the pipeline output yields the standard GPipe backward schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gpipe(
+    stage_fn: Callable,  # (stage_params, x_mb) -> y_mb
+    stage_params,  # rank-local params pytree for THIS stage
+    x_mb,  # [M, mb, ...] microbatched input (used on stage 0)
+    axis_name: str = "stage",
+):
+    """Run the pipeline; returns [M, mb, ...] outputs (valid on last stage,
+    zeros elsewhere — mask downstream loss by stage)."""
+    S = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    M = x_mb.shape[0]
+    mb_shape = x_mb.shape[1:]
+
+    from .vma import pvary
+
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    # carries derive from x_mb (inheriting its varying axes) plus an
+    # explicit pvary over the stage axis, which they acquire via ppermute
+    state0 = pvary(x_mb[0] * 0, axis_name)
+    outputs0 = pvary(x_mb * 0, axis_name)
+
+    def tick(carry, t):
+        state_prev, outputs = carry
+        mb_idx = jnp.clip(t, 0, M - 1)
+        inject = pvary(
+            lax.dynamic_index_in_dim(x_mb, mb_idx, axis=0, keepdims=False), axis_name
+        )
+        x_in = jnp.where(idx == 0, inject, state_prev)
+        y = stage_fn(stage_params, x_in)
+        out_idx = t - (S - 1)
+        valid = (out_idx >= 0) & (idx == S - 1)
+        updated = lax.dynamic_update_index_in_dim(
+            outputs, y.astype(outputs.dtype), jnp.clip(out_idx, 0, M - 1), axis=0
+        )
+        outputs = jnp.where(valid, updated, outputs)
+        state_next = lax.ppermute(y, axis_name, perm)
+        return (state_next, outputs), None
+
+    (_, outputs), _ = lax.scan(tick, (state0, outputs0), jnp.arange(M + S - 1))
+    return outputs

@@ -1,0 +1,106 @@
+"""Ring attention: sequence-parallel exact attention over an ICI ring.
+
+Long-context design (task requirement; the reference had no sequence axis
+at all — SURVEY.md §5 "long-context: absent"). Each rank of the ``seq``
+mesh axis holds one sequence chunk of Q, K, V. K/V chunks rotate around
+the ring via ``lax.ppermute`` while each rank accumulates its Q-chunk's
+attention with a numerically-stable online softmax (flash-attention style
+running max/denominator), so peak memory stays O(T/n) per chip and the
+DMA of the next chunk overlaps the matmul of the current one (XLA
+schedules the ppermute async).
+
+Causal masking works on global positions: rank r owns rows
+[r*C, (r+1)*C); at ring step s it sees the K/V chunk originally owned by
+rank (r - s) mod n, i.e. columns [(r-s)%n * C, ...). Blocks entirely in
+the future are masked; XLA still executes them (static shapes) but a
+`skip` factor zeroes their contribution.
+
+Call INSIDE shard_map with the sequence axis name; degenerates to plain
+attention when the axis has size 1.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attn(q, k, v, bias, scale):
+    """One (Q-chunk x K-chunk) block: returns (unnormalised out, row max,
+    row denom) for online-softmax accumulation. q:[B,H,Tq,Dh] k/v:[B,H,Tk,Dh]"""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    m = jnp.max(s, axis=-1, keepdims=True)  # [B,H,Tq,1]
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return o, m, l
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True):
+    """Exact attention over a sequence-sharded ring.
+
+    q, k, v: [B, H, C, Dh] local chunks (C = T / ring_size).
+    Returns local [B, H, C, Dh] attention output.
+    """
+    from .vma import pvary
+
+    ring = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    chunk = q.shape[2]
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    # inputs may arrive invariant over the ring axis (e.g. replicated
+    # sequences); the rotating carries are varying by construction
+    q, k, v = (pvary(t, axis_name) for t in (q, k, v))
+    q32 = q.astype(jnp.float32)
+    row_pos = rank * chunk + jnp.arange(chunk)  # global row ids [C]
+
+    def step(carry, s):
+        o_acc, m_acc, l_acc, k_cur, v_cur = carry
+        src_rank = (rank - s) % ring  # owner of the visiting chunk
+        col_pos = src_rank * chunk + jnp.arange(chunk)
+        if causal:
+            mask = row_pos[:, None] >= col_pos[None, :]  # [C, C]
+            bias = jnp.where(mask, 0.0, -1e30)[None, None]
+        else:
+            bias = None
+        o, m, l = _block_attn(q32, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32), bias, scale)
+        # online softmax merge
+        m_new = jnp.maximum(m_acc, m)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m - m_new)
+        o_acc = o_acc * alpha + o * beta
+        l_acc = l_acc * alpha + l * beta
+        # rotate K/V to the next rank (skip the final, unused rotation is
+        # harmless and keeps the scan body uniform)
+        perm = [(i, (i + 1) % ring) for i in range(ring)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o_acc, m_new, l_acc, k_nxt, v_nxt), None
+
+    # initial accumulators derive from q so they inherit its full
+    # varying-axes type (JAX >=0.9 tracks device-variance in avals); bare
+    # jnp.zeros would be axis-invariant and fail the scan carry type check
+    o0 = q32 * 0.0
+    m0 = jnp.sum(o0, axis=-1, keepdims=True) - 1e30
+    l0 = jnp.sum(o0, axis=-1, keepdims=True)
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(ring))
+    out = o / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+def full_attention(q, k, v, causal: bool = True):
+    """Single-chip reference attention (same signature minus the ring)."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if causal:
+        t_q, t_k = q.shape[2], k.shape[2]
+        mask = jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

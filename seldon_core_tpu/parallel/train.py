@@ -1,0 +1,227 @@
+"""Fully-parallel LM train step: dp x pp x sp x tp x ep in one shard_map.
+
+The scaling-book recipe made explicit: one Mesh with axes
+(data, stage, seq, model); parameters arrive pre-sharded (stage-stacked
+blocks over ``stage``, head/FFN columns over ``model``, experts over the
+combined (data, seq) ranks); the body is written rank-locally with manual
+collectives — psum for tensor-parallel row-matmuls, ppermute rings for
+both the GPipe stage loop and ring attention, all_to_all for expert
+dispatch, and a final gradient psum over the replicated axes. ``jax.grad``
+differentiates through every collective (their transposes are collectives
+too), so the backward schedule falls out automatically.
+
+No reference counterpart: SURVEY.md §2 records the reference's only
+scaling axes as pod replicas and HTTP fan-out.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def stack_stages(params: Dict[str, Any], n_stages: int) -> Dict[str, Any]:
+    """Reshape block leaves [L, ...] -> [S, L/S, ...] for stage sharding."""
+    import jax
+
+    def reshape(leaf):
+        L = leaf.shape[0]
+        if L % n_stages:
+            raise ValueError(f"n_layers {L} not divisible by {n_stages} stages")
+        return leaf.reshape(n_stages, L // n_stages, *leaf.shape[1:])
+
+    out = dict(params)
+    out["blocks"] = jax.tree_util.tree_map(reshape, params["blocks"])
+    return out
+
+
+def unstack_stages(params: Dict[str, Any]) -> Dict[str, Any]:
+    import jax
+
+    out = dict(params)
+    out["blocks"] = jax.tree_util.tree_map(
+        lambda leaf: leaf.reshape(leaf.shape[0] * leaf.shape[1], *leaf.shape[2:]),
+        params["blocks"],
+    )
+    return out
+
+
+def param_specs(model, n_stages: int) -> Dict[str, Any]:
+    """PartitionSpecs for stage-stacked params.
+
+    blocks leaves are [S, L/S, ...]: dim0 -> stage; tensor-parallel dims ->
+    model; the expert dim -> the combined (data, seq) ranks.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    col = {"wq", "wk", "wv", "w1", "w3"}  # last dim over model
+    row = {"wo", "w2"}  # second-to-last dim over model
+
+    def block_spec(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        nd = len(leaf.shape)
+        if name in col:
+            return P("stage", *([None] * (nd - 2)), "model")
+        if name in row:
+            return P("stage", *([None] * (nd - 3)), "model", None)
+        if name in ("w1e", "w2e"):  # [S, Ls, E, D, F] / [S, Ls, E, F, D]
+            return P("stage", None, ("data", "seq"), None, None)
+        return P("stage", *([None] * (nd - 1)))  # ln1/ln2/router
+
+    stacked = jax.eval_shape(lambda: stack_stages(model.init_params(0), n_stages))
+    blocks = jax.tree_util.tree_map_with_path(block_spec, stacked["blocks"])
+    from jax.sharding import PartitionSpec as P2
+
+    return {
+        "embed": P2(),
+        "blocks": blocks,
+        "ln_f": P2(),
+        "unembed": P2(),
+    }
+
+
+def make_train_step(
+    model,
+    mesh,
+    n_microbatches: int = 2,
+    learning_rate: float = 1e-2,
+    use_pipeline: Optional[bool] = None,
+):
+    """Build (init_sharded_params, train_step) for a mesh with axes
+    (data, stage, seq, model).
+
+    train_step(params, tokens) -> (params, loss).
+    tokens: [B, T+1] int32, batch sharded over ``data``, REPLICATED over
+    ``seq`` — each seq rank slices its own [T/sp]-chunk plus the next-token
+    targets that spill across the chunk boundary. T must divide by sp;
+    B by dp * n_microbatches.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg = model.cfg
+    S = mesh.shape.get("stage", 1)
+    sp = mesh.shape.get("seq", 1)
+    if use_pipeline is None:
+        use_pipeline = S > 1
+    specs = param_specs(model, S)
+
+    from .pipeline import gpipe
+
+    def local_loss(params, tokens):
+        """Rank-local loss; global mean via psums. tokens [B_local, T+1]
+        (full sequence; seq-replicated)."""
+        dt = jnp.dtype(cfg.dtype)
+        sp_rank = lax.axis_index("seq")
+        T = tokens.shape[1] - 1
+        T_local = T // sp
+        start = sp_rank * T_local
+        inputs = lax.dynamic_slice(tokens, (0, start), (tokens.shape[0], T_local))
+        targets = lax.dynamic_slice(tokens, (0, start + 1), (tokens.shape[0], T_local))
+        positions = start + jnp.arange(T_local)
+
+        x = params["embed"][inputs].astype(dt)  # [B_local, T_local, D]
+
+        # local stage shard arrives as [1, L/S, ...]; drop the unit dim
+        blocks_local = jax.tree_util.tree_map(lambda l: l[0], params["blocks"])
+
+        run_block = partial(
+            model.backbone, tp_axis="model", sp_axis="seq", ep_axes=("data", "seq")
+        )
+        if use_pipeline:
+            B_local = x.shape[0]
+            M = n_microbatches
+            mb = B_local // M
+            x_mb = x.reshape(M, mb, T_local, -1)
+            # KNOWN LIMIT: the MoE aux loss is dropped on the pipelined
+            # path (the GPipe ring carries activations only; bubble ticks
+            # would pollute a scalar side-channel). Router load-balancing
+            # pressure therefore requires stage=1 or aux_loss_weight=0.
+            y_mb = gpipe(
+                lambda sp_params, xx: run_block(sp_params, xx, positions)[0],
+                blocks_local,
+                x_mb,
+                "stage",
+            )
+            y = y_mb.reshape(B_local, T_local, -1)
+            aux = jnp.float32(0.0)
+        else:
+            y, aux = run_block(blocks_local, x, positions)
+            if cfg.n_experts > 0:
+                # aux is a per-rank routing statistic; average over ep ranks
+                aux = lax.pmean(aux, ("data", "seq"))
+
+        from ..models.llm import _rms_norm
+
+        y = _rms_norm(y, params["ln_f"].astype(dt))
+        logits = (y @ params["unembed"].astype(dt)).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ce = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        loss_local = jnp.sum(ce)
+        count_local = jnp.float32(ce.size)
+        loss_sum = lax.psum(loss_local, ("data", "seq"))
+        count = lax.psum(count_local, ("data", "seq"))
+        # only the last stage computed real logits (with S=1 every rank is
+        # the last stage); zero the rest and share across stages — this
+        # also discharges the stage-variance the stacked params introduced
+        is_last = (lax.axis_index("stage") == S - 1).astype(jnp.float32)
+        loss_sum = lax.psum(loss_sum * is_last, "stage")
+        loss = loss_sum / count
+        if not use_pipeline and cfg.n_experts > 0:
+            # discharge aux's stage-variance the same way (S==1 here, so
+            # the mask-psum is the identity on the value)
+            aux = lax.psum(aux * is_last, "stage")
+            loss = loss + cfg.aux_loss_weight * aux
+        return loss
+
+    def step_body(params, tokens):
+        loss, grads = jax.value_and_grad(local_loss)(params, tokens)
+
+        def sync(spec, g):
+            # psum each grad over the axes it actually varies on MINUS the
+            # axes its param is sharded over (those stay per-shard). The
+            # vma type tracks the former exactly; relying on it (instead of
+            # a hand-maintained table) keeps DP/TP/PP grad sync correct
+            # even as the model wiring changes.
+            sharded = set()
+            for entry in spec:
+                if entry is None:
+                    continue
+                sharded.update(entry if isinstance(entry, tuple) else (entry,))
+            axes = tuple(a for a in jax.typeof(g).vma if a not in sharded)
+            return lax.psum(g, axes) if axes else g
+
+        grads = jax.tree_util.tree_map(sync, specs, grads)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: (p - learning_rate * g).astype(p.dtype), params, grads
+        )
+        return new_params, loss
+
+    sharded_step = shard_map(
+        step_body,
+        mesh=mesh,
+        in_specs=(specs, P("data", None)),
+        out_specs=(specs, P()),
+    )
+
+    def to_named(tree_specs):
+        return jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), tree_specs)
+
+    def init_sharded_params(seed: int = 0):
+        host = model.init_params(seed)
+        stacked = stack_stages(host, S)
+        return jax.device_put(stacked, to_named(specs))
+
+    train_step = jax.jit(
+        sharded_step,
+        in_shardings=(to_named(specs), NamedSharding(mesh, P("data", None))),
+        out_shardings=(to_named(specs), NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )
+    return init_sharded_params, train_step

@@ -8,10 +8,16 @@ Must run before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon sitecustomize force-registers the TPU plugin and overrides
+# JAX_PLATFORMS via jax.config at interpreter start; win the fight by
+# updating the config again before any backend is initialized.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio
 import json as _json
