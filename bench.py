@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Headline benchmark: engine REST predictions throughput, stub model.
+
+Mirrors the reference's published benchmark — locust hammering the engine's
+``/api/v0.1/predictions`` with the in-engine SIMPLE_MODEL stub, measuring
+orchestrator + serialization overhead (reference:
+doc/source/reference/benchmarking.md:33-44 — 12,088.95 req/s on a GCP
+n1-standard-16 with a 3-node / 64-worker locust cluster;
+notebooks/benchmark_simple_model.ipynb). Here the native C++ engine and the
+load generator share ONE core of the TPU-VM host: the printed
+``vs_baseline`` is against the reference's 16-core number anyway.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "req/s", "vs_baseline": N, ...}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+REFERENCE_REST_RPS = 12088.95  # reference benchmarking.md:33-44
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main() -> None:
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, repo)
+    from seldon_core_tpu.native_engine import BIN_PATH, build
+
+    build()
+    clients = int(os.environ.get("BENCH_CLIENTS", 32))
+    seconds = float(os.environ.get("BENCH_SECONDS", 5.0))
+    port = free_port()
+    out = subprocess.run(
+        [
+            BIN_PATH, "--port", str(port), "--bench",
+            "--clients", str(clients), "--seconds", str(seconds),
+        ],
+        check=True, capture_output=True, text=True,
+    )
+    stats = json.loads(out.stdout.strip().splitlines()[-1])
+    if stats.get("errors"):
+        raise SystemExit(f"bench had {stats['errors']} errors: {stats}")
+    result = {
+        "metric": "engine REST predictions throughput (stub model, 1 core)",
+        "value": round(stats["rps"], 2),
+        "unit": "req/s",
+        "vs_baseline": round(stats["rps"] / REFERENCE_REST_RPS, 3),
+        "p50_ms": stats["p50_ms"],
+        "p99_ms": stats["p99_ms"],
+        "requests": stats["requests"],
+        "baseline": REFERENCE_REST_RPS,
+        "baseline_source": "reference doc/source/reference/benchmarking.md:33-44 (n1-standard-16)",
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

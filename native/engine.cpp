@@ -1,0 +1,1207 @@
+// seldon_core_tpu native engine: the data-plane request orchestrator.
+//
+// TPU-native counterpart of the reference's Java engine (reference:
+// engine/src/main/java/io/seldon/engine/ — Spring Boot + Tomcat + Netty,
+// recursive @Async graph walk in predictors/PredictiveUnitBean.java:81-197,
+// external REST in api/rest/RestClientController.java:136-291). Rebuilt as
+// a single-binary epoll HTTP/1.1 service: on the single-core hosts that
+// front TPU VMs, a non-blocking C++ loop beats a JVM thread farm by an
+// order of magnitude on the same headline benchmark (stub-model
+// predictions, doc/source/reference/benchmarking.md).
+//
+//   * N event-loop threads (SO_REUSEPORT), keep-alive, pipelining-safe
+//   * in-process builtin units (SIMPLE_MODEL / AVERAGE_COMBINER /
+//     SIMPLE_ROUTER / RANDOM_ABTEST, parity with reference
+//     predictors/SimpleModelUnit.java:33-57 etc.)
+//   * REMOTE units forwarded over keep-alive HTTP (one upstream
+//     connection per loop thread) — e.g. Python/TPU microservices
+//   * meta merge: puid, requestPath, routing, tags
+//     (reference: PredictiveUnitBean.java:354-372)
+//   * /api/v0.1|v1.0/predictions, /ping /live /ready /pause /unpause,
+//     /metrics (Prometheus text)
+//   * --bench mode: in-binary loopback load generator (clients and server
+//     share the process, mirroring the locust setup of
+//     notebooks/benchmark_simple_model.ipynb without a cluster)
+//
+// C ABI for ctypes at the bottom: sce_start / sce_stop / sce_version.
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cassert>
+#include <cctype>
+#include <chrono>
+#include <cmath>
+#include <cstdarg>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// Minimal JSON (subset: obj/arr/str/num/bool/null) — parse in place, fast
+// serialize. The wire schema is small and known; no external deps.
+// ---------------------------------------------------------------------------
+namespace json {
+
+struct Value;
+using Object = std::vector<std::pair<std::string, Value>>;
+using Array = std::vector<Value>;
+
+struct Value {
+  enum Type { Null, Bool, Num, Str, Arr, Obj } type = Null;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::shared_ptr<Array> arr;
+  std::shared_ptr<Object> obj;
+
+  static Value object() { Value v; v.type = Obj; v.obj = std::make_shared<Object>(); return v; }
+  static Value array() { Value v; v.type = Arr; v.arr = std::make_shared<Array>(); return v; }
+  static Value number(double d) { Value v; v.type = Num; v.num = d; return v; }
+  static Value string(std::string s) { Value v; v.type = Str; v.str = std::move(s); return v; }
+
+  const Value* find(const std::string& key) const {
+    if (type != Obj) return nullptr;
+    for (auto& kv : *obj) if (kv.first == key) return &kv.second;
+    return nullptr;
+  }
+  Value& set(const std::string& key, Value v) {
+    if (type != Obj) { type = Obj; obj = std::make_shared<Object>(); }
+    for (auto& kv : *obj) if (kv.first == key) { kv.second = std::move(v); return kv.second; }
+    obj->emplace_back(key, std::move(v));
+    return obj->back().second;
+  }
+};
+
+struct Parser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  explicit Parser(const std::string& s) : p(s.data()), end(s.data() + s.size()) {}
+  void skip() { while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p; }
+
+  Value parse() { skip(); Value v = value(); skip(); if (p != end) ok = false; return v; }
+
+  Value value() {
+    skip();
+    if (p >= end) { ok = false; return {}; }
+    switch (*p) {
+      case '{': return object();
+      case '[': return array();
+      case '"': return strval();
+      case 't': return lit("true", [] { Value v; v.type = Value::Bool; v.b = true; return v; }());
+      case 'f': return lit("false", [] { Value v; v.type = Value::Bool; v.b = false; return v; }());
+      case 'n': return lit("null", Value{});
+      default: return numval();
+    }
+  }
+
+  Value lit(const char* s, Value v) {
+    size_t n = strlen(s);
+    if (size_t(end - p) < n || strncmp(p, s, n) != 0) { ok = false; return {}; }
+    p += n;
+    return v;
+  }
+
+  Value numval() {
+    char* out = nullptr;
+    double d = strtod(p, &out);
+    if (out == p) { ok = false; return {}; }
+    p = out;
+    return Value::number(d);
+  }
+
+  Value strval() {
+    ++p;  // opening quote
+    std::string s;
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        ++p;
+        switch (*p) {
+          case 'n': s += '\n'; break;
+          case 't': s += '\t'; break;
+          case 'r': s += '\r'; break;
+          case 'b': s += '\b'; break;
+          case 'f': s += '\f'; break;
+          case '/': s += '/'; break;
+          case '\\': s += '\\'; break;
+          case '"': s += '"'; break;
+          case 'u': {
+            if (end - p < 5) { ok = false; return {}; }
+            unsigned code = 0;
+            for (int i = 1; i <= 4; i++) {
+              char c = p[i]; code <<= 4;
+              if (c >= '0' && c <= '9') code |= c - '0';
+              else if (c >= 'a' && c <= 'f') code |= c - 'a' + 10;
+              else if (c >= 'A' && c <= 'F') code |= c - 'A' + 10;
+              else { ok = false; return {}; }
+            }
+            p += 4;
+            // UTF-8 encode (BMP only; surrogate pairs passed through raw)
+            if (code < 0x80) s += char(code);
+            else if (code < 0x800) { s += char(0xC0 | (code >> 6)); s += char(0x80 | (code & 0x3F)); }
+            else { s += char(0xE0 | (code >> 12)); s += char(0x80 | ((code >> 6) & 0x3F)); s += char(0x80 | (code & 0x3F)); }
+            break;
+          }
+          default: ok = false; return {};
+        }
+        ++p;
+      } else {
+        s += *p++;
+      }
+    }
+    if (p >= end) { ok = false; return {}; }
+    ++p;  // closing quote
+    return Value::string(std::move(s));
+  }
+
+  Value array() {
+    Value v = Value::array();
+    ++p; skip();
+    if (p < end && *p == ']') { ++p; return v; }
+    while (ok) {
+      v.arr->push_back(value());
+      skip();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == ']') { ++p; break; }
+      ok = false;
+    }
+    return v;
+  }
+
+  Value object() {
+    Value v = Value::object();
+    ++p; skip();
+    if (p < end && *p == '}') { ++p; return v; }
+    while (ok) {
+      skip();
+      if (p >= end || *p != '"') { ok = false; break; }
+      Value key = strval();
+      skip();
+      if (p >= end || *p != ':') { ok = false; break; }
+      ++p;
+      v.obj->emplace_back(std::move(key.str), value());
+      skip();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == '}') { ++p; break; }
+      ok = false;
+    }
+    return v;
+  }
+};
+
+inline void escape_to(const std::string& s, std::string& out) {
+  out += '"';
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if ((unsigned char)c < 0x20) { char buf[8]; snprintf(buf, sizeof buf, "\\u%04x", c); out += buf; }
+        else out += c;
+    }
+  }
+  out += '"';
+}
+
+inline void number_to(double d, std::string& out) {
+  if (std::isfinite(d)) {
+    if (d == (long long)d && std::fabs(d) < 1e15) {
+      char buf[32]; snprintf(buf, sizeof buf, "%lld", (long long)d); out += buf;
+      return;
+    }
+    char buf[32];
+    snprintf(buf, sizeof buf, "%.15g", d);
+    if (strtod(buf, nullptr) != d) snprintf(buf, sizeof buf, "%.17g", d);
+    out += buf;
+  } else {
+    out += "null";
+  }
+}
+
+inline void serialize_to(const Value& v, std::string& out) {
+  switch (v.type) {
+    case Value::Null: out += "null"; break;
+    case Value::Bool: out += v.b ? "true" : "false"; break;
+    case Value::Num: number_to(v.num, out); break;
+    case Value::Str: escape_to(v.str, out); break;
+    case Value::Arr: {
+      out += '[';
+      bool first = true;
+      for (auto& e : *v.arr) { if (!first) out += ','; first = false; serialize_to(e, out); }
+      out += ']';
+      break;
+    }
+    case Value::Obj: {
+      out += '{';
+      bool first = true;
+      for (auto& kv : *v.obj) {
+        if (!first) out += ',';
+        first = false;
+        escape_to(kv.first, out);
+        out += ':';
+        serialize_to(kv.second, out);
+      }
+      out += '}';
+      break;
+    }
+  }
+}
+
+inline std::string serialize(const Value& v) { std::string out; out.reserve(256); serialize_to(v, out); return out; }
+
+}  // namespace json
+
+// ---------------------------------------------------------------------------
+// Graph model
+// ---------------------------------------------------------------------------
+
+struct Unit {
+  std::string name;
+  std::string type;  // MODEL / ROUTER / COMBINER / TRANSFORMER / OUTPUT_TRANSFORMER
+  std::string impl;  // SIMPLE_MODEL / ... / empty
+  std::string host;  // remote host (REST transport)
+  int port = 0;
+  bool remote = false;
+  double ratio_a = 0.5;  // RANDOM_ABTEST
+  std::vector<Unit> children;
+};
+
+static Unit parse_unit(const json::Value& v) {
+  Unit u;
+  if (auto* n = v.find("name")) u.name = n->str;
+  if (auto* t = v.find("type")) u.type = t->str;
+  if (auto* i = v.find("implementation")) u.impl = i->str;
+  if (auto* params = v.find("parameters")) {
+    if (params->type == json::Value::Arr)
+      for (auto& p : *params->arr) {
+        auto* pn = p.find("name");
+        auto* pv = p.find("value");
+        if (pn && pv && pn->str == "ratio_a")
+          u.ratio_a = pv->type == json::Value::Num ? pv->num : strtod(pv->str.c_str(), nullptr);
+      }
+  }
+  if (auto* ep = v.find("endpoint")) {
+    const json::Value* tr = ep->find("transport");
+    const json::Value* host = ep->find("service_host");
+    const json::Value* port = ep->find("service_port");
+    if (tr && (tr->str == "REST" || tr->str == "HTTP")) {
+      u.remote = true;
+      u.host = host ? host->str : "127.0.0.1";
+      u.port = port ? int(port->num) : 9000;
+    }
+  }
+  // infer type from implementation (webhook parity)
+  if (u.type.empty()) {
+    if (u.impl == "SIMPLE_ROUTER" || u.impl == "RANDOM_ABTEST") u.type = "ROUTER";
+    else if (u.impl == "AVERAGE_COMBINER") u.type = "COMBINER";
+    else u.type = "MODEL";
+  }
+  if (auto* ch = v.find("children"))
+    if (ch->type == json::Value::Arr)
+      for (auto& c : *ch->arr) u.children.push_back(parse_unit(c));
+  return u;
+}
+
+// ---------------------------------------------------------------------------
+// Engine
+// ---------------------------------------------------------------------------
+
+struct Metrics {
+  std::atomic<uint64_t> requests{0};
+  std::atomic<uint64_t> errors{0};
+  std::atomic<uint64_t> feedback{0};
+  // latency histogram, microsecond buckets (log2-spaced 1us..~8s)
+  static constexpr int kBuckets = 24;
+  std::atomic<uint64_t> lat[kBuckets]{};
+  std::atomic<uint64_t> lat_sum_us{0};
+
+  void observe_us(uint64_t us) {
+    int b = us == 0 ? 0 : 63 - __builtin_clzll(us);
+    if (b >= kBuckets) b = kBuckets - 1;
+    lat[b].fetch_add(1, std::memory_order_relaxed);
+    lat_sum_us.fetch_add(us, std::memory_order_relaxed);
+  }
+};
+
+struct UpstreamConn {  // per-thread keep-alive connection to one remote unit
+  int fd = -1;
+  std::string host;
+  int port = 0;
+};
+
+struct Engine;
+
+struct RequestCtx {
+  std::string puid;
+  json::Value request_path = json::Value::object();
+  json::Value routing = json::Value::object();
+  json::Value tags = json::Value::object();
+  json::Value metrics_arr = json::Value::array();
+  Engine* engine = nullptr;
+  std::mt19937* rng = nullptr;
+  std::map<std::string, UpstreamConn>* upstreams = nullptr;
+  std::string error;  // non-empty => fail request
+};
+
+struct Engine {
+  Unit root;
+  std::string deployment = "default";
+  std::atomic<bool> paused{false};
+  Metrics metrics;
+  int port = 8000;
+  int threads = 1;
+  std::atomic<bool> stopping{false};
+  std::vector<std::thread> loops;
+  std::vector<int> listen_fds;
+};
+
+// --- builtin units (parity: reference engine/.../predictors/*.java) --------
+
+static json::Value simple_model_predict(const json::Value& msg, int batch) {
+  // static 3-class output (reference: SimpleModelUnit.java:33-57)
+  json::Value data = json::Value::object();
+  json::Value names = json::Value::array();
+  names.arr->push_back(json::Value::string("proba_0"));
+  names.arr->push_back(json::Value::string("proba_1"));
+  names.arr->push_back(json::Value::string("proba_2"));
+  data.set("names", std::move(names));
+  json::Value nd = json::Value::array();
+  for (int i = 0; i < batch; i++) {
+    json::Value row = json::Value::array();
+    row.arr->push_back(json::Value::number(0.9));
+    row.arr->push_back(json::Value::number(0.05));
+    row.arr->push_back(json::Value::number(0.05));
+    nd.arr->push_back(std::move(row));
+  }
+  data.set("ndarray", std::move(nd));
+  json::Value out = json::Value::object();
+  out.set("data", std::move(data));
+  return out;
+}
+
+static int batch_of(const json::Value& msg) {
+  if (auto* data = msg.find("data")) {
+    if (auto* nd = data->find("ndarray"))
+      if (nd->type == json::Value::Arr) return std::max<size_t>(1, nd->arr->size());
+    if (auto* t = data->find("tensor"))
+      if (auto* shape = t->find("shape"))
+        if (shape->type == json::Value::Arr && !shape->arr->empty())
+          return std::max(1, int((*shape->arr)[0].num));
+  }
+  return 1;
+}
+
+// numeric matrix view of a message's data (ndarray or tensor)
+static bool msg_matrix(const json::Value& msg, std::vector<std::vector<double>>& out) {
+  auto* data = msg.find("data");
+  if (!data) return false;
+  if (auto* nd = data->find("ndarray")) {
+    if (nd->type != json::Value::Arr) return false;
+    for (auto& row : *nd->arr) {
+      std::vector<double> r;
+      if (row.type == json::Value::Arr) {
+        for (auto& x : *row.arr) r.push_back(x.num);
+      } else {
+        r.push_back(row.num);
+      }
+      out.push_back(std::move(r));
+    }
+    return true;
+  }
+  if (auto* t = data->find("tensor")) {
+    auto* shape = t->find("shape");
+    auto* values = t->find("values");
+    if (!values || values->type != json::Value::Arr) return false;
+    size_t rows = 1, cols = values->arr->size();
+    if (shape && shape->type == json::Value::Arr && shape->arr->size() >= 2) {
+      rows = size_t((*shape->arr)[0].num);
+      cols = size_t((*shape->arr)[1].num);
+    }
+    size_t idx = 0;
+    for (size_t i = 0; i < rows; i++) {
+      std::vector<double> r;
+      for (size_t j = 0; j < cols && idx < values->arr->size(); j++) r.push_back((*values->arr)[idx++].num);
+      out.push_back(std::move(r));
+    }
+    return true;
+  }
+  return false;
+}
+
+static json::Value matrix_msg(const std::vector<std::vector<double>>& m, const json::Value* names) {
+  json::Value nd = json::Value::array();
+  for (auto& row : m) {
+    json::Value r = json::Value::array();
+    for (double x : row) r.arr->push_back(json::Value::number(x));
+    nd.arr->push_back(std::move(r));
+  }
+  json::Value data = json::Value::object();
+  if (names) data.set("names", *names);
+  data.set("ndarray", std::move(nd));
+  json::Value out = json::Value::object();
+  out.set("data", std::move(data));
+  return out;
+}
+
+// --- remote unit call (keep-alive, blocking on this loop thread) -----------
+
+static int connect_to(const std::string& host, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) { close(fd); return -1; }
+  if (connect(fd, (sockaddr*)&addr, sizeof addr) != 0) { close(fd); return -1; }
+  return fd;
+}
+
+// decode a complete chunked-transfer payload accumulated in `raw`;
+// returns true + decoded body once the terminating 0-chunk has arrived
+static bool decode_chunked(const std::string& raw, std::string& body, bool& complete) {
+  body.clear();
+  size_t pos = 0;
+  for (;;) {
+    size_t line_end = raw.find("\r\n", pos);
+    if (line_end == std::string::npos) { complete = false; return true; }
+    size_t len = strtoul(raw.c_str() + pos, nullptr, 16);
+    pos = line_end + 2;
+    if (len == 0) { complete = true; return true; }
+    if (raw.size() < pos + len + 2) { complete = false; return true; }
+    body.append(raw, pos, len);
+    pos += len + 2;  // chunk + CRLF
+  }
+}
+
+static bool read_http_response(int fd, std::string& body, int& status) {
+  std::string buf;
+  char tmp[16384];
+  size_t header_end = std::string::npos;
+  while (header_end == std::string::npos) {
+    ssize_t n = read(fd, tmp, sizeof tmp);
+    if (n <= 0) return false;
+    buf.append(tmp, n);
+    header_end = buf.find("\r\n\r\n");
+    if (buf.size() > (1u << 26)) return false;
+  }
+  status = 0;
+  if (buf.size() > 12) status = atoi(buf.c_str() + 9);
+  const char* cl = strcasestr(buf.c_str(), "content-length:");
+  const char* te = strcasestr(buf.c_str(), "transfer-encoding:");
+  bool chunked = te && te < buf.c_str() + header_end && strcasestr(te, "chunked") == te + 18 + strspn(te + 18, " \t");
+  if (cl && cl < buf.c_str() + header_end) {
+    size_t content_length = strtoul(cl + 15, nullptr, 10);
+    size_t have = buf.size() - (header_end + 4);
+    body = buf.substr(header_end + 4);
+    while (have < content_length) {
+      ssize_t n = read(fd, tmp, sizeof tmp);
+      if (n <= 0) return false;
+      body.append(tmp, n);
+      have += n;
+    }
+    return true;
+  }
+  if (chunked) {
+    std::string raw = buf.substr(header_end + 4);
+    for (;;) {
+      bool complete = false;
+      if (!decode_chunked(raw, body, complete)) return false;
+      if (complete) return true;
+      ssize_t n = read(fd, tmp, sizeof tmp);
+      if (n <= 0) return false;
+      raw.append(tmp, n);
+      if (raw.size() > (1u << 26)) return false;
+    }
+  }
+  // close-delimited (HTTP/1.0 style): read until EOF
+  body = buf.substr(header_end + 4);
+  for (;;) {
+    ssize_t n = read(fd, tmp, sizeof tmp);
+    if (n < 0) return false;
+    if (n == 0) return true;
+    body.append(tmp, n);
+    if (body.size() > (1u << 26)) return false;
+  }
+}
+
+static json::Value remote_call(RequestCtx& ctx, const Unit& u, const char* path, const json::Value& msg) {
+  std::string key = u.host + ":" + std::to_string(u.port);
+  UpstreamConn& conn = (*ctx.upstreams)[key];
+  std::string body = json::serialize(msg);
+  char head[256];
+  for (int attempt = 0; attempt < 3; attempt++) {  // retry x3 (reference: InternalPredictionService.java:87-91)
+    if (conn.fd < 0) conn.fd = connect_to(u.host, u.port);
+    if (conn.fd < 0) continue;
+    int n = snprintf(head, sizeof head,
+                     "POST %s HTTP/1.1\r\nHost: %s\r\nContent-Type: application/json\r\nContent-Length: %zu\r\n\r\n",
+                     path, u.host.c_str(), body.size());
+    std::string req(head, n);
+    req += body;
+    if (write(conn.fd, req.data(), req.size()) != (ssize_t)req.size()) { close(conn.fd); conn.fd = -1; continue; }
+    std::string resp_body;
+    int status = 0;
+    if (!read_http_response(conn.fd, resp_body, status)) { close(conn.fd); conn.fd = -1; continue; }
+    if (status >= 400) { ctx.error = "unit " + u.name + " returned " + std::to_string(status); return {}; }
+    json::Parser p(resp_body);
+    json::Value out = p.parse();
+    if (!p.ok) { ctx.error = "unit " + u.name + " returned invalid JSON"; return {}; }
+    return out;
+  }
+  ctx.error = "unit " + u.name + " unreachable after 3 tries";
+  return {};
+}
+
+// --- graph walk (parity: reference PredictiveUnitBean.getOutputAsync) ------
+
+static void absorb_meta(RequestCtx& ctx, const json::Value& resp) {
+  if (auto* meta = resp.find("meta")) {
+    if (auto* tags = meta->find("tags"))
+      if (tags->type == json::Value::Obj)
+        for (auto& kv : *tags->obj) ctx.tags.set(kv.first, kv.second);
+    if (auto* ms = meta->find("metrics"))
+      if (ms->type == json::Value::Arr)
+        for (auto& m : *ms->arr) ctx.metrics_arr.arr->push_back(m);
+  }
+}
+
+static json::Value walk(RequestCtx& ctx, const Unit& u, json::Value msg);
+
+static json::Value unit_predict(RequestCtx& ctx, const Unit& u, const json::Value& msg) {
+  if (u.remote) {
+    json::Value out = remote_call(ctx, u, "/predict", msg);
+    if (ctx.error.empty()) absorb_meta(ctx, out);
+    return out;
+  }
+  if (u.impl == "SIMPLE_MODEL") return simple_model_predict(msg, batch_of(msg));
+  ctx.error = "unit " + u.name + " has no implementation and no endpoint";
+  return {};
+}
+
+static int unit_route(RequestCtx& ctx, const Unit& u, const json::Value& msg) {
+  if (u.remote) {
+    json::Value out = remote_call(ctx, u, "/route", msg);
+    if (!ctx.error.empty()) return 0;
+    absorb_meta(ctx, out);
+    std::vector<std::vector<double>> m;
+    if (msg_matrix(out, m) && !m.empty() && !m[0].empty()) return int(m[0][0]);
+    ctx.error = "router " + u.name + " returned no branch tensor";
+    return 0;
+  }
+  if (u.impl == "RANDOM_ABTEST") {
+    std::uniform_real_distribution<double> d(0.0, 1.0);
+    return d(*ctx.rng) < u.ratio_a ? 0 : 1;
+  }
+  return 0;  // SIMPLE_ROUTER (reference: SimpleRouterUnit.java:25-30)
+}
+
+static json::Value unit_aggregate(RequestCtx& ctx, const Unit& u, std::vector<json::Value> outs) {
+  if (u.remote) {
+    json::Value list = json::Value::object();
+    json::Value arr = json::Value::array();
+    for (auto& o : outs) arr.arr->push_back(std::move(o));
+    list.set("seldonMessages", std::move(arr));
+    json::Value out = remote_call(ctx, u, "/aggregate", list);
+    if (ctx.error.empty()) absorb_meta(ctx, out);
+    return out;
+  }
+  // AVERAGE_COMBINER: element-wise mean (reference: AverageCombinerUnit.java:30)
+  std::vector<std::vector<std::vector<double>>> mats(outs.size());
+  for (size_t i = 0; i < outs.size(); i++) {
+    if (!msg_matrix(outs[i], mats[i])) { ctx.error = "combiner input " + std::to_string(i) + " has no tensor data"; return {}; }
+    if (mats[i].size() != mats[0].size() || (mats[i].size() && mats[i][0].size() != mats[0][0].size())) {
+      ctx.error = "combiner inputs disagree on shape";
+      return {};
+    }
+  }
+  auto avg = mats[0];
+  for (size_t m = 1; m < mats.size(); m++)
+    for (size_t i = 0; i < avg.size(); i++)
+      for (size_t j = 0; j < avg[i].size(); j++) avg[i][j] += mats[m][i][j];
+  for (auto& row : avg)
+    for (auto& x : row) x /= double(mats.size());
+  const json::Value* names = nullptr;
+  if (auto* d0 = outs[0].find("data")) names = d0->find("names");
+  return matrix_msg(avg, names);
+}
+
+static json::Value walk(RequestCtx& ctx, const Unit& u, json::Value msg) {
+  ctx.request_path.set(u.name, json::Value::string(u.impl.empty() ? u.name : u.impl));
+
+  // 1. input transform
+  if (u.type == "MODEL") {
+    msg = unit_predict(ctx, u, msg);
+    if (!ctx.error.empty()) return {};
+  } else if (u.type == "TRANSFORMER") {
+    if (u.remote) {
+      msg = remote_call(ctx, u, "/transform-input", msg);
+      if (!ctx.error.empty()) return {};
+      absorb_meta(ctx, msg);
+    }
+  }
+
+  // 2/3. routing + children
+  if (!u.children.empty()) {
+    std::vector<const Unit*> selected;
+    if (u.type == "ROUTER") {
+      int branch = unit_route(ctx, u, msg);
+      if (!ctx.error.empty()) return {};
+      if (branch >= int(u.children.size()) || branch < -1) {
+        ctx.error = "router " + u.name + " chose branch " + std::to_string(branch);
+        return {};
+      }
+      ctx.routing.set(u.name, json::Value::number(branch));
+      if (branch == -1)
+        for (auto& c : u.children) selected.push_back(&c);
+      else
+        selected.push_back(&u.children[branch]);
+    } else {
+      for (auto& c : u.children) selected.push_back(&c);
+    }
+    std::vector<json::Value> outs;
+    outs.reserve(selected.size());
+    for (auto* c : selected) {
+      outs.push_back(walk(ctx, *c, msg));
+      if (!ctx.error.empty()) return {};
+    }
+    if (u.type == "COMBINER") {
+      msg = unit_aggregate(ctx, u, std::move(outs));
+      if (!ctx.error.empty()) return {};
+    } else if (outs.size() == 1) {
+      msg = std::move(outs[0]);
+    } else {
+      ctx.error = "unit " + u.name + " has multiple child outputs but is no combiner";
+      return {};
+    }
+  }
+
+  // 5. output transform
+  if (u.type == "OUTPUT_TRANSFORMER" && u.remote) {
+    msg = remote_call(ctx, u, "/transform-output", msg);
+    if (!ctx.error.empty()) return {};
+    absorb_meta(ctx, msg);
+  }
+  return msg;
+}
+
+// ---------------------------------------------------------------------------
+// HTTP server (epoll, keep-alive)
+// ---------------------------------------------------------------------------
+
+struct Conn {
+  int fd = -1;
+  std::string in;
+  std::string out;
+  size_t out_off = 0;
+  // incremental parse state: where the CRLFCRLF search left off and, once
+  // headers are parsed, the total byte count of the pending request —
+  // avoids O(n^2) rescans of large bodies arriving in many chunks
+  size_t scan_off = 0;
+  size_t need_total = 0;  // 0 = headers not yet parsed
+  bool close_after_flush = false;
+  bool want_epollout = false;
+};
+
+static std::atomic<uint64_t> g_puid_counter{1};
+// process entropy for puids — separate from the seeded routing rng so A/B
+// splits stay deterministic while puids stay unique across restarts
+static const uint64_t g_puid_entropy = [] {
+  std::random_device rd;
+  return (uint64_t(rd()) << 32) ^ rd() ^ (uint64_t)getpid();
+}();
+
+static std::string gen_puid(std::mt19937&) {
+  char buf[48];
+  uint64_t c = g_puid_counter.fetch_add(1, std::memory_order_relaxed);
+  snprintf(buf, sizeof buf, "%llx-%llx", (unsigned long long)g_puid_entropy,
+           (unsigned long long)c);
+  return buf;
+}
+
+static void http_response(std::string& out, int status, const std::string& body,
+                          const char* ctype = "application/json") {
+  const char* reason = status == 200 ? "OK" : status == 400 ? "Bad Request" : status == 404 ? "Not Found"
+                       : status == 503 ? "Service Unavailable" : "Internal Server Error";
+  char head[256];
+  int n = snprintf(head, sizeof head,
+                   "HTTP/1.1 %d %s\r\nContent-Type: %s\r\nContent-Length: %zu\r\nConnection: keep-alive\r\n\r\n",
+                   status, reason, ctype, body.size());
+  out.append(head, n);
+  out += body;
+}
+
+static std::string error_json(int code, const std::string& info) {
+  json::Value v = json::Value::object();
+  json::Value status = json::Value::object();
+  status.set("code", json::Value::number(code));
+  status.set("info", json::Value::string(info));
+  status.set("status", json::Value::string("FAILURE"));
+  v.set("status", std::move(status));
+  return json::serialize(v);
+}
+
+static void handle_predictions(Engine& eng, RequestCtx& ctx, const std::string& body, std::string& out) {
+  auto t0 = std::chrono::steady_clock::now();
+  json::Parser parser(body);
+  json::Value msg = parser.parse();
+  if (!parser.ok || msg.type != json::Value::Obj) {
+    eng.metrics.errors.fetch_add(1, std::memory_order_relaxed);
+    http_response(out, 400, error_json(400, "invalid JSON body"));
+    return;
+  }
+  // puid (reference: PredictionService.PuidGenerator:77)
+  if (auto* meta = msg.find("meta"))
+    if (auto* p = meta->find("puid")) ctx.puid = p->str;
+  if (ctx.puid.empty()) ctx.puid = gen_puid(*ctx.rng);
+  if (auto* meta = msg.find("meta"))
+    if (auto* tags = meta->find("tags"))
+      if (tags->type == json::Value::Obj)
+        for (auto& kv : *tags->obj) ctx.tags.set(kv.first, kv.second);
+
+  json::Value result = walk(ctx, eng.root, std::move(msg));
+  if (!ctx.error.empty()) {
+    eng.metrics.errors.fetch_add(1, std::memory_order_relaxed);
+    http_response(out, 503, error_json(503, ctx.error));
+    return;
+  }
+  json::Value meta = json::Value::object();
+  meta.set("puid", json::Value::string(ctx.puid));
+  if (!ctx.tags.obj->empty()) meta.set("tags", std::move(ctx.tags));
+  if (!ctx.metrics_arr.arr->empty()) meta.set("metrics", std::move(ctx.metrics_arr));
+  if (!ctx.routing.obj->empty()) meta.set("routing", std::move(ctx.routing));
+  meta.set("requestPath", std::move(ctx.request_path));
+  result.set("meta", std::move(meta));
+
+  http_response(out, 200, json::serialize(result));
+  eng.metrics.requests.fetch_add(1, std::memory_order_relaxed);
+  auto us = std::chrono::duration_cast<std::chrono::microseconds>(std::chrono::steady_clock::now() - t0).count();
+  eng.metrics.observe_us(uint64_t(us));
+}
+
+static std::string prometheus_text(Engine& eng) {
+  std::string s;
+  char buf[160];
+  // deployment name is user-controlled; build labeled lines in std::string
+  // so long names can't truncate the exposition format
+  s += "# TYPE seldon_api_engine_server_requests counter\nseldon_api_engine_server_requests{deployment=\"";
+  s += eng.deployment;
+  s += "\"} " + std::to_string(eng.metrics.requests.load()) + "\n";
+  s += "# TYPE seldon_api_engine_server_errors counter\nseldon_api_engine_server_errors{deployment=\"";
+  s += eng.deployment;
+  s += "\"} " + std::to_string(eng.metrics.errors.load()) + "\n";
+  s += "# TYPE seldon_api_engine_server_requests_seconds histogram\n";
+  uint64_t cum = 0;
+  for (int b = 0; b < Metrics::kBuckets; b++) {
+    cum += eng.metrics.lat[b].load();
+    double le = std::pow(2.0, b + 1) / 1e6;
+    snprintf(buf, sizeof buf, "seldon_api_engine_server_requests_seconds_bucket{le=\"%g\"} %llu\n", le, (unsigned long long)cum);
+    s += buf;
+  }
+  snprintf(buf, sizeof buf, "seldon_api_engine_server_requests_seconds_bucket{le=\"+Inf\"} %llu\n", (unsigned long long)cum);
+  s += buf;
+  snprintf(buf, sizeof buf, "seldon_api_engine_server_requests_seconds_sum %g\n", eng.metrics.lat_sum_us.load() / 1e6);
+  s += buf;
+  snprintf(buf, sizeof buf, "seldon_api_engine_server_requests_seconds_count %llu\n", (unsigned long long)cum);
+  s += buf;
+  return s;
+}
+
+// returns false if the connection should close
+static bool process_buffer(Engine& eng, Conn& c, std::mt19937& rng,
+                           std::map<std::string, UpstreamConn>& upstreams) {
+  for (;;) {
+    size_t header_end;
+    if (c.need_total == 0) {
+      // resume the CRLFCRLF search where the previous chunk left off
+      size_t start = c.scan_off > 3 ? c.scan_off - 3 : 0;
+      header_end = c.in.find("\r\n\r\n", start);
+      c.scan_off = c.in.size();
+      if (header_end == std::string::npos) {
+        if (c.in.size() > (1u << 20)) { http_response(c.out, 400, error_json(400, "headers too large")); return false; }
+        return true;
+      }
+      size_t content_length = 0;
+      {
+        const char* cl = strcasestr(c.in.c_str(), "content-length:");
+        if (cl && cl < c.in.c_str() + header_end) content_length = strtoul(cl + 15, nullptr, 10);
+      }
+      if (content_length > (1u << 26)) { http_response(c.out, 400, error_json(400, "body too large")); return false; }
+      c.need_total = header_end + 4 + content_length;
+    }
+    if (c.in.size() < c.need_total) return true;  // need more bytes
+    header_end = c.in.find("\r\n\r\n");
+
+    // request line
+    size_t sp1 = c.in.find(' ');
+    size_t sp2 = c.in.find(' ', sp1 + 1);
+    if (sp1 == std::string::npos || sp2 == std::string::npos || sp1 > header_end) {
+      http_response(c.out, 400, error_json(400, "bad request line"));
+      return false;
+    }
+    std::string method = c.in.substr(0, sp1);
+    std::string path = c.in.substr(sp1 + 1, sp2 - sp1 - 1);
+    size_t q = path.find('?');
+    if (q != std::string::npos) path.resize(q);
+
+    std::string body = c.in.substr(header_end + 4, c.need_total - header_end - 4);
+    c.in.erase(0, c.need_total);
+    c.need_total = 0;
+    c.scan_off = 0;
+
+    if (path == "/api/v0.1/predictions" || path == "/api/v1.0/predictions" || path == "/predict") {
+      if (eng.paused.load(std::memory_order_relaxed)) {
+        http_response(c.out, 503, error_json(503, "paused"));
+      } else {
+        RequestCtx ctx;
+        ctx.engine = &eng;
+        ctx.rng = &rng;
+        ctx.upstreams = &upstreams;
+        handle_predictions(eng, ctx, body, c.out);
+      }
+    } else if (path == "/ping") {
+      http_response(c.out, 200, "pong", "text/plain");
+    } else if (path == "/live") {
+      http_response(c.out, 200, "{\"status\":\"ok\"}");
+    } else if (path == "/ready") {
+      if (eng.paused.load()) http_response(c.out, 503, error_json(503, "not ready"));
+      else http_response(c.out, 200, "{\"status\":\"ok\"}");
+    } else if (path == "/pause") {
+      eng.paused.store(true);
+      http_response(c.out, 200, "{\"status\":\"paused\"}");
+    } else if (path == "/unpause") {
+      eng.paused.store(false);
+      http_response(c.out, 200, "{\"status\":\"ok\"}");
+    } else if (path == "/metrics" || path == "/prometheus") {
+      http_response(c.out, 200, prometheus_text(eng), "text/plain; version=0.0.4");
+    } else {
+      http_response(c.out, 404, error_json(404, "no route " + path));
+    }
+  }
+}
+
+static int make_listener(int port) {
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (bind(fd, (sockaddr*)&addr, sizeof addr) != 0) { close(fd); return -1; }
+  if (listen(fd, 1024) != 0) { close(fd); return -1; }
+  return fd;
+}
+
+static void event_loop(Engine* eng, int listen_fd, unsigned seed) {
+  int ep = epoll_create1(0);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = listen_fd;
+  epoll_ctl(ep, EPOLL_CTL_ADD, listen_fd, &ev);
+  std::map<int, Conn> conns;
+  std::mt19937 rng(seed);
+  std::map<std::string, UpstreamConn> upstreams;
+  std::vector<epoll_event> events(256);
+  char buf[65536];
+
+  while (!eng->stopping.load(std::memory_order_relaxed)) {
+    int n = epoll_wait(ep, events.data(), events.size(), 100);
+    for (int i = 0; i < n; i++) {
+      int fd = events[i].data.fd;
+      if (fd == listen_fd) {
+        for (;;) {
+          int cfd = accept4(listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
+          if (cfd < 0) break;
+          int one = 1;
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+          epoll_event cev{};
+          cev.events = EPOLLIN;
+          cev.data.fd = cfd;
+          epoll_ctl(ep, EPOLL_CTL_ADD, cfd, &cev);
+          conns[cfd].fd = cfd;
+        }
+        continue;
+      }
+      auto it = conns.find(fd);
+      if (it == conns.end()) continue;
+      Conn& c = it->second;
+      bool closing = false;
+      if (events[i].events & (EPOLLERR | EPOLLHUP)) {
+        close(fd);
+        conns.erase(it);
+        continue;
+      }
+      if (events[i].events & EPOLLIN) {
+        for (;;) {
+          ssize_t r = read(fd, buf, sizeof buf);
+          if (r > 0) c.in.append(buf, r);
+          else if (r == 0) { closing = true; break; }
+          else {
+            if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) closing = true;
+            break;
+          }
+        }
+        if (!closing && !process_buffer(*eng, c, rng, upstreams)) c.close_after_flush = true;
+      }
+      // flush output; on short write, arm EPOLLOUT so the kernel wakes us
+      // when the send buffer drains (a waiting HTTP client sends nothing
+      // more, so EPOLLIN alone would stall the response forever)
+      while (c.out_off < c.out.size()) {
+        ssize_t w = write(fd, c.out.data() + c.out_off, c.out.size() - c.out_off);
+        if (w > 0) c.out_off += w;
+        else {
+          if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) closing = true;
+          break;
+        }
+      }
+      bool flushed = c.out_off >= c.out.size();
+      if (flushed) { c.out.clear(); c.out_off = 0; }
+      bool need_out = !flushed && !closing;
+      if (need_out != c.want_epollout) {
+        c.want_epollout = need_out;
+        epoll_event mev{};
+        mev.events = EPOLLIN | (need_out ? EPOLLOUT : 0);
+        mev.data.fd = fd;
+        epoll_ctl(ep, EPOLL_CTL_MOD, fd, &mev);
+      }
+      if (closing || (flushed && c.close_after_flush)) {
+        close(fd);
+        conns.erase(it);
+      }
+    }
+  }
+  for (auto& kv : conns) close(kv.first);
+  for (auto& kv : upstreams)
+    if (kv.second.fd >= 0) close(kv.second.fd);
+  close(ep);
+}
+
+static void engine_stop(Engine* eng);
+
+static Engine* engine_start(const std::string& spec_json, int port, int threads) {
+  json::Parser p(spec_json);
+  json::Value spec = p.parse();
+  if (!p.ok) return nullptr;
+  auto* eng = new Engine();
+  if (auto* name = spec.find("name")) eng->deployment = name->str;
+  const json::Value* graph = spec.find("graph");
+  if (!graph) { delete eng; return nullptr; }
+  eng->root = parse_unit(*graph);
+  eng->port = port;
+  eng->threads = threads;
+  for (int t = 0; t < threads; t++) {
+    int lfd = make_listener(port);
+    if (lfd < 0) {
+      // unwind: already-spawned loops still reference *eng — stop and join
+      // them before freeing (a raw delete here would UAF + std::terminate)
+      engine_stop(eng);
+      return nullptr;
+    }
+    eng->listen_fds.push_back(lfd);
+    eng->loops.emplace_back(event_loop, eng, lfd, 1337u + t);
+  }
+  return eng;
+}
+
+static void engine_stop(Engine* eng) {
+  eng->stopping.store(true);
+  for (auto& t : eng->loops) t.join();
+  for (int fd : eng->listen_fds) close(fd);
+  delete eng;
+}
+
+// ---------------------------------------------------------------------------
+// C ABI (ctypes)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* sce_start(const char* spec_json, int port, int threads) {
+  signal(SIGPIPE, SIG_IGN);
+  return engine_start(spec_json, port, threads <= 0 ? 1 : threads);
+}
+
+void sce_stop(void* handle) {
+  if (handle) engine_stop(static_cast<Engine*>(handle));
+}
+
+const char* sce_version() { return "seldon-tpu-engine/0.1.0"; }
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Standalone binary: serve or bench
+// ---------------------------------------------------------------------------
+
+#ifndef SCE_SHARED_ONLY
+
+struct BenchClient {
+  int fd = -1;
+  std::string out;
+  size_t out_off = 0;
+  std::string in;
+  uint64_t inflight = 0;
+  std::chrono::steady_clock::time_point sent_at;
+};
+
+// loopback load generator: C concurrent keep-alive connections, one
+// outstanding request each (closed-loop, like locust users)
+static void run_bench(int port, int clients, double seconds, const std::string& payload) {
+  std::string request;
+  {
+    char head[256];
+    int n = snprintf(head, sizeof head,
+                     "POST /api/v0.1/predictions HTTP/1.1\r\nHost: 127.0.0.1\r\nContent-Type: application/json\r\nContent-Length: %zu\r\n\r\n",
+                     payload.size());
+    request.assign(head, n);
+    request += payload;
+  }
+  int ep = epoll_create1(0);
+  std::map<int, BenchClient> conns;
+  for (int i = 0; i < clients; i++) {
+    int fd = connect_to("127.0.0.1", port);
+    if (fd < 0) { fprintf(stderr, "bench: connect failed\n"); exit(1); }
+    fcntl(fd, F_SETFL, O_NONBLOCK);
+    epoll_event ev{};
+    ev.events = EPOLLIN | EPOLLOUT;
+    ev.data.fd = fd;
+    epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ev);
+    BenchClient& c = conns[fd];
+    c.fd = fd;
+    c.out = request;
+    c.sent_at = std::chrono::steady_clock::now();
+  }
+  uint64_t done = 0, errors = 0;
+  std::vector<uint64_t> lat_us;
+  lat_us.reserve(1 << 20);
+  auto t_start = std::chrono::steady_clock::now();
+  auto deadline = t_start + std::chrono::duration<double>(seconds);
+  std::vector<epoll_event> events(256);
+  char buf[65536];
+  while (std::chrono::steady_clock::now() < deadline) {
+    int n = epoll_wait(ep, events.data(), events.size(), 50);
+    for (int i = 0; i < n; i++) {
+      int fd = events[i].data.fd;
+      BenchClient& c = conns[fd];
+      if (events[i].events & EPOLLOUT) {
+        while (c.out_off < c.out.size()) {
+          ssize_t w = write(fd, c.out.data() + c.out_off, c.out.size() - c.out_off);
+          if (w > 0) c.out_off += w;
+          else break;
+        }
+        if (c.out_off >= c.out.size()) {
+          epoll_event ev{};
+          ev.events = EPOLLIN;
+          ev.data.fd = fd;
+          epoll_ctl(ep, EPOLL_CTL_MOD, fd, &ev);
+        }
+      }
+      if (events[i].events & EPOLLIN) {
+        for (;;) {
+          ssize_t r = read(fd, buf, sizeof buf);
+          if (r > 0) c.in.append(buf, r);
+          else break;
+        }
+        // complete response?
+        size_t he = c.in.find("\r\n\r\n");
+        if (he != std::string::npos) {
+          const char* cl = strcasestr(c.in.c_str(), "content-length:");
+          size_t len = cl ? strtoul(cl + 15, nullptr, 10) : 0;
+          if (c.in.size() >= he + 4 + len) {
+            int status = atoi(c.in.c_str() + 9);
+            if (status != 200) errors++;
+            auto now = std::chrono::steady_clock::now();
+            lat_us.push_back(std::chrono::duration_cast<std::chrono::microseconds>(now - c.sent_at).count());
+            done++;
+            c.in.erase(0, he + 4 + len);
+            // fire next request
+            c.out = request;
+            c.out_off = 0;
+            c.sent_at = now;
+            epoll_event ev{};
+            ev.events = EPOLLIN | EPOLLOUT;
+            ev.data.fd = fd;
+            epoll_ctl(ep, EPOLL_CTL_MOD, fd, &ev);
+          }
+        }
+      }
+    }
+  }
+  double elapsed = std::chrono::duration<double>(std::chrono::steady_clock::now() - t_start).count();
+  std::sort(lat_us.begin(), lat_us.end());
+  auto pct = [&](double q) -> double {
+    if (lat_us.empty()) return 0;
+    size_t idx = std::min(lat_us.size() - 1, size_t(q * lat_us.size()));
+    return lat_us[idx] / 1000.0;  // ms
+  };
+  printf("{\"requests\": %llu, \"errors\": %llu, \"seconds\": %.3f, \"rps\": %.2f, "
+         "\"p50_ms\": %.3f, \"p90_ms\": %.3f, \"p99_ms\": %.3f}\n",
+         (unsigned long long)done, (unsigned long long)errors, elapsed, done / elapsed,
+         pct(0.50), pct(0.90), pct(0.99));
+  for (auto& kv : conns) close(kv.first);
+  close(ep);
+}
+
+int main(int argc, char** argv) {
+  signal(SIGPIPE, SIG_IGN);
+  std::string spec_json = R"({"name":"bench","graph":{"name":"stub","implementation":"SIMPLE_MODEL"}})";
+  int port = 8000;
+  int threads = 1;
+  bool bench = false;
+  int clients = 16;
+  double seconds = 5.0;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() -> const char* { return i + 1 < argc ? argv[++i] : ""; };
+    if (a == "--spec-file") {
+      FILE* f = fopen(next(), "rb");
+      if (!f) { fprintf(stderr, "cannot open spec file\n"); return 1; }
+      spec_json.clear();
+      char buf[4096];
+      size_t r;
+      while ((r = fread(buf, 1, sizeof buf, f)) > 0) spec_json.append(buf, r);
+      fclose(f);
+    } else if (a == "--spec") spec_json = next();
+    else if (a == "--port") port = atoi(next());
+    else if (a == "--threads") threads = atoi(next());
+    else if (a == "--bench") bench = true;
+    else if (a == "--clients") clients = atoi(next());
+    else if (a == "--seconds") seconds = atof(next());
+    else { fprintf(stderr, "unknown arg %s\n", a.c_str()); return 1; }
+  }
+  Engine* eng = engine_start(spec_json, port, threads);
+  if (!eng) { fprintf(stderr, "bad spec\n"); return 1; }
+  fprintf(stderr, "seldon-tpu-engine listening on :%d (%d threads)\n", port, threads);
+  if (bench) {
+    // payload mirrors the reference benchmark notebook's request
+    std::string payload = R"({"data":{"names":["a","b","c","d","e"],"tensor":{"shape":[1,5],"values":[1.0,2.0,3.0,4.0,5.0]}}})";
+    run_bench(port, clients, seconds, payload);
+    engine_stop(eng);
+    return 0;
+  }
+  for (;;) pause();
+  return 0;
+}
+
+#endif  // SCE_SHARED_ONLY

@@ -1,0 +1,82 @@
+"""ctypes bindings for the native C++ engine (native/engine.cpp).
+
+The native engine is the production data plane: an epoll HTTP/1.1
+orchestrator serving inference graphs with in-process builtin units and
+keep-alive forwarding to remote (e.g. Python/TPU microservice) units. The
+Python EngineApp (graph/service.py) remains the full-featured reference
+implementation (gRPC front, micro-batching, request logging); this wrapper
+lets Python deployments run the C++ data plane in-process.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+from typing import Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libseldon_engine.so")
+BIN_PATH = os.path.join(_NATIVE_DIR, "build", "seldon-tpu-engine")
+
+
+def build(force: bool = False) -> str:
+    """Build the native engine via make; returns the shared-lib path."""
+    if force or not (os.path.exists(LIB_PATH) and os.path.exists(BIN_PATH)):
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True, capture_output=True)
+    return LIB_PATH
+
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(build())
+        lib.sce_start.restype = ctypes.c_void_p
+        lib.sce_start.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        lib.sce_stop.argtypes = [ctypes.c_void_p]
+        lib.sce_version.restype = ctypes.c_char_p
+        _lib = lib
+    return _lib
+
+
+def version() -> str:
+    return _load().sce_version().decode()
+
+
+class NativeEngine:
+    """In-process native engine bound to a predictor spec.
+
+    >>> eng = NativeEngine(spec_dict, port=8000)
+    >>> eng.start()
+    ... # serve; e.g. curl :8000/api/v0.1/predictions
+    >>> eng.stop()
+    """
+
+    def __init__(self, spec, port: int = 8000, threads: int = 1):
+        self.spec = spec.to_dict() if hasattr(spec, "to_dict") else spec
+        self.port = port
+        self.threads = threads
+        self._handle: Optional[int] = None
+
+    def start(self) -> "NativeEngine":
+        lib = _load()
+        blob = json.dumps(self.spec).encode()
+        self._handle = lib.sce_start(blob, self.port, self.threads)
+        if not self._handle:
+            raise RuntimeError(f"native engine failed to start on :{self.port} (bad spec or bind failure)")
+        return self
+
+    def stop(self) -> None:
+        if self._handle:
+            _load().sce_stop(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
