@@ -1,0 +1,13 @@
+"""Reference graph components: bandit routers and outlier detectors.
+
+Counterpart of the reference's ``components/`` tree
+(components/routers/, components/outlier-detection/ — SURVEY.md §2 #37-38),
+re-designed around functional, checkpointable state so every stateful
+component can be snapshotted by :mod:`seldon_core_tpu.persistence`.
+"""
+
+from seldon_core_tpu.components.routers import (  # noqa: F401
+    BanditState,
+    EpsilonGreedy,
+    ThompsonSampling,
+)

@@ -1,0 +1,139 @@
+"""Bandit router tests (reference: components/routers/{epsilon-greedy,
+thompson-sampling}, case study components/routers/case_study)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.components.routers import (
+    BanditState,
+    EpsilonGreedy,
+    ThompsonSampling,
+)
+from seldon_core_tpu.graph import GraphExecutor, PredictorSpec
+from seldon_core_tpu.graph.spec import default_predictor
+from seldon_core_tpu.user_model import SeldonComponent
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+X4 = np.zeros((4, 2))  # 4-row batch
+
+
+def test_epsilon_greedy_requires_n_branches():
+    with pytest.raises(TypeError):
+        EpsilonGreedy()
+    with pytest.raises(ValueError):
+        EpsilonGreedy(n_branches=0)
+
+
+def test_epsilon_greedy_exploit_vs_explore():
+    r = EpsilonGreedy(n_branches=3, epsilon=0.0, best_branch=1, seed=0)
+    assert all(r.route(X4, []) == 1 for _ in range(20))
+    r = EpsilonGreedy(n_branches=3, epsilon=1.0, best_branch=1, seed=0)
+    assert all(r.route(X4, []) != 1 for _ in range(20))
+
+
+def test_epsilon_greedy_feedback_updates_best():
+    r = EpsilonGreedy(n_branches=2, epsilon=0.0, best_branch=0, seed=0)
+    # arm 1 gets perfect reward on a 4-row batch, arm 0 gets zero
+    r.send_feedback(X4, [], reward=1.0, truth=None, routing=1)
+    r.send_feedback(X4, [], reward=0.0, truth=None, routing=0)
+    assert r.state.best_branch == 1
+    assert r.state.success.tolist() == [0.0, 4.0]
+    assert r.state.tries.tolist() == [4.0, 4.0]
+    assert r.route(X4, []) == 1
+
+
+def test_fractional_reward_counts():
+    r = ThompsonSampling(n_branches=2, seed=0)
+    # mean reward 0.75 over 4 rows -> 3 successes, 1 failure
+    r.send_feedback(X4, [], reward=0.75, truth=None, routing=0)
+    assert r.state.success[0] == 3.0 and r.state.tries[0] == 4.0
+
+
+def test_thompson_converges_to_better_arm():
+    r = ThompsonSampling(n_branches=2, seed=42)
+    rng = np.random.default_rng(0)
+    for _ in range(300):
+        arm = r.route(X4, [])
+        p = 0.8 if arm == 1 else 0.2
+        reward = rng.binomial(4, p) / 4.0
+        r.send_feedback(X4, [], reward=reward, truth=None, routing=arm)
+    counts = np.bincount(
+        [r.route(X4, []) for _ in range(100)], minlength=2
+    )
+    assert counts[1] > 80
+    assert r.tags()["best_branch"] == int(np.argmax(r.state.values))
+    assert len(r.metrics()) == 2
+
+
+def test_state_dict_roundtrip():
+    r = EpsilonGreedy(n_branches=3, seed=1)
+    r.send_feedback(X4, [], reward=0.5, truth=None, routing=2)
+    d = r.to_state_dict()
+    r2 = EpsilonGreedy(n_branches=3, seed=1)
+    r2.from_state_dict(d)
+    assert r2.state.success.tolist() == r.state.success.tolist()
+    assert r2.state.best_branch == r.state.best_branch
+
+
+def test_branch_names_in_tags():
+    r = EpsilonGreedy(n_branches=2, best_branch=1, branch_names="a:b", seed=0)
+    assert r.tags() == {"best_branch": "b"}
+
+
+class _FixedModel(SeldonComponent):
+    """Stub model whose 'accuracy' drives the bandit's reward."""
+
+    def __init__(self, accuracy: float):
+        self.accuracy = accuracy
+
+    def predict(self, X, names, meta=None):
+        return np.full((np.asarray(X).shape[0], 1), self.accuracy)
+
+
+def test_mab_feedback_loop_through_graph():
+    """Case-study equivalent: route via Thompson sampling over two models,
+    replay rewards through the engine's feedback path, converge to the
+    better model (reference: §3.5 feedback path, components/routers/case_study)."""
+    graph = {
+        "name": "router",
+        "type": "ROUTER",
+        "children": [
+            {"name": "bad", "type": "MODEL"},
+            {"name": "good", "type": "MODEL"},
+        ],
+    }
+    spec = default_predictor(PredictorSpec.from_dict({"name": "p", "graph": graph}))
+    router = ThompsonSampling(n_branches=2, seed=7)
+    ex = GraphExecutor(
+        spec,
+        registry={
+            "router": router,
+            "bad": _FixedModel(0.1),
+            "good": _FixedModel(0.9),
+        },
+    )
+    rng = np.random.default_rng(1)
+
+    async def loop():
+        req = {"data": {"ndarray": [[1.0, 2.0]] * 4}}
+        for _ in range(200):
+            resp = await ex.predict(dict(req))
+            branch = resp["meta"]["routing"]["router"]
+            acc = resp["data"]["ndarray"][0][0]
+            reward = rng.binomial(4, acc) / 4.0
+            await ex.send_feedback(
+                {"request": req, "response": resp, "reward": reward}
+            )
+        return resp
+
+    run(loop())
+    assert router.state.best_branch == 1
+    assert router.state.tries.sum() == 200 * 4
+    # the better arm should have drawn most of the traffic
+    assert router.state.tries[1] > router.state.tries[0]
