@@ -26,9 +26,11 @@
 // C ABI for ctypes at the bottom: sce_start / sce_stop / sce_version.
 
 #include <arpa/inet.h>
+#include <cerrno>
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <signal.h>
 #include <sys/epoll.h>
 #include <sys/socket.h>
@@ -223,7 +225,8 @@ inline void escape_to(const std::string& s, std::string& out) {
 
 inline void number_to(double d, std::string& out) {
   if (std::isfinite(d)) {
-    if (d == (long long)d && std::fabs(d) < 1e15) {
+    // range guard BEFORE the cast: double->long long outside range is UB
+    if (std::fabs(d) < 1e15 && d == (long long)d) {
       char buf[32]; snprintf(buf, sizeof buf, "%lld", (long long)d); out += buf;
       return;
     }
@@ -403,8 +406,16 @@ static int batch_of(const json::Value& msg) {
       if (nd->type == json::Value::Arr) return std::max<size_t>(1, nd->arr->size());
     if (auto* t = data->find("tensor"))
       if (auto* shape = t->find("shape"))
-        if (shape->type == json::Value::Arr && !shape->arr->empty())
-          return std::max(1, int((*shape->arr)[0].num));
+        if (shape->type == json::Value::Arr && !shape->arr->empty()) {
+          // shape is client-supplied: clamp to what the values array can
+          // actually back so a tiny request can't fabricate a huge batch
+          double want = (*shape->arr)[0].num;
+          size_t have = 1;
+          if (auto* values = t->find("values"))
+            if (values->type == json::Value::Arr) have = std::max<size_t>(1, values->arr->size());
+          if (!(want >= 1)) return 1;
+          return int(std::min(want, double(have)));
+        }
   }
   return 1;
 }
@@ -432,8 +443,14 @@ static bool msg_matrix(const json::Value& msg, std::vector<std::vector<double>>&
     if (!values || values->type != json::Value::Arr) return false;
     size_t rows = 1, cols = values->arr->size();
     if (shape && shape->type == json::Value::Arr && shape->arr->size() >= 2) {
-      rows = size_t((*shape->arr)[0].num);
-      cols = size_t((*shape->arr)[1].num);
+      double r = (*shape->arr)[0].num, c = (*shape->arr)[1].num;
+      if (!(r >= 1) || !(c >= 1)) return false;  // rejects negatives and NaN
+      // client-supplied shape must exactly match the values it claims to
+      // describe — rejecting (-> 4xx/5xx upstream) both guards the
+      // multi-GB-allocation DoS and avoids silently reshaping data
+      if (r * c != double(values->arr->size())) return false;
+      rows = size_t(r);
+      cols = size_t(c);
     }
     size_t idx = 0;
     for (size_t i = 0; i < rows; i++) {
@@ -463,16 +480,41 @@ static json::Value matrix_msg(const std::vector<std::vector<double>>& m, const j
 
 // --- remote unit call (keep-alive, blocking on this loop thread) -----------
 
+// Upstream I/O deadline. The reference gives every internal hop a
+// configurable timeout (InternalPredictionService.java:87-91); without one a
+// single hung microservice would stall this event-loop thread forever
+// (including /live + /ready served from it) and make engine_stop unjoinable.
+static int upstream_timeout_ms() {
+  static int ms = [] {
+    const char* e = getenv("SELDON_ENGINE_UPSTREAM_TIMEOUT_MS");
+    int v = e ? atoi(e) : 0;
+    return v > 0 ? v : 10000;
+  }();
+  return ms;
+}
+
 static int connect_to(const std::string& host, int port) {
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  timeval tv{upstream_timeout_ms() / 1000, (upstream_timeout_ms() % 1000) * 1000};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(port);
   if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) { close(fd); return -1; }
-  if (connect(fd, (sockaddr*)&addr, sizeof addr) != 0) { close(fd); return -1; }
+  // bounded connect: non-blocking + poll, then back to blocking-with-deadline
+  fcntl(fd, F_SETFL, O_NONBLOCK);
+  int rc = connect(fd, (sockaddr*)&addr, sizeof addr);
+  if (rc != 0 && errno == EINPROGRESS) {
+    pollfd pfd{fd, POLLOUT, 0};
+    if (poll(&pfd, 1, upstream_timeout_ms()) != 1) { close(fd); return -1; }
+    int err = 0; socklen_t len = sizeof err;
+    if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len) != 0 || err != 0) { close(fd); return -1; }
+  } else if (rc != 0) { close(fd); return -1; }
+  fcntl(fd, F_SETFL, fcntl(fd, F_GETFL) & ~O_NONBLOCK);
   return fd;
 }
 
@@ -486,20 +528,33 @@ static bool decode_chunked(const std::string& raw, std::string& body, bool& comp
     if (line_end == std::string::npos) { complete = false; return true; }
     size_t len = strtoul(raw.c_str() + pos, nullptr, 16);
     pos = line_end + 2;
-    if (len == 0) { complete = true; return true; }
+    if (len == 0) {
+      // consume trailers + the final CRLF — leaving them unread would
+      // desync the next response on this keep-alive connection
+      for (;;) {
+        size_t te = raw.find("\r\n", pos);
+        if (te == std::string::npos) { complete = false; return true; }
+        if (te == pos) { complete = true; return true; }  // empty line
+        pos = te + 2;  // skip a trailer header line
+      }
+    }
     if (raw.size() < pos + len + 2) { complete = false; return true; }
     body.append(raw, pos, len);
     pos += len + 2;  // chunk + CRLF
   }
 }
 
-static bool read_http_response(int fd, std::string& body, int& status) {
+using Deadline = std::chrono::steady_clock::time_point;
+
+static bool past(const Deadline& d) { return std::chrono::steady_clock::now() >= d; }
+
+static bool read_http_response(int fd, std::string& body, int& status, const Deadline& deadline) {
   std::string buf;
   char tmp[16384];
   size_t header_end = std::string::npos;
   while (header_end == std::string::npos) {
     ssize_t n = read(fd, tmp, sizeof tmp);
-    if (n <= 0) return false;
+    if (n <= 0 || past(deadline)) return false;  // deadline bounds a trickling upstream
     buf.append(tmp, n);
     header_end = buf.find("\r\n\r\n");
     if (buf.size() > (1u << 26)) return false;
@@ -515,7 +570,7 @@ static bool read_http_response(int fd, std::string& body, int& status) {
     body = buf.substr(header_end + 4);
     while (have < content_length) {
       ssize_t n = read(fd, tmp, sizeof tmp);
-      if (n <= 0) return false;
+      if (n <= 0 || past(deadline)) return false;
       body.append(tmp, n);
       have += n;
     }
@@ -528,7 +583,7 @@ static bool read_http_response(int fd, std::string& body, int& status) {
       if (!decode_chunked(raw, body, complete)) return false;
       if (complete) return true;
       ssize_t n = read(fd, tmp, sizeof tmp);
-      if (n <= 0) return false;
+      if (n <= 0 || past(deadline)) return false;
       raw.append(tmp, n);
       if (raw.size() > (1u << 26)) return false;
     }
@@ -537,7 +592,7 @@ static bool read_http_response(int fd, std::string& body, int& status) {
   body = buf.substr(header_end + 4);
   for (;;) {
     ssize_t n = read(fd, tmp, sizeof tmp);
-    if (n < 0) return false;
+    if (n < 0 || past(deadline)) return false;
     if (n == 0) return true;
     body.append(tmp, n);
     if (body.size() > (1u << 26)) return false;
@@ -549,7 +604,13 @@ static json::Value remote_call(RequestCtx& ctx, const Unit& u, const char* path,
   UpstreamConn& conn = (*ctx.upstreams)[key];
   std::string body = json::serialize(msg);
   char head[256];
-  for (int attempt = 0; attempt < 3; attempt++) {  // retry x3 (reference: InternalPredictionService.java:87-91)
+  // one deadline for the WHOLE hop (connect + 3 retries + reads) so a dead
+  // or trickling upstream can't stack per-attempt timeouts into a 30s+
+  // event-loop stall (reference applies its timeout per hop, not per try:
+  // InternalPredictionService.java:87-91)
+  const Deadline deadline =
+      std::chrono::steady_clock::now() + std::chrono::milliseconds(upstream_timeout_ms());
+  for (int attempt = 0; attempt < 3 && !past(deadline); attempt++) {
     if (conn.fd < 0) conn.fd = connect_to(u.host, u.port);
     if (conn.fd < 0) continue;
     int n = snprintf(head, sizeof head,
@@ -560,7 +621,7 @@ static json::Value remote_call(RequestCtx& ctx, const Unit& u, const char* path,
     if (write(conn.fd, req.data(), req.size()) != (ssize_t)req.size()) { close(conn.fd); conn.fd = -1; continue; }
     std::string resp_body;
     int status = 0;
-    if (!read_http_response(conn.fd, resp_body, status)) { close(conn.fd); conn.fd = -1; continue; }
+    if (!read_http_response(conn.fd, resp_body, status, deadline)) { close(conn.fd); conn.fd = -1; continue; }
     if (status >= 400) { ctx.error = "unit " + u.name + " returned " + std::to_string(status); return {}; }
     json::Parser p(resp_body);
     json::Value out = p.parse();
@@ -628,10 +689,11 @@ static json::Value unit_aggregate(RequestCtx& ctx, const Unit& u, std::vector<js
   std::vector<std::vector<std::vector<double>>> mats(outs.size());
   for (size_t i = 0; i < outs.size(); i++) {
     if (!msg_matrix(outs[i], mats[i])) { ctx.error = "combiner input " + std::to_string(i) + " has no tensor data"; return {}; }
-    if (mats[i].size() != mats[0].size() || (mats[i].size() && mats[i][0].size() != mats[0][0].size())) {
-      ctx.error = "combiner inputs disagree on shape";
-      return {};
-    }
+    if (mats[i].size() != mats[0].size()) { ctx.error = "combiner inputs disagree on shape"; return {}; }
+    // every row, not just row 0 — ragged ndarrays must not reach the
+    // accumulation loop's mats[m][i][j] indexing
+    for (size_t r = 0; r < mats[i].size(); r++)
+      if (mats[i][r].size() != mats[0][r].size()) { ctx.error = "combiner inputs disagree on shape"; return {}; }
   }
   auto avg = mats[0];
   for (size_t m = 1; m < mats.size(); m++)
@@ -797,16 +859,30 @@ static void handle_predictions(Engine& eng, RequestCtx& ctx, const std::string& 
   eng.metrics.observe_us(uint64_t(us));
 }
 
+// Prometheus label values need \\, \" and newline escaped or one odd
+// deployment name corrupts the whole exposition page
+static std::string prom_label_escape(const std::string& v) {
+  std::string out;
+  for (char c : v) {
+    if (c == '\\') out += "\\\\";
+    else if (c == '"') out += "\\\"";
+    else if (c == '\n') out += "\\n";
+    else out += c;
+  }
+  return out;
+}
+
 static std::string prometheus_text(Engine& eng) {
   std::string s;
   char buf[160];
   // deployment name is user-controlled; build labeled lines in std::string
   // so long names can't truncate the exposition format
+  const std::string dep = prom_label_escape(eng.deployment);
   s += "# TYPE seldon_api_engine_server_requests counter\nseldon_api_engine_server_requests{deployment=\"";
-  s += eng.deployment;
+  s += dep;
   s += "\"} " + std::to_string(eng.metrics.requests.load()) + "\n";
   s += "# TYPE seldon_api_engine_server_errors counter\nseldon_api_engine_server_errors{deployment=\"";
-  s += eng.deployment;
+  s += dep;
   s += "\"} " + std::to_string(eng.metrics.errors.load()) + "\n";
   s += "# TYPE seldon_api_engine_server_requests_seconds histogram\n";
   uint64_t cum = 0;

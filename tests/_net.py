@@ -1,0 +1,80 @@
+"""Socket helpers shared by the socket-level tests.
+
+A plain module (not conftest) so it stays importable under
+``--import-mode=importlib``; bench.py keeps its own free_port copy so it
+runs standalone.
+"""
+
+import json
+import socket
+import threading
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class FixedResponseServer:
+    """Minimal HTTP server that answers every POST with one fixed JSON body.
+
+    Stands in for a remote microservice when a test needs a response the
+    builtin units can't produce (e.g. ragged ndarrays)."""
+
+    def __init__(self, body: dict):
+        self.raw = json.dumps(body).encode()
+        self.port = free_port()
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", self.port))
+        self._srv.listen(8)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            buf = b""
+            while not self._stop.is_set():
+                while b"\r\n\r\n" not in buf:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                head, _, rest = buf.partition(b"\r\n\r\n")
+                clen = 0
+                for line in head.split(b"\r\n"):
+                    if line.lower().startswith(b"content-length:"):
+                        clen = int(line.split(b":")[1])
+                while len(rest) < clen:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    rest += chunk
+                buf = rest[clen:]
+                conn.sendall(
+                    b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                    b"Content-Length: " + str(len(self.raw)).encode() + b"\r\n\r\n" + self.raw
+                )
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._srv.close()

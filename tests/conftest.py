@@ -46,3 +46,9 @@ class RestTestClient:
 @pytest.fixture
 def rest_client():
     return RestTestClient
+
+
+# make tests/ importable as top-level modules (``from _net import ...``)
+# under any pytest import mode
+import sys as _sys
+_sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

@@ -22,12 +22,7 @@ class Doubler(SeldonComponent):
         return np.asarray(X) * 2
 
 
-def free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from _net import free_port  # noqa: E402
 
 
 @pytest.fixture

@@ -19,12 +19,7 @@ from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
 from seldon_core_tpu.native_engine import NativeEngine, build, version
 
 
-def free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from _net import free_port  # noqa: E402
 
 
 def post(port, path, body, timeout=10):
@@ -230,3 +225,80 @@ def test_python_engine_parity_on_same_graph(built):
         _, native_out = post(port, "/api/v0.1/predictions", dict(req))
     np.testing.assert_allclose(native_out["data"]["ndarray"], py_out["data"]["ndarray"])
     assert set(native_out["meta"]["requestPath"]) == set(py_out["meta"]["requestPath"])
+
+
+def test_hostile_tensor_shape_is_clamped(built):
+    """A tiny request must not fabricate a huge batch (shape[0]=2e9 with one
+    value used to drive a multi-GB allocation). batch_of clamps to the
+    backing values; msg_matrix (combiner path) rejects the mismatch."""
+    port = free_port()
+    spec = {"name": "t", "graph": {"name": "stub", "implementation": "SIMPLE_MODEL"}}
+    with NativeEngine(spec, port=port):
+        wait_port(port)
+        status, body = post(port, "/api/v0.1/predictions",
+                            {"data": {"tensor": {"shape": [2000000000, 5], "values": [1.0]}}})
+        assert status == 200
+        assert len(body["data"]["ndarray"]) == 1  # clamped to backing values
+        # negative shape rows likewise
+        status, body = post(port, "/api/v0.1/predictions",
+                            {"data": {"tensor": {"shape": [-1, 5], "values": [1.0, 2.0]}}})
+        assert status == 200
+        assert len(body["data"]["ndarray"]) == 1
+
+
+def test_shape_values_mismatch_rejected_by_combiner(built):
+    """msg_matrix must reject a tensor whose shape disagrees with its values
+    rather than silently reshaping. Client input only reaches msg_matrix via
+    remote-unit responses, so deliver the lie from a fake child."""
+    from _net import FixedResponseServer
+
+    lying = {"data": {"tensor": {"shape": [2, 3], "values": [1.0, 2.0, 3.0]}}}
+    ok = {"data": {"ndarray": [[5.0], [6.0]]}}
+    with FixedResponseServer(lying) as m1, FixedResponseServer(ok) as m2:
+        port = free_port()
+        spec = {"name": "t", "graph": {
+            "name": "c", "implementation": "AVERAGE_COMBINER",
+            "children": [
+                {"name": "m1", "type": "MODEL",
+                 "endpoint": {"service_host": "127.0.0.1", "service_port": m1.port, "transport": "REST"}},
+                {"name": "m2", "type": "MODEL",
+                 "endpoint": {"service_host": "127.0.0.1", "service_port": m2.port, "transport": "REST"}}]}}
+        with NativeEngine(spec, port=port):
+            wait_port(port)
+            status, body = post(port, "/api/v0.1/predictions",
+                                {"data": {"ndarray": [[1.0], [2.0]]}})
+            assert status >= 400
+
+
+def test_ragged_combiner_inputs_rejected(built):
+    """Remote children returning ragged ndarrays that agree on row 0 must be
+    rejected, not averaged out-of-bounds."""
+    from _net import FixedResponseServer
+
+    with FixedResponseServer({"data": {"ndarray": [[1.0], [2.0, 3.0]]}}) as m1, \
+         FixedResponseServer({"data": {"ndarray": [[5.0], [6.0]]}}) as m2:
+        port = free_port()
+        spec = {"name": "t", "graph": {
+            "name": "c", "implementation": "AVERAGE_COMBINER",
+            "children": [
+                {"name": "m1", "type": "MODEL",
+                 "endpoint": {"service_host": "127.0.0.1", "service_port": m1.port, "transport": "REST"}},
+                {"name": "m2", "type": "MODEL",
+                 "endpoint": {"service_host": "127.0.0.1", "service_port": m2.port, "transport": "REST"}}]}}
+        with NativeEngine(spec, port=port):
+            wait_port(port)
+            status, body = post(port, "/api/v0.1/predictions",
+                                {"data": {"ndarray": [[1.0], [2.0]]}})
+            assert status >= 400
+            assert "shape" in json.dumps(body)
+
+
+def test_prometheus_label_escaping(built):
+    port = free_port()
+    spec = {"name": 'dep"ployment\\x', "graph": {"name": "stub", "implementation": "SIMPLE_MODEL"}}
+    with NativeEngine(spec, port=port):
+        wait_port(port)
+        post(port, "/api/v0.1/predictions", {"data": {"ndarray": [[1.0]]}})
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert 'deployment="dep\\"ployment\\\\x"' in text
