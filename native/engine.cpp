@@ -443,7 +443,10 @@ static bool msg_matrix(const json::Value& msg, std::vector<std::vector<double>>&
     if (!values || values->type != json::Value::Arr) return false;
     size_t rows = 1, cols = values->arr->size();
     if (shape && shape->type == json::Value::Arr && shape->arr->size() >= 2) {
-      double r = (*shape->arr)[0].num, c = (*shape->arr)[1].num;
+      // matrix view of an N-d tensor: rows = dim0, cols = prod(trailing
+      // dims), matching the Python payload layer's np.prod(shape) reshape
+      double r = (*shape->arr)[0].num, c = 1.0;
+      for (size_t d = 1; d < shape->arr->size(); d++) c *= (*shape->arr)[d].num;
       if (!(r >= 1) || !(c >= 1)) return false;  // rejects negatives and NaN
       // client-supplied shape must exactly match the values it claims to
       // describe — rejecting (-> 4xx/5xx upstream) both guards the
@@ -493,14 +496,19 @@ static int upstream_timeout_ms() {
   return ms;
 }
 
-static int connect_to(const std::string& host, int port) {
+static void set_io_timeouts(int fd, int ms) {
+  if (ms < 1) ms = 1;
+  timeval tv{ms / 1000, (ms % 1000) * 1000};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+}
+
+static int connect_to(const std::string& host, int port, int timeout_ms) {
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
-  timeval tv{upstream_timeout_ms() / 1000, (upstream_timeout_ms() % 1000) * 1000};
-  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
-  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+  set_io_timeouts(fd, timeout_ms);
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(port);
@@ -510,7 +518,7 @@ static int connect_to(const std::string& host, int port) {
   int rc = connect(fd, (sockaddr*)&addr, sizeof addr);
   if (rc != 0 && errno == EINPROGRESS) {
     pollfd pfd{fd, POLLOUT, 0};
-    if (poll(&pfd, 1, upstream_timeout_ms()) != 1) { close(fd); return -1; }
+    if (poll(&pfd, 1, timeout_ms) != 1) { close(fd); return -1; }
     int err = 0; socklen_t len = sizeof err;
     if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len) != 0 || err != 0) { close(fd); return -1; }
   } else if (rc != 0) { close(fd); return -1; }
@@ -610,8 +618,15 @@ static json::Value remote_call(RequestCtx& ctx, const Unit& u, const char* path,
   // InternalPredictionService.java:87-91)
   const Deadline deadline =
       std::chrono::steady_clock::now() + std::chrono::milliseconds(upstream_timeout_ms());
-  for (int attempt = 0; attempt < 3 && !past(deadline); attempt++) {
-    if (conn.fd < 0) conn.fd = connect_to(u.host, u.port);
+  for (int attempt = 0; attempt < 3; attempt++) {
+    // per-operation socket timeouts clamped to the REMAINING hop budget so
+    // the hop can't exceed the deadline by stacking full-length waits
+    int rem = int(std::chrono::duration_cast<std::chrono::milliseconds>(
+                      deadline - std::chrono::steady_clock::now())
+                      .count());
+    if (rem <= 0) break;
+    if (conn.fd < 0) conn.fd = connect_to(u.host, u.port, rem);
+    else set_io_timeouts(conn.fd, rem);
     if (conn.fd < 0) continue;
     int n = snprintf(head, sizeof head,
                      "POST %s HTTP/1.1\r\nHost: %s\r\nContent-Type: application/json\r\nContent-Length: %zu\r\n\r\n",
@@ -1156,7 +1171,7 @@ static void run_bench(int port, int clients, double seconds, const std::string& 
   int ep = epoll_create1(0);
   std::map<int, BenchClient> conns;
   for (int i = 0; i < clients; i++) {
-    int fd = connect_to("127.0.0.1", port);
+    int fd = connect_to("127.0.0.1", port, upstream_timeout_ms());
     if (fd < 0) { fprintf(stderr, "bench: connect failed\n"); exit(1); }
     fcntl(fd, F_SETFL, O_NONBLOCK);
     epoll_event ev{};

@@ -1,0 +1,11 @@
+"""Outlier detectors as graph nodes (reference:
+components/outlier-detection/{mahalanobis,vae,isolation-forest,seq2seq-lstm}).
+
+Use as MODELs (predict -> 0/1 flags) or input TRANSFORMERs (passthrough +
+``outlier-predictions`` tag + gauges)."""
+
+from .base import OutlierDetector  # noqa: F401
+from .iforest import IsolationForestOutlier  # noqa: F401
+from .mahalanobis import Mahalanobis  # noqa: F401
+from .seq2seq import Seq2SeqOutlier, train_seq2seq  # noqa: F401
+from .vae import VAEOutlier, train_vae  # noqa: F401

@@ -1,0 +1,209 @@
+"""Seq2seq-LSTM outlier detector (JAX, lax.scan).
+
+Behavioral counterpart of the reference's
+components/outlier-detection/seq2seq-lstm/ (Keras encoder-decoder): train a
+sequence autoencoder on normal sequences, score each sequence by
+reconstruction MSE, flag scores above ``threshold``.
+
+TPU-native re-design: a single-layer LSTM encoder + LSTM decoder written
+as ``jax.lax.scan`` over time (static shapes, no Python loop inside jit),
+batched over sequences; trained with optax Adam under jit.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Optional
+
+import numpy as np
+
+from .base import OutlierDetector
+
+
+def _lstm_init(key, in_dim: int, hidden: int):
+    import jax
+
+    k1, k2 = jax.random.split(key)
+    scale = (1.0 / max(in_dim + hidden, 1)) ** 0.5
+    return {
+        "wx": jax.random.normal(k1, (in_dim, 4 * hidden), dtype="float32") * scale,
+        "wh": jax.random.normal(k2, (hidden, 4 * hidden), dtype="float32") * scale,
+        "b": np.zeros((4 * hidden,), dtype="float32"),
+    }
+
+
+def _lstm_cell(params, carry, x_t):
+    import jax.numpy as jnp
+
+    import jax
+
+    h, c = carry
+    gates = x_t @ params["wx"] + h @ params["wh"] + params["b"]
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return (h, c), h
+
+
+def seq2seq_init(key, n_features: int, hidden: int):
+    import jax
+
+    ke, kd, kp = jax.random.split(key, 3)
+    return {
+        "enc": _lstm_init(ke, n_features, hidden),
+        "dec": _lstm_init(kd, n_features, hidden),
+        "proj": {
+            "w": jax.random.normal(kp, (hidden, n_features), dtype="float32")
+            * (1.0 / hidden) ** 0.5,
+            "b": np.zeros((n_features,), dtype="float32"),
+        },
+    }
+
+
+def seq2seq_apply(params, x):
+    """x: [batch, time, features] -> reconstruction of the same shape.
+
+    Encoder consumes x; decoder starts from the encoder state and is fed the
+    (teacher-forced) input shifted by one step, mirroring the reference's
+    reconstruction decoder.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, T, F = x.shape
+    H = params["proj"]["w"].shape[0]
+    zeros = jnp.zeros((B, H), dtype=x.dtype)
+
+    xt = jnp.swapaxes(x, 0, 1)  # [T, B, F] for scan over time
+    (h, c), _ = jax.lax.scan(
+        lambda carry, x_t: _lstm_cell(params["enc"], carry, x_t), (zeros, zeros), xt
+    )
+    # decoder input: zero then x[:-1] (teacher forcing)
+    dec_in = jnp.concatenate([jnp.zeros_like(xt[:1]), xt[:-1]], axis=0)
+    _, hs = jax.lax.scan(
+        lambda carry, x_t: _lstm_cell(params["dec"], carry, x_t), (h, c), dec_in
+    )
+    recon = hs @ params["proj"]["w"] + params["proj"]["b"]  # [T, B, F]
+    return jnp.swapaxes(recon, 0, 1)
+
+
+def train_seq2seq(
+    X: np.ndarray,
+    hidden: int = 16,
+    lr: float = 1e-2,
+    epochs: int = 50,
+    batch_size: int = 32,
+    seed: int = 0,
+):
+    """Fit on normal sequences X [n, time, features]; returns (params, stats)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    X = np.asarray(X, dtype=np.float32)
+    mean = X.mean(axis=(0, 1))
+    std = X.std(axis=(0, 1)) + 1e-8
+    Xs = (X - mean) / std
+    key = jax.random.PRNGKey(seed)
+    params = seq2seq_init(key, X.shape[2], hidden)
+    opt = optax.adam(lr)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        def loss_fn(p):
+            recon = seq2seq_apply(p, batch)
+            return jnp.mean((batch - recon) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = np.random.default_rng(seed)
+    for _ in range(epochs):
+        order = rng.permutation(Xs.shape[0])
+        for i in range(0, Xs.shape[0], batch_size):
+            params, opt_state, _ = step(params, opt_state, Xs[order[i : i + batch_size]])
+    return params, {"mean": mean, "std": std}
+
+
+class Seq2SeqOutlier(OutlierDetector):
+    """Score = per-sequence reconstruction MSE. Accepts [batch, T, F] input
+    or [batch, T*F] flattened rows (reshaped with ``seq_len``)."""
+
+    def __init__(
+        self,
+        threshold: float = 1.0,
+        seq_len: Optional[int] = None,
+        model_uri: Optional[str] = None,
+    ):
+        super().__init__(threshold=float(threshold))
+        self.seq_len = None if seq_len is None else int(seq_len)
+        self.params = None
+        self.stats = None
+        self._score_fn = None
+        self.model_uri = model_uri
+
+    def load(self) -> None:
+        if self.model_uri:
+            from seldon_core_tpu.storage import Storage
+
+            path = Storage.download(self.model_uri)
+            with open(f"{path}/seq2seq.pkl", "rb") as f:
+                blob = pickle.load(f)
+            self.fit_from(blob["params"], blob["stats"])
+
+    def fit(self, X: np.ndarray, **train_kwargs) -> "Seq2SeqOutlier":
+        params, stats = train_seq2seq(X, **train_kwargs)
+        return self.fit_from(params, stats)
+
+    def fit_from(self, params, stats) -> "Seq2SeqOutlier":
+        import jax
+        import jax.numpy as jnp
+
+        self.params, self.stats = params, stats
+
+        @jax.jit
+        def score_fn(params, x):
+            recon = seq2seq_apply(params, x)
+            return jnp.mean((x - recon) ** 2, axis=(1, 2))
+
+        self._score_fn = score_fn
+        return self
+
+    def save(self, path: str) -> None:
+        import jax
+
+        blob = {
+            "params": jax.tree_util.tree_map(np.asarray, self.params),
+            "stats": self.stats,
+        }
+        with open(f"{path}/seq2seq.pkl", "wb") as f:
+            pickle.dump(blob, f)
+
+    def score(self, X: np.ndarray) -> np.ndarray:
+        if self._score_fn is None:
+            raise RuntimeError("Seq2SeqOutlier not fitted/loaded")
+        X = np.asarray(X, np.float32)
+        if X.ndim == 2:
+            if not self.seq_len:
+                raise ValueError("flattened input needs seq_len")
+            X = X.reshape(X.shape[0], self.seq_len, -1)
+        Xs = (X - self.stats["mean"]) / self.stats["std"]
+        return np.asarray(self._score_fn(self.params, Xs))
+
+    def _coerce(self, X) -> np.ndarray:
+        # sequences are 3-d; skip the base class's atleast_2d coercion
+        return np.asarray(X, dtype=np.float64)
+
+    # persistence hooks: snapshot params+stats, not the jit closure
+    def to_state_dict(self):
+        import jax
+
+        return {
+            "params": jax.tree_util.tree_map(np.asarray, self.params),
+            "stats": dict(self.stats),
+        }
+
+    def from_state_dict(self, d) -> None:
+        self.fit_from(d["params"], d["stats"])

@@ -65,10 +65,16 @@ def load_class(interface_name: str):
     return getattr(module, cls_name)
 
 
-def build_user_object(interface_name: str, parameters_json: str | None = None):
+def resolve_user_class(interface_name: str, parameters_json: str | None = None):
+    """Resolve (class, parsed-parameter dict) — single source of truth for
+    both the normal and --persistence boot paths."""
     params = json.loads(parameters_json or os.environ.get("PREDICTIVE_UNIT_PARAMETERS", "[]"))
-    cls = load_class(interface_name)
-    return cls(**parse_parameters(params))
+    return load_class(interface_name), parse_parameters(params)
+
+
+def build_user_object(interface_name: str, parameters_json: str | None = None):
+    cls, params = resolve_user_class(interface_name, parameters_json)
+    return cls(**params)
 
 
 async def _serve_rest(user_object, host: str, port: int, state: ServerState):
@@ -93,13 +99,40 @@ def main(argv=None) -> None:
         type=int,
         default=int(os.environ.get("GRPC_MAX_MESSAGE_BYTES", 0)) or None,
     )
+    parser.add_argument(
+        "--persistence",
+        action="store_true",
+        help="restore component state on boot and snapshot it periodically "
+        "(reference: microservice.py --persistence + persistence.py)",
+    )
+    parser.add_argument(
+        "--persistence-dir",
+        default=os.environ.get("SELDON_PERSISTENCE_DIR", "/tmp/seldon-state"),
+    )
+    parser.add_argument(
+        "--persistence-frequency",
+        type=float,
+        default=float(os.environ.get("SELDON_PERSISTENCE_FREQUENCY", 60)),
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=args.log_level.upper(),
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
 
-    user_object = build_user_object(args.interface_name, args.parameters)
+    persistence_thread = None
+    if args.persistence:
+        from seldon_core_tpu import persistence
+
+        cls, params = resolve_user_class(args.interface_name, args.parameters)
+        key = persistence.state_key(args.interface_name.rsplit(".", 1)[-1])
+        user_object = persistence.restore(cls, params, args.persistence_dir, key)
+        persistence_thread = persistence.PersistenceThread(
+            user_object, args.persistence_dir, key, args.persistence_frequency
+        )
+        persistence_thread.start()
+    else:
+        user_object = build_user_object(args.interface_name, args.parameters)
     if not args.no_warmup and hasattr(user_object, "load"):
         logger.info("warmup: load()")
         user_object.load()
@@ -118,10 +151,15 @@ def main(argv=None) -> None:
         except KeyboardInterrupt:
             pass
     elif grpc_server is not None:
-        grpc_server.wait_for_termination()
+        try:
+            grpc_server.wait_for_termination()
+        except KeyboardInterrupt:
+            pass  # fall through to graceful stop + final persistence push
 
     if grpc_server is not None:
         grpc_server.stop(grace=5)
+    if persistence_thread is not None:
+        persistence_thread.stop(final_push=True)
 
 
 if __name__ == "__main__":

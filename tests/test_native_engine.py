@@ -302,3 +302,28 @@ def test_prometheus_label_escaping(built):
         with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
             text = r.read().decode()
         assert 'deployment="dep\\"ployment\\\\x"' in text
+
+
+def test_3d_tensor_accepted_by_combiner(built):
+    """prod(shape) == len(values) must be accepted for N-d tensors (parity
+    with the Python payload layer's np.prod reshape)."""
+    from _net import FixedResponseServer
+
+    body3d = {"data": {"tensor": {"shape": [2, 3, 2], "values": [float(i) for i in range(12)]}}}
+    with FixedResponseServer(body3d) as m1, FixedResponseServer(body3d) as m2:
+        port = free_port()
+        spec = {"name": "t", "graph": {
+            "name": "c", "implementation": "AVERAGE_COMBINER",
+            "children": [
+                {"name": "m1", "type": "MODEL",
+                 "endpoint": {"service_host": "127.0.0.1", "service_port": m1.port, "transport": "REST"}},
+                {"name": "m2", "type": "MODEL",
+                 "endpoint": {"service_host": "127.0.0.1", "service_port": m2.port, "transport": "REST"}}]}}
+        with NativeEngine(spec, port=port):
+            wait_port(port)
+            status, body = post(port, "/api/v0.1/predictions",
+                                {"data": {"ndarray": [[1.0], [2.0]]}})
+            assert status == 200
+            # average of identical 2x6 matrix views
+            assert body["data"]["ndarray"] == [[0.0, 1.0, 2.0, 3.0, 4.0, 5.0],
+                                               [6.0, 7.0, 8.0, 9.0, 10.0, 11.0]]

@@ -1,0 +1,116 @@
+"""Outlier detector tests (reference: components/outlier-detection/*/ —
+each detector trained on inliers must flag planted outliers, pass input
+through as a transformer, and expose tags + gauges)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.components.outlier import (
+    IsolationForestOutlier,
+    Mahalanobis,
+    Seq2SeqOutlier,
+    VAEOutlier,
+)
+from seldon_core_tpu.graph import GraphExecutor, PredictorSpec
+from seldon_core_tpu.graph.spec import default_predictor
+
+
+RNG = np.random.default_rng(0)
+INLIERS = RNG.normal(0, 1, (400, 4))
+OUTLIERS = RNG.normal(8, 1, (10, 4))
+
+
+def test_mahalanobis_flags_planted_outliers():
+    det = Mahalanobis(threshold=25.0, n_components=3)
+    for i in range(0, 400, 50):
+        det.transform_input(INLIERS[i : i + 50], [])
+    assert det.prediction_.sum() <= 2  # inliers mostly clean
+    flags = det.predict(OUTLIERS, [])
+    assert flags.sum() >= 8
+    tags = det.tags()
+    assert len(tags["outlier-predictions"]) == 10
+    keys = {m["key"] for m in det.metrics()}
+    assert {"is_outlier", "outlier_score", "nb_outliers", "fraction_outliers",
+            "observation", "threshold"} <= keys
+
+
+def test_mahalanobis_state_roundtrip():
+    det = Mahalanobis()
+    det.transform_input(INLIERS[:100], [])
+    d = det.to_state_dict()
+    det2 = Mahalanobis()
+    det2.from_state_dict(d)
+    s1 = det.score(OUTLIERS)
+    s2 = det2.score(OUTLIERS)
+    np.testing.assert_allclose(s1, s2)
+
+
+def test_isolation_forest():
+    det = IsolationForestOutlier(threshold=0.0, n_estimators=50).fit(INLIERS)
+    flags_in = det.predict(INLIERS[:50], [])
+    flags_out = det.predict(OUTLIERS, [])
+    assert flags_out.sum() == 10
+    assert flags_in.mean() < 0.3
+
+
+def test_isolation_forest_save_load(tmp_path):
+    det = IsolationForestOutlier(threshold=0.0, n_estimators=20).fit(INLIERS)
+    det.save(str(tmp_path))
+    det2 = IsolationForestOutlier(threshold=0.0, model_uri=str(tmp_path))
+    det2.load()
+    np.testing.assert_allclose(det.score(OUTLIERS), det2.score(OUTLIERS))
+
+
+def test_vae_detector(tmp_path):
+    det = VAEOutlier(threshold=0.0, mc_samples=3, seed=0)
+    det.fit(INLIERS, hidden=(16, 8), latent_dim=2, epochs=20, batch_size=128)
+    s_in = det.score(INLIERS[:50])
+    s_out = det.score(OUTLIERS)
+    assert s_out.mean() > 5 * s_in.mean()
+    det.threshold = float(np.quantile(det.score(INLIERS), 0.99))
+    assert det.predict(OUTLIERS, []).sum() >= 8
+    # save/load parity
+    det.save(str(tmp_path))
+    det2 = VAEOutlier(threshold=det.threshold, mc_samples=3, model_uri=str(tmp_path))
+    det2.load()
+    assert det2.predict(OUTLIERS, []).sum() >= 8
+
+
+def test_seq2seq_detector():
+    t = np.linspace(0, 4 * np.pi, 20)
+    normal = np.stack(
+        [np.sin(t + ph)[:, None] for ph in RNG.uniform(0, 2 * np.pi, 200)]
+    )  # [200, 20, 1]
+    anomalous = RNG.normal(0, 1.5, (10, 20, 1))
+    det = Seq2SeqOutlier(threshold=0.0)
+    det.fit(normal, hidden=8, epochs=30, batch_size=64)
+    s_in = det.score(normal[:50])
+    s_out = det.score(anomalous)
+    assert s_out.mean() > 3 * s_in.mean()
+    det.threshold = float(np.quantile(det.score(normal), 0.99))
+    flags = det.predict(anomalous, [])
+    assert flags.sum() >= 8
+    # flattened 2-d input path
+    det2 = Seq2SeqOutlier(threshold=det.threshold, seq_len=20)
+    det2.fit_from(det.params, det.stats)
+    np.testing.assert_allclose(
+        det2.score(anomalous.reshape(10, -1)), s_out, rtol=1e-5
+    )
+
+
+def test_outlier_transformer_in_graph():
+    """Detector as input TRANSFORMER above a model: passthrough + tags
+    (reference: doc/source/analytics/outlier_detection.md graph pattern)."""
+    det = IsolationForestOutlier(threshold=0.0, n_estimators=20).fit(INLIERS)
+    graph = {
+        "name": "od",
+        "type": "TRANSFORMER",
+        "children": [{"name": "m", "implementation": "SIMPLE_MODEL"}],
+    }
+    spec = default_predictor(PredictorSpec.from_dict({"name": "p", "graph": graph}))
+    ex = GraphExecutor(spec, registry={"od": det})
+    out = asyncio.run(ex.predict({"data": {"ndarray": OUTLIERS.tolist()}}))
+    assert out["data"]["ndarray"][0] == [0.9, 0.05, 0.05]  # model output passthrough
+    assert out["meta"]["tags"]["outlier-predictions"] == [1] * 10

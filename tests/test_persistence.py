@@ -1,0 +1,85 @@
+"""Persistence tests (reference: python/seldon_core/persistence.py:21-85 —
+restore on boot, periodic push, key layout predictor_deployment_component)."""
+
+import time
+
+import numpy as np
+
+from seldon_core_tpu import persistence
+from seldon_core_tpu.components.routers import EpsilonGreedy
+from seldon_core_tpu.user_model import SeldonComponent
+
+X4 = np.zeros((4, 2))
+
+
+def test_state_key_env(monkeypatch):
+    monkeypatch.setenv("PREDICTOR_ID", "pred")
+    monkeypatch.setenv("SELDON_DEPLOYMENT_ID", "dep")
+    assert persistence.state_key("router") == "pred_dep_router"
+    assert persistence.state_key("r", "a", "b") == "a_b_r"
+
+
+def test_orbax_roundtrip_for_state_dict_components(tmp_path):
+    r = EpsilonGreedy(n_branches=3, epsilon=0.0, seed=0)
+    r.send_feedback(X4, [], reward=1.0, truth=None, routing=2)
+    path = persistence.persist(r, str(tmp_path), "k")
+    assert path.endswith(".orbax")
+    r2 = persistence.restore(
+        EpsilonGreedy, {"n_branches": 3, "epsilon": 0.0, "seed": 0}, str(tmp_path), "k"
+    )
+    assert r2.state.best_branch == 2
+    assert r2.state.success.tolist() == r.state.success.tolist()
+    assert r2.route(X4, []) == 2
+
+
+class PlainCounter(SeldonComponent):
+    def __init__(self):
+        self.count = 0
+
+    def predict(self, X, names, meta=None):
+        self.count += 1
+        return X
+
+
+def test_pickle_fallback_for_plain_components(tmp_path):
+    c = PlainCounter()
+    c.predict(X4, [])
+    c.predict(X4, [])
+    path = persistence.persist(c, str(tmp_path), "k")
+    assert path.endswith(".pkl")
+    c2 = persistence.restore(PlainCounter, {}, str(tmp_path), "k")
+    assert c2.count == 2
+
+
+def test_restore_without_snapshot_is_fresh(tmp_path):
+    r = persistence.restore(EpsilonGreedy, {"n_branches": 2}, str(tmp_path), "nope")
+    assert r.state.tries.sum() == 0
+
+
+def test_persistence_thread_pushes(tmp_path):
+    c = PlainCounter()
+    t = persistence.PersistenceThread(c, str(tmp_path), "k", push_frequency=0.05)
+    t.start()
+    c.predict(X4, [])
+    time.sleep(0.2)
+    t.stop(final_push=True)
+    c2 = persistence.restore(PlainCounter, {}, str(tmp_path), "k")
+    assert c2.count == 1
+
+
+def test_vae_state_dict_persistence(tmp_path):
+    """VAE/seq2seq hold jit closures that can't pickle; the state-dict hooks
+    must make --persistence work for them via orbax."""
+    from seldon_core_tpu.components.outlier import VAEOutlier
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(0, 1, (100, 3))
+    det = VAEOutlier(threshold=5.0, mc_samples=2, seed=0)
+    det.fit(X, hidden=(8,), latent_dim=2, epochs=3, batch_size=64)
+    path = persistence.persist(det, str(tmp_path), "vae")
+    assert path.endswith(".orbax")
+    det2 = persistence.restore(
+        VAEOutlier, {"threshold": 5.0, "mc_samples": 2, "seed": 0}, str(tmp_path), "vae"
+    )
+    outliers = rng.normal(9, 1, (5, 3))
+    np.testing.assert_allclose(det.score(outliers), det2.score(outliers), rtol=1e-4)
