@@ -1,0 +1,27 @@
+"""Control plane: declarative SeldonDeployment resources reconciled onto
+local TPU-host runtimes.
+
+TPU-native counterpart of the reference's Go operator stack
+(reference: operator/main.go:49-93, operator/controllers/
+seldondeployment_controller.go:253-1199): a resource store stands in for
+the K8s API server, an admission step mirrors the defaulting/validating
+webhook, and the reconciler materializes engines + microservice processes
+(instead of Deployments/Services) with topology-aware TPU device
+placement instead of GKE node-pool scheduling.
+"""
+
+from .resource import DeploymentStatus, SeldonDeployment
+from .store import ResourceStore
+from .placement import PlacementError, TpuPlacement
+from .reconciler import DeploymentController
+from .ingress import Gateway
+
+__all__ = [
+    "SeldonDeployment",
+    "DeploymentStatus",
+    "ResourceStore",
+    "TpuPlacement",
+    "PlacementError",
+    "DeploymentController",
+    "Gateway",
+]

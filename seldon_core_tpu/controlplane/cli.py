@@ -1,0 +1,140 @@
+"""Control-plane CLI: apply/get/delete deployments + run the controller.
+
+kubectl-equivalent for the file-backed resource store (the reference's
+users drive the operator with `kubectl apply -f deployment.json` —
+reference: testing/scripts/test_prepackaged_servers.py:7-35):
+
+    python -m seldon_core_tpu.controlplane apply -f dep.json
+    python -m seldon_core_tpu.controlplane get [name]
+    python -m seldon_core_tpu.controlplane delete <name>
+    python -m seldon_core_tpu.controlplane controller --gateway-port 8003
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import sys
+
+from .ingress import Gateway
+from .reconciler import DeploymentController
+from .resource import SeldonDeployment
+from .runtime import InProcessRuntime, SubprocessRuntime
+from .store import ResourceStore
+from .placement import TpuPlacement
+
+DEFAULT_STORE = os.environ.get("SELDON_TPU_STORE", "/tmp/seldon-tpu-store")
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser("seldon-tpu-ctl")
+    parser.add_argument("--store-dir", default=DEFAULT_STORE)
+    parser.add_argument("--namespace", "-n", default="default")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_apply = sub.add_parser("apply")
+    p_apply.add_argument("-f", "--filename", required=True)
+
+    p_get = sub.add_parser("get")
+    p_get.add_argument("name", nargs="?")
+
+    p_delete = sub.add_parser("delete")
+    p_delete.add_argument("name")
+
+    p_ctl = sub.add_parser("controller")
+    p_ctl.add_argument("--gateway-port", type=int, default=int(os.environ.get("GATEWAY_PORT", 8003)))
+    p_ctl.add_argument("--subprocess-runtime", action="store_true",
+                       help="one OS process per component instead of in-process asyncio")
+    p_ctl.add_argument("--placement", action="store_true",
+                       help="enable TPU device placement (needs jax)")
+    p_ctl.add_argument("--poll-s", type=float, default=1.0,
+                       help="store re-scan period (picks up sdctl writes from other processes)")
+
+    args = parser.parse_args(argv)
+    logging.basicConfig(level="INFO", format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    store = ResourceStore(persist_dir=args.store_dir)
+
+    if args.cmd == "apply":
+        with open(args.filename) as f:
+            dep = SeldonDeployment.from_dict(json.load(f))
+        if dep.namespace == "default" and args.namespace != "default":
+            dep.namespace = args.namespace
+        dep, event = store.apply(dep)
+        print(f"seldondeployment.machinelearning.seldon.io/{dep.name} {event.lower()}")
+        return
+
+    if args.cmd == "get":
+        deps = store.list(args.namespace)
+        if args.name:
+            deps = [d for d in deps if d.name == args.name]
+            if not deps:
+                print(f"not found: {args.name}", file=sys.stderr)
+                raise SystemExit(1)
+            print(json.dumps(deps[0].to_dict(), indent=2))
+            return
+        for d in deps:
+            s = d.status
+            print(f"{d.namespace}/{d.name}\tgen={d.generation}\t{s.state}\t{s.description}")
+        return
+
+    if args.cmd == "delete":
+        ok = store.delete(args.name, args.namespace)
+        print(
+            f"seldondeployment.machinelearning.seldon.io/{args.name} "
+            + ("deleted" if ok else "not found")
+        )
+        return
+
+    if args.cmd == "controller":
+        runtime = SubprocessRuntime() if args.subprocess_runtime else InProcessRuntime()
+        placement = TpuPlacement() if args.placement else None
+        gateway = Gateway()
+        controller = DeploymentController(
+            store, runtime=runtime, placement=placement, gateway=gateway
+        )
+
+        async def run():
+            tasks = [
+                asyncio.create_task(controller.run()),
+                asyncio.create_task(
+                    gateway.app().serve_forever("0.0.0.0", args.gateway_port)
+                ),
+                asyncio.create_task(_rescan_loop(store, args.store_dir, args.poll_s)),
+            ]
+            await asyncio.gather(*tasks)
+
+        try:
+            asyncio.run(run())
+        except KeyboardInterrupt:
+            pass
+        return
+
+
+async def _rescan_loop(store: ResourceStore, persist_dir: str, period_s: float) -> None:
+    """Pick up applies/deletes written by other sdctl processes: re-read the
+    persist dir and diff against the in-memory view."""
+    while True:
+        await asyncio.sleep(period_s)
+        try:
+            fresh = ResourceStore(persist_dir=persist_dir)
+        except Exception:
+            continue
+        fresh_keys = {d.key for d in fresh.list()}
+        for dep in fresh.list():
+            mine = store.get(dep.name, dep.namespace)
+            if (
+                mine is None
+                or mine.spec_hash() != dep.spec_hash()
+                or mine.annotations != dep.annotations
+            ):
+                store.apply(dep)
+        for dep in list(store.list()):
+            if dep.key not in fresh_keys:
+                store.delete(dep.name, dep.namespace)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,172 @@
+"""Ingress gateway: weighted canary routing across predictors.
+
+Parity with the reference's Istio VirtualService traffic weights and
+Ambassador mappings (reference: operator/controllers/
+seldondeployment_controller.go:113-224 createIstioResources;
+operator/controllers/ambassador.go:50-222 — weighted canaries, shadow
+predictors, header-based routing). One asyncio HTTP front exposes
+
+    /seldon/<namespace>/<deployment>/api/v0.1/predictions  (and /feedback)
+
+and fans each request to one predictor's engine chosen by traffic weight,
+honouring a ``seldon-predictor`` header override and mirroring traffic to
+shadow predictors fire-and-forget.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from typing import Dict, List, Optional, Tuple
+
+from ..http_server import HTTPServer, Request, Response, error_body
+
+logger = logging.getLogger(__name__)
+
+HEADER_PREDICTOR = "seldon-predictor"
+ANNOTATION_SHADOW = "seldon.io/shadow"
+
+
+def _log_shadow_failure(task: "asyncio.Task") -> None:
+    if not task.cancelled() and task.exception() is not None:
+        logger.warning("shadow mirror failed: %s", task.exception())
+
+
+class _Route:
+    __slots__ = ("predictor", "weight", "handles", "shadow", "_rr")
+
+    def __init__(self, predictor: str, weight: int, handles: List, shadow: bool):
+        self.predictor = predictor
+        self.weight = weight
+        self.handles = handles
+        self.shadow = shadow
+        self._rr = 0
+
+    def pick(self):
+        """Round-robin over replica engines of one predictor."""
+        if not self.handles:
+            return None
+        h = self.handles[self._rr % len(self.handles)]
+        self._rr += 1
+        return h
+
+
+class Gateway:
+    def __init__(self, seed: Optional[int] = None):
+        # deployment key -> list of routes
+        self._routes: Dict[str, List[_Route]] = {}
+        self._rng = random.Random(seed)
+
+    # -- route table maintenance (called by the reconciler) -----------------
+
+    def set_routes(self, dep, endpoints: Dict[str, List]) -> None:
+        routes = []
+        for pspec in dep.predictors:
+            shadow = pspec.annotations.get(ANNOTATION_SHADOW, "false") == "true"
+            routes.append(
+                _Route(pspec.name, pspec.traffic, endpoints.get(pspec.name, []), shadow)
+            )
+        self._routes[dep.key] = routes
+
+    def drop_routes(self, key: str) -> None:
+        self._routes.pop(key, None)
+
+    def route_table(self) -> Dict[str, List[Tuple[str, int, int, bool]]]:
+        return {
+            k: [(r.predictor, r.weight, len(r.handles), r.shadow) for r in rs]
+            for k, rs in self._routes.items()
+        }
+
+    # -- selection ----------------------------------------------------------
+
+    def select(self, key: str, header_predictor: Optional[str] = None):
+        """Choose (primary_handle, [shadow_handles]) for one request."""
+        routes = self._routes.get(key)
+        if not routes:
+            return None, []
+        live = [r for r in routes if not r.shadow]
+        shadows = [r for r in routes if r.shadow]
+        if header_predictor:
+            for r in routes:
+                if r.predictor == header_predictor:
+                    return r.pick(), []
+            return None, []
+        total = sum(r.weight for r in live)
+        if total <= 0:
+            chosen = live[0] if live else None
+        else:
+            x = self._rng.uniform(0, total)
+            acc = 0.0
+            chosen = live[-1]
+            for r in live:
+                acc += r.weight
+                if x <= acc:
+                    chosen = r
+                    break
+        return (chosen.pick() if chosen else None), [s.pick() for s in shadows if s.handles]
+
+    # -- HTTP front ---------------------------------------------------------
+
+    async def _forward(self, handle, path: str, payload):
+        """Dispatch to an engine; uses the in-process app when available
+        (zero-copy localhost fast path, like the webhook's
+        ServiceHost=localhost — reference: seldondeployment_webhook.go:211-216)."""
+        import json as _json
+
+        app = getattr(handle, "app", None)
+        if app is not None:
+            if path.endswith("/feedback"):
+                return await app.send_feedback(payload)
+            if path.endswith("/predictions") or path == "/predict":
+                return await app.predict(payload)
+            raise LookupError(f"no engine route {path}")
+
+        def do_post():
+            import urllib.request
+
+            req = urllib.request.Request(
+                f"{handle.url}{path}",
+                data=_json.dumps(payload).encode(),
+                headers={"content-type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                return _json.loads(r.read())
+
+        return await asyncio.get_running_loop().run_in_executor(None, do_post)
+
+    def app(self) -> HTTPServer:
+        server = HTTPServer("gateway")
+        gw = self
+
+        async def handler(req: Request) -> Response:
+            # /seldon/<ns>/<name>/api/v0.1/predictions
+            parts = [p for p in req.path.split("/") if p]
+            if len(parts) < 4 or parts[0] != "seldon":
+                return Response(error_body(404, f"no route for {req.path}"), 404)
+            ns, name = parts[1], parts[2]
+            api_path = "/" + "/".join(parts[3:])
+            key = f"{ns}/{name}"
+            primary, shadows = gw.select(key, req.headers.get(HEADER_PREDICTOR))
+            if primary is None:
+                return Response(error_body(503, f"no live predictor for {key}"), 503)
+            # req.json() handles both raw JSON and the reference's
+            # form-encoded `json=` body style
+            payload = req.json()
+            for s in shadows:
+                t = asyncio.ensure_future(gw._forward(s, api_path, payload))
+                t.add_done_callback(_log_shadow_failure)
+            try:
+                out = await gw._forward(primary, api_path, payload)
+            except LookupError as e:
+                return Response(error_body(404, str(e)), 404)
+            except Exception as e:  # noqa: BLE001 - gateway must answer
+                return Response(error_body(502, str(e)), 502)
+            return Response(out)
+
+        async def routes(req: Request) -> Response:
+            return Response(gw.route_table())
+
+        server.add_prefix_route("/seldon/", handler)
+        server.add_route("/routes", routes)
+        return server

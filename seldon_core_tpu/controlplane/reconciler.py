@@ -1,0 +1,351 @@
+"""Deployment reconciler: SeldonDeployment resources → running components.
+
+Parity with the operator's reconcile loop (reference:
+operator/controllers/seldondeployment_controller.go:253-391,1067-1122):
+per predictor it runs the admission defaulting/validation, the
+model-initializer (modelUri download — reference:
+operator/controllers/model_initializer_injector.go:65-242), prepackaged
+server wiring (reference: seldondeployment_prepackaged_servers.go:30-176),
+TPU device placement (replaces GKE scheduling), engine injection with the
+b64 graph env (reference: seldondeployment_engine.go:101-214), explainer
+components (reference: seldondeployment_explainers.go:32-187), then diffs
+desired vs running components, performs create-before-delete rolling
+updates, and rolls the status up to Creating/Available/Failed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ..graph.spec import (
+    PREPACKAGED_SERVERS,
+    PredictorSpec,
+    default_predictor,
+    validate_deployment,
+)
+from ..storage import Storage
+from .resource import (
+    STATE_AVAILABLE,
+    STATE_CREATING,
+    STATE_FAILED,
+    DeploymentStatus,
+    PredictorStatus,
+    SeldonDeployment,
+)
+from .runtime import ComponentHandle, ComponentSpec, InProcessRuntime
+from .store import EVENT_DELETED, ResourceStore
+from .placement import PlacementError, TpuPlacement
+
+logger = logging.getLogger(__name__)
+
+# annotation keys (reference: seldondeployment_types.go:35-45 k8s
+# annotations-as-feature-flags, delivered via podinfo downward API)
+ANNOTATION_SEPARATE_ENGINE = "seldon.io/engine-separate-pod"
+ANNOTATION_NO_ENGINE = "seldon.io/no-engine"
+
+
+class DeploymentController:
+    def __init__(
+        self,
+        store: ResourceStore,
+        runtime: Optional[InProcessRuntime] = None,
+        placement: Optional[TpuPlacement] = None,
+        gateway=None,
+        model_cache_dir: Optional[str] = None,
+        ready_timeout_s: float = 30.0,
+    ):
+        self.store = store
+        self.runtime = runtime or InProcessRuntime()
+        self.placement = placement
+        self.gateway = gateway
+        self.model_cache_dir = model_cache_dir
+        self.ready_timeout_s = ready_timeout_s
+        # component-name -> (handle, spec_hash of owning deployment)
+        self.components: Dict[str, Tuple[ComponentHandle, str]] = {}
+        self._reconciling: Dict[str, asyncio.Lock] = {}
+
+    # -- desired state ------------------------------------------------------
+
+    async def _initialize_models(self, pspec: PredictorSpec) -> None:
+        """Model-initializer: pull every unit's modelUri to local disk and
+        point the unit at the local copy (reference: init-container download
+        into emptyDir /mnt/models, model_initializer_injector.go:65-242).
+        Downloads run on the default executor so a multi-GB pull doesn't
+        stall the controller loop (or the co-hosted gateway)."""
+        loop = asyncio.get_running_loop()
+        for unit in pspec.graph.walk():
+            if not unit.model_uri:
+                continue
+            scheme = unit.model_uri.split("://", 1)[0] if "://" in unit.model_uri else ""
+            if scheme in ("", "file"):
+                continue  # already local
+            out_dir = (
+                None if self.model_cache_dir is None else f"{self.model_cache_dir}/{unit.name}"
+            )
+            unit.model_uri = await loop.run_in_executor(
+                None, Storage.download, unit.model_uri, out_dir
+            )
+
+    async def desired_components(self, dep: SeldonDeployment) -> List[ComponentSpec]:
+        specs: List[ComponentSpec] = []
+        h = dep.spec_hash()
+        for pspec in dep.predictors:
+            separate = dep.annotations.get(ANNOTATION_SEPARATE_ENGINE, "false") == "true"
+            pspec = default_predictor(pspec, separate_pods=False)
+            await self._initialize_models(pspec)
+            # separate-pod units become standalone microservices; their ports
+            # are allocated here and written back into the engine graph so
+            # the engine's REST client dials the real socket (reference:
+            # createStandaloneModelServers prepackaged_servers.go:248)
+            if separate:
+                for unit in pspec.graph.walk():
+                    if unit.endpoint.transport in ("REST", "GRPC") and unit.implementation in PREPACKAGED_SERVERS:
+                        from .runtime import free_port
+
+                        port = free_port()
+                        unit.endpoint.transport = "REST"
+                        unit.endpoint.service_host = "127.0.0.1"
+                        unit.endpoint.service_port = port
+                        specs.append(
+                            ComponentSpec(
+                                name=f"{dep.key}/{pspec.name}/{unit.name}/svc-{h[:8]}",
+                                kind="microservice",
+                                deployment=dep.key,
+                                predictor=pspec.name,
+                                interface_name=PREPACKAGED_SERVERS[unit.implementation],
+                                http_port=port,
+                                parameters=[
+                                    {"name": "model_uri", "value": unit.model_uri, "type": "STRING"},
+                                    *[p.to_dict() for p in unit.parameters],
+                                ],
+                            )
+                        )
+            for replica in range(max(1, pspec.replicas)):
+                name = f"{dep.key}/{pspec.name}/{replica}/engine-{h[:8]}"
+                specs.append(
+                    ComponentSpec(
+                        name=name,
+                        kind="engine",
+                        deployment=dep.key,
+                        predictor=pspec.name,
+                        replica=replica,
+                        engine_spec=pspec.to_dict(),
+                    )
+                )
+            explainer = pspec.annotations.get("seldon.io/explainer-type")
+            if explainer:
+                specs.append(
+                    ComponentSpec(
+                        name=f"{dep.key}/{pspec.name}/explainer-{h[:8]}",
+                        kind="explainer",
+                        deployment=dep.key,
+                        predictor=pspec.name,
+                        interface_name="seldon_core_tpu.components.explainer.Explainer",
+                        parameters=[
+                            {"name": "explainer_type", "value": explainer, "type": "STRING"},
+                            {
+                                "name": "model_uri",
+                                "value": pspec.annotations.get("seldon.io/explainer-model-uri", ""),
+                                "type": "STRING",
+                            },
+                        ],
+                    )
+                )
+        return specs
+
+    # -- reconcile ----------------------------------------------------------
+
+    async def reconcile(self, dep: SeldonDeployment) -> DeploymentStatus:
+        lock = self._reconciling.setdefault(dep.key, asyncio.Lock())
+        async with lock:
+            return await self._reconcile_locked(dep)
+
+    async def _reconcile_locked(self, dep: SeldonDeployment) -> DeploymentStatus:
+        status = DeploymentStatus(state=STATE_CREATING)
+
+        def fail(desc: str) -> DeploymentStatus:
+            status.state = STATE_FAILED
+            status.description = desc
+            status.predictor_status = []
+            dep.status = status
+            self.store.update_status(dep)
+            return status
+
+        try:
+            validate_deployment(dep.predictors)
+            desired = await self.desired_components(dep)
+        except Exception as e:  # noqa: BLE001 - any bad spec must not kill run()
+            return fail(str(e))
+
+        desired_names = {s.name for s in desired}
+        mine = {n for n, (h, _) in self.components.items() if h.spec.deployment == dep.key}
+
+        # TPU placement: one block per (predictor, replica) engine. Prefer
+        # create-before-delete; when chips don't fit both generations at
+        # once, fall back to tearing the old generation down first
+        # (Recreate-strategy equivalent).
+        if self.placement is not None:
+            try:
+                self._allocate_blocks(dep, desired)
+            except PlacementError:
+                for name in sorted(mine - desired_names):
+                    handle, _ = self.components.pop(name)
+                    self.placement.release(name)
+                    await handle.stop()
+                mine = {n for n, (h, _) in self.components.items() if h.spec.deployment == dep.key}
+                try:
+                    self._allocate_blocks(dep, desired)
+                except PlacementError as e:
+                    self._release_blocks(desired)
+                    return fail(str(e))
+
+        # create-before-delete rolling update (reference: Deployment
+        # rolling-update semantics exercised by test_rolling_updates.py)
+        created: List[ComponentHandle] = []
+        try:
+            for spec in desired:
+                if spec.name not in self.components:
+                    handle = await self.runtime.start(spec)
+                    self.components[spec.name] = (handle, dep.spec_hash())
+                    created.append(handle)
+            # wait for new components to come ready before tearing down old
+            ok = await self._await_ready(created)
+        except Exception as e:  # noqa: BLE001 - component boot must not kill run()
+            logger.exception("%s: component start failed", dep.key)
+            for handle in created:
+                self.components.pop(handle.spec.name, None)
+                await handle.stop()
+            self._release_blocks(desired, keep=mine)
+            return fail(f"component start failed: {e}")
+
+        if ok:
+            for name in mine - desired_names:
+                handle, _ = self.components.pop(name)
+                if self.placement is not None:
+                    self.placement.release(name)
+                await handle.stop()
+        else:
+            # roll back: tear down the failed new generation, keep old
+            for handle in created:
+                self.components.pop(handle.spec.name, None)
+                if self.placement is not None:
+                    self.placement.release(handle.spec.name)
+                await handle.stop()
+            return fail("new components failed readiness")
+
+        # status rollup (reference: seldondeployment_controller.go:1111-1119)
+        for pspec in dep.predictors:
+            replicas = max(1, pspec.replicas)
+            avail = 0
+            for name, (handle, _) in self.components.items():
+                if (
+                    handle.spec.deployment == dep.key
+                    and handle.spec.predictor == pspec.name
+                    and handle.spec.kind == "engine"
+                    and await handle.ready()
+                ):
+                    avail += 1
+            status.predictor_status.append(
+                PredictorStatus(name=pspec.name, replicas=replicas, replicas_available=avail)
+            )
+        status.state = (
+            STATE_AVAILABLE
+            if all(p.replicas_available >= p.replicas for p in status.predictor_status)
+            else STATE_CREATING
+        )
+        dep.status = status
+        self.store.update_status(dep)
+        if self.gateway is not None:
+            self.gateway.set_routes(dep, self._engine_endpoints(dep))
+        return status
+
+    def _allocate_blocks(self, dep: SeldonDeployment, desired: List[ComponentSpec]) -> None:
+        """All-or-nothing device allocation for the desired engines: on a
+        PlacementError, blocks grabbed within this call are released so a
+        failed generation never leaks chips."""
+        fresh: List[str] = []
+        try:
+            for spec in desired:
+                if spec.kind != "engine":
+                    continue
+                if self.placement.assigned(spec.name) is None:
+                    pspec = dep.predictor(spec.predictor)
+                    self.placement.allocate(spec.name, pspec.tpu_mesh if pspec else None)
+                    fresh.append(spec.name)
+        except PlacementError:
+            for name in fresh:
+                self.placement.release(name)
+            raise
+
+    def _release_blocks(self, desired: List[ComponentSpec], keep=()) -> None:
+        if self.placement is None:
+            return
+        for spec in desired:
+            if spec.name not in keep and spec.name not in self.components:
+                self.placement.release(spec.name)
+
+    def _engine_endpoints(self, dep: SeldonDeployment) -> Dict[str, List[ComponentHandle]]:
+        out: Dict[str, List[ComponentHandle]] = {}
+        for name, (handle, _) in self.components.items():
+            if handle.spec.deployment == dep.key and handle.spec.kind == "engine":
+                out.setdefault(handle.spec.predictor, []).append(handle)
+        return out
+
+    async def _await_ready(self, handles: List[ComponentHandle]) -> bool:
+        deadline = asyncio.get_running_loop().time() + self.ready_timeout_s
+        pending = list(handles)
+        while pending and asyncio.get_running_loop().time() < deadline:
+            still = []
+            for h in pending:
+                if not await h.ready():
+                    still.append(h)
+            pending = still
+            if pending:
+                await asyncio.sleep(0.05)
+        return not pending
+
+    async def delete(self, dep: SeldonDeployment) -> None:
+        mine = [n for n, (h, _) in self.components.items() if h.spec.deployment == dep.key]
+        for name in mine:
+            handle, _ = self.components.pop(name)
+            if self.placement is not None:
+                self.placement.release(name)
+            await handle.stop()
+        if self.gateway is not None:
+            self.gateway.drop_routes(dep.key)
+
+    # -- watch loop ---------------------------------------------------------
+
+    async def run(self, stop_event: Optional[asyncio.Event] = None) -> None:
+        """Consume store events forever (controller-runtime manager parity,
+        reference: operator/main.go:49-93)."""
+        q = self.store.watch()
+        # reconcile pre-existing resources (controller restart)
+        for dep in self.store.list():
+            await self.reconcile(dep.clone())
+        try:
+            while stop_event is None or not stop_event.is_set():
+                try:
+                    event, dep = await asyncio.wait_for(q.get(), timeout=0.2)
+                except asyncio.TimeoutError:
+                    continue
+                try:
+                    if event == EVENT_DELETED:
+                        await self.delete(dep)
+                    else:
+                        await self.reconcile(dep.clone())
+                except Exception:  # noqa: BLE001 - one bad resource must not
+                    # stop reconciling the others (controller-runtime requeues
+                    # on error rather than crashing the manager)
+                    logger.exception("reconcile %s failed", dep.key)
+        finally:
+            self.store.unwatch(q)
+
+    async def shutdown(self) -> None:
+        for name in list(self.components):
+            handle, _ = self.components.pop(name)
+            if self.placement is not None:
+                self.placement.release(name)
+            await handle.stop()

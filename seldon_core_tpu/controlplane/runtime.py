@@ -1,0 +1,244 @@
+"""Component runtimes: how the reconciler materializes desired state.
+
+The reference operator emits K8s Deployments and lets kubelet run pods
+(reference: operator/controllers/seldondeployment_controller.go:855-900);
+here a runtime starts the same logical components on the TPU host:
+
+  * ``InProcessRuntime`` — engines and microservices as asyncio servers
+    inside the controller process, on real localhost ports. This is both
+    the test tier (SURVEY §4: in-process fake placement) and the
+    single-host production mode: co-located graph units stay INPROCESS so
+    a request never leaves the process between nodes.
+  * ``SubprocessRuntime`` — one OS process per component, env-injected
+    exactly like the engine sidecar (``ENGINE_PREDICTOR`` b64 —
+    reference: operator/controllers/seldondeployment_engine.go:101-214)
+    and the wrapper (``PREDICTIVE_UNIT_PARAMETERS`` env).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@dataclass
+class ComponentSpec:
+    """One schedulable unit: an engine, a microservice, or an explainer."""
+
+    name: str  # unique within the runtime, e.g. "default/dep/predictor-0/engine"
+    kind: str  # "engine" | "microservice" | "explainer"
+    deployment: str
+    predictor: str
+    replica: int = 0
+    # engine kinds carry the full predictor spec dict; microservices carry
+    # the interface name + parameters
+    engine_spec: Optional[Dict[str, Any]] = None
+    interface_name: Optional[str] = None
+    parameters: Optional[List[Dict[str, Any]]] = None
+    env: Dict[str, str] = field(default_factory=dict)
+    http_port: int = 0
+    grpc_port: int = 0
+
+
+class ComponentHandle:
+    """A running component; reconciler tracks these by spec name."""
+
+    def __init__(self, spec: ComponentSpec):
+        self.spec = spec
+        self.started_at = time.time()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.spec.http_port}"
+
+    async def ready(self) -> bool:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    async def stop(self) -> None:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+
+class _InProcessHandle(ComponentHandle):
+    def __init__(self, spec: ComponentSpec, tasks: List[asyncio.Task], probe, grpc_server=None):
+        super().__init__(spec)
+        self._tasks = tasks
+        self._probe = probe
+        self._grpc_server = grpc_server
+
+    async def ready(self) -> bool:
+        try:
+            out = self._probe()
+            if asyncio.iscoroutine(out):
+                out = await out
+            return bool(out)
+        except Exception:
+            return False
+
+    async def stop(self) -> None:
+        if self._grpc_server is not None:
+            await self._grpc_server.stop(grace=0.1)
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+
+
+class InProcessRuntime:
+    """Run components as asyncio servers in the controller's loop."""
+
+    def __init__(self, open_ports: bool = True, grpc: bool = False):
+        # open_ports=False → don't bind sockets (pure logical placement,
+        # the reconciler-unit-test mode); engine apps are still constructed
+        # and reachable via handle.app
+        self.open_ports = open_ports
+        self.grpc = grpc
+
+    async def start(self, spec: ComponentSpec) -> ComponentHandle:
+        from ..graph.service import EngineApp
+        from ..graph.spec import PredictorSpec, default_predictor, validate_predictor
+
+        if spec.kind == "engine":
+            pspec = PredictorSpec.from_dict(spec.engine_spec)
+            pspec = default_predictor(pspec)
+            validate_predictor(pspec)
+            app = EngineApp(pspec)
+            app.start_readiness_loop()
+            tasks = []
+            if self.open_ports:
+                spec.http_port = spec.http_port or free_port()
+                tasks.append(
+                    asyncio.create_task(
+                        app.rest_app().serve_forever("127.0.0.1", spec.http_port)
+                    )
+                )
+            grpc_server = None
+            if self.open_ports and self.grpc:
+                spec.grpc_port = spec.grpc_port or free_port()
+                grpc_server = app.grpc_server()
+                grpc_server.add_insecure_port(f"127.0.0.1:{spec.grpc_port}")
+                await grpc_server.start()
+            # probe the graph directly rather than app.graph_ready — the
+            # cached flag initializes True before the first poll, which would
+            # make the reconciler's rolling-update readiness gate vacuous
+            handle = _InProcessHandle(spec, tasks, lambda: app.executor.ready(), grpc_server)
+            handle.app = app
+            return handle
+
+        if spec.kind in ("microservice", "explainer"):
+            from ..microservice import build_user_object
+            from ..wrapper import ServerState, get_rest_microservice
+            import json as _json
+
+            user_object = build_user_object(
+                spec.interface_name, _json.dumps(spec.parameters or [])
+            )
+            if hasattr(user_object, "load"):
+                await asyncio.get_running_loop().run_in_executor(None, user_object.load)
+            state = ServerState()
+            rest = get_rest_microservice(user_object, state)
+            tasks = []
+            if self.open_ports:
+                spec.http_port = spec.http_port or free_port()
+                tasks.append(
+                    asyncio.create_task(rest.serve_forever("127.0.0.1", spec.http_port))
+                )
+            handle = _InProcessHandle(spec, tasks, lambda: state.ready)
+            handle.user_object = user_object
+            return handle
+
+        raise ValueError(f"unknown component kind {spec.kind!r}")
+
+
+class _SubprocessHandle(ComponentHandle):
+    def __init__(self, spec: ComponentSpec, proc: subprocess.Popen):
+        super().__init__(spec)
+        self.proc = proc
+
+    async def ready(self) -> bool:
+        if self.proc.poll() is not None:
+            return False
+
+        def probe() -> bool:
+            try:
+                with urllib.request.urlopen(f"{self.url}/ready", timeout=1.0) as r:
+                    return r.status == 200
+            except Exception:
+                return False
+
+        return await asyncio.get_running_loop().run_in_executor(None, probe)
+
+    async def stop(self) -> None:
+        # graceful drain first (reference preStop: curl /pause; sleep —
+        # operator/controllers/seldondeployment_engine.go:173-177)
+        def drain():
+            try:
+                urllib.request.urlopen(f"{self.url}/pause", timeout=0.5).read()
+            except Exception:
+                pass
+
+        await asyncio.get_running_loop().run_in_executor(None, drain)
+        self.proc.terminate()
+        try:
+            await asyncio.get_running_loop().run_in_executor(None, self.proc.wait, 5)
+        except Exception:
+            self.proc.kill()
+
+
+class SubprocessRuntime:
+    """One OS process per component (the multi-process production mode)."""
+
+    def __init__(self, python: str = sys.executable):
+        self.python = python
+
+    async def start(self, spec: ComponentSpec) -> ComponentHandle:
+        import base64
+        import json as _json
+
+        spec.http_port = spec.http_port or free_port()
+        env = {**os.environ, **spec.env}
+        # scope persisted component state per deployment/predictor
+        # (persistence.state_key reads these — reference: persistence.py:21)
+        env.setdefault("SELDON_DEPLOYMENT_ID", spec.deployment.replace("/", "-"))
+        env.setdefault("PREDICTOR_ID", spec.predictor)
+        if spec.kind == "engine":
+            env["ENGINE_PREDICTOR"] = base64.b64encode(
+                _json.dumps(spec.engine_spec).encode()
+            ).decode()
+            cmd = [
+                self.python, "-m", "seldon_core_tpu.engine_main",
+                "--host", "127.0.0.1",
+                "--http-port", str(spec.http_port),
+                "--no-grpc",
+            ]
+        else:
+            env["PREDICTIVE_UNIT_PARAMETERS"] = _json.dumps(spec.parameters or [])
+            env["PREDICTIVE_UNIT_SERVICE_PORT"] = str(spec.http_port)
+            cmd = [
+                self.python, "-m", "seldon_core_tpu.microservice",
+                spec.interface_name, "REST",
+                "--host", "127.0.0.1",
+                "--service-port", str(spec.http_port),
+            ]
+        proc = subprocess.Popen(cmd, env=env)
+        return _SubprocessHandle(spec, proc)

@@ -91,6 +91,7 @@ class HTTPServer:
     def __init__(self, name: str = "http"):
         self.name = name
         self.routes: Dict[str, Handler] = {}
+        self.prefix_routes: Dict[str, Handler] = {}
         self._server: Optional[asyncio.AbstractServer] = None
 
     def route(self, path: str):
@@ -103,8 +104,17 @@ class HTTPServer:
     def add_route(self, path: str, fn: Handler) -> None:
         self.routes[path] = fn
 
+    def add_prefix_route(self, prefix: str, fn: Handler) -> None:
+        """Route every path under `prefix` (longest prefix wins)."""
+        self.prefix_routes[prefix] = fn
+
     async def _dispatch(self, req: Request) -> Response:
         handler = self.routes.get(req.path)
+        if handler is None and self.prefix_routes:
+            for prefix in sorted(self.prefix_routes, key=len, reverse=True):
+                if req.path.startswith(prefix):
+                    handler = self.prefix_routes[prefix]
+                    break
         if handler is None:
             return Response({"status": {"info": f"no route {req.path}", "code": 404, "status": "FAILURE"}}, 404)
         try:

@@ -102,10 +102,28 @@ class PersistenceThread(threading.Thread):
         self.push_frequency = float(push_frequency)
         self._stop_event = threading.Event()
 
+    def _push(self) -> None:
+        # components that mutate state on the request thread can expose a
+        # `_state_lock` (threading.Lock) to get a consistent snapshot; without
+        # one, retry the handful of races pickling a live dict can raise
+        lock = getattr(self.user_object, "_state_lock", None)
+        if lock is not None:
+            with lock:
+                persist(self.user_object, self.store_dir, self.key)
+            return
+        for attempt in range(3):
+            try:
+                persist(self.user_object, self.store_dir, self.key)
+                return
+            except RuntimeError:  # "dictionary changed size during iteration"
+                if attempt == 2:
+                    raise
+                time.sleep(0.01)
+
     def run(self) -> None:
         while not self._stop_event.wait(self.push_frequency):
             try:
-                persist(self.user_object, self.store_dir, self.key)
+                self._push()
             except Exception:  # keep serving even if a push fails
                 logger.exception("persistence push failed")
 
