@@ -116,10 +116,14 @@ def main(argv=None) -> None:
 async def _rescan_loop(store: ResourceStore, persist_dir: str, period_s: float) -> None:
     """Pick up applies/deletes written by other sdctl processes: re-read the
     persist dir and diff against the in-memory view."""
+    loop = asyncio.get_running_loop()
     while True:
         await asyncio.sleep(period_s)
         try:
-            fresh = ResourceStore(persist_dir=persist_dir)
+            # file parsing off the loop so big stores don't stall the gateway
+            fresh = await loop.run_in_executor(
+                None, lambda: ResourceStore(persist_dir=persist_dir)
+            )
         except Exception:
             continue
         fresh_keys = {d.key for d in fresh.list()}

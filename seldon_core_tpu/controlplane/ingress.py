@@ -121,6 +121,20 @@ class Gateway:
             if path.endswith("/predictions") or path == "/predict":
                 return await app.predict(payload)
             raise LookupError(f"no engine route {path}")
+        user_object = getattr(handle, "user_object", None)
+        if user_object is not None:
+            # no-engine mode: the routable component is a bare model
+            from .. import seldon_methods
+
+            if path.endswith("/feedback") or path.endswith("/send-feedback"):
+                fn = seldon_methods.send_feedback
+            elif path.endswith("/predictions") or path == "/predict":
+                fn = seldon_methods.predict
+            else:
+                raise LookupError(f"no model route {path}")
+            return await asyncio.get_running_loop().run_in_executor(
+                None, fn, user_object, payload
+            )
 
         def do_post():
             import urllib.request

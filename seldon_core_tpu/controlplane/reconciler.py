@@ -20,6 +20,7 @@ import logging
 from typing import Dict, List, Optional, Tuple
 
 from ..graph.spec import (
+    GraphSpecError,
     PREPACKAGED_SERVERS,
     PredictorSpec,
     default_predictor,
@@ -88,9 +89,21 @@ class DeploymentController:
                 None, Storage.download, unit.model_uri, out_dir
             )
 
+    @staticmethod
+    def _component_hash(dep: SeldonDeployment) -> str:
+        """Spec hash extended with annotations: annotation flips (e.g.
+        separate-engine) must produce new component names so running
+        engines are replaced, not half-updated."""
+        import hashlib
+        import json as _json
+
+        blob = dep.spec_hash() + _json.dumps(dep.annotations, sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
     async def desired_components(self, dep: SeldonDeployment) -> List[ComponentSpec]:
         specs: List[ComponentSpec] = []
-        h = dep.spec_hash()
+        h = self._component_hash(dep)
+        no_engine = dep.annotations.get(ANNOTATION_NO_ENGINE, "false") == "true"
         for pspec in dep.predictors:
             separate = dep.annotations.get(ANNOTATION_SEPARATE_ENGINE, "false") == "true"
             pspec = default_predictor(pspec, separate_pods=False)
@@ -122,6 +135,38 @@ class DeploymentController:
                                 ],
                             )
                         )
+            if no_engine:
+                # no-engine mode: expose the single graph node directly, no
+                # orchestrator hop (reference: seldon.io/no-engine annotation,
+                # seldondeployment_types.go:43-45). Only single-node graphs
+                # qualify — deeper graphs need the engine walk.
+                root = pspec.graph
+                if root.children:
+                    raise GraphSpecError(
+                        f"{pspec.name}: {ANNOTATION_NO_ENGINE} requires a single-node graph"
+                    )
+                if root.implementation not in PREPACKAGED_SERVERS:
+                    raise GraphSpecError(
+                        f"{pspec.name}: {ANNOTATION_NO_ENGINE} needs a prepackaged "
+                        f"implementation, got {root.implementation!r}"
+                    )
+                for replica in range(max(1, pspec.replicas)):
+                    specs.append(
+                        ComponentSpec(
+                            name=f"{dep.key}/{pspec.name}/{replica}/model-{h[:8]}",
+                            kind="microservice",
+                            deployment=dep.key,
+                            predictor=pspec.name,
+                            replica=replica,
+                            routable=True,
+                            interface_name=PREPACKAGED_SERVERS[root.implementation],
+                            parameters=[
+                                {"name": "model_uri", "value": root.model_uri, "type": "STRING"},
+                                *[p.to_dict() for p in root.parameters],
+                            ],
+                        )
+                    )
+                continue
             for replica in range(max(1, pspec.replicas)):
                 name = f"{dep.key}/{pspec.name}/{replica}/engine-{h[:8]}"
                 specs.append(
@@ -131,6 +176,7 @@ class DeploymentController:
                         deployment=dep.key,
                         predictor=pspec.name,
                         replica=replica,
+                        routable=True,
                         engine_spec=pspec.to_dict(),
                     )
                 )
@@ -171,6 +217,11 @@ class DeploymentController:
             status.predictor_status = []
             dep.status = status
             self.store.update_status(dep)
+            if self.gateway is not None:
+                # routes must track what actually survives a failed
+                # reconcile (e.g. the recreate fallback tore the old
+                # generation down) — never leave stale handles routable
+                self.gateway.set_routes(dep, self._routable_endpoints(dep))
             return status
 
         try:
@@ -243,7 +294,7 @@ class DeploymentController:
                 if (
                     handle.spec.deployment == dep.key
                     and handle.spec.predictor == pspec.name
-                    and handle.spec.kind == "engine"
+                    and handle.spec.routable
                     and await handle.ready()
                 ):
                     avail += 1
@@ -258,7 +309,7 @@ class DeploymentController:
         dep.status = status
         self.store.update_status(dep)
         if self.gateway is not None:
-            self.gateway.set_routes(dep, self._engine_endpoints(dep))
+            self.gateway.set_routes(dep, self._routable_endpoints(dep))
         return status
 
     def _allocate_blocks(self, dep: SeldonDeployment, desired: List[ComponentSpec]) -> None:
@@ -286,10 +337,10 @@ class DeploymentController:
             if spec.name not in keep and spec.name not in self.components:
                 self.placement.release(spec.name)
 
-    def _engine_endpoints(self, dep: SeldonDeployment) -> Dict[str, List[ComponentHandle]]:
+    def _routable_endpoints(self, dep: SeldonDeployment) -> Dict[str, List[ComponentHandle]]:
         out: Dict[str, List[ComponentHandle]] = {}
         for name, (handle, _) in self.components.items():
-            if handle.spec.deployment == dep.key and handle.spec.kind == "engine":
+            if handle.spec.deployment == dep.key and handle.spec.routable:
                 out.setdefault(handle.spec.predictor, []).append(handle)
         return out
 

@@ -56,6 +56,9 @@ class ComponentSpec:
     env: Dict[str, str] = field(default_factory=dict)
     http_port: int = 0
     grpc_port: int = 0
+    # routable components receive external traffic from the gateway:
+    # engines, and direct-exposed models in no-engine mode
+    routable: bool = False
 
 
 class ComponentHandle:

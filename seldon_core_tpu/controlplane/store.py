@@ -45,18 +45,18 @@ class ResourceStore:
             try:
                 with open(os.path.join(self._persist_dir, fn)) as f:
                     d = json.load(f)
-            except (json.JSONDecodeError, OSError) as e:
-                # a torn write must not brick the whole control plane
+                dep = SeldonDeployment.from_dict(d)
+                dep.generation = (d.get("metadata") or {}).get("generation", 1)
+                if "status" in d:
+                    from .resource import DeploymentStatus
+
+                    dep.status = DeploymentStatus.from_dict(d["status"])
+            except Exception as e:  # noqa: BLE001 - a torn write or schema
+                # drift in one file must not brick the whole control plane
                 import logging
 
-                logging.getLogger(__name__).warning("skipping corrupt %s: %s", fn, e)
+                logging.getLogger(__name__).warning("skipping unreadable %s: %s", fn, e)
                 continue
-            dep = SeldonDeployment.from_dict(d)
-            dep.generation = (d.get("metadata") or {}).get("generation", 1)
-            if "status" in d:
-                from .resource import DeploymentStatus
-
-                dep.status = DeploymentStatus.from_dict(d["status"])
             self._items[dep.key] = dep
 
     def _persist(self, dep: SeldonDeployment) -> None:

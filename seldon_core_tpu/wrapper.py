@@ -58,6 +58,7 @@ def get_rest_microservice(user_object, state: Optional[ServerState] = None) -> H
 
     app.add_route("/predict", endpoint(seldon_methods.predict))
     app.add_route("/api/v1.0/predictions", endpoint(seldon_methods.predict))
+    app.add_route("/api/v0.1/predictions", endpoint(seldon_methods.predict))
     app.add_route("/transform-input", endpoint(seldon_methods.transform_input))
     app.add_route("/transform-output", endpoint(seldon_methods.transform_output))
     app.add_route("/route", endpoint(seldon_methods.route))

@@ -1,0 +1,254 @@
+"""Client SDK + contract tester tests (reference test model:
+python/tests/test_seldon_client.py + microservice_tester contract
+fixtures under python/tests/resources/)."""
+
+import asyncio
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.client import SeldonClient
+from seldon_core_tpu.tester import (
+    ContractError,
+    generate_batch,
+    generate_contract_from_data,
+    run_contract_test,
+    unfold_contract,
+    validate_response,
+)
+from seldon_core_tpu.user_model import SeldonComponent
+from seldon_core_tpu.wrapper import get_grpc_server, get_rest_microservice
+
+from _net import free_port
+
+CONTRACT = {
+    "features": [
+        {"name": "sepal_length", "ftype": "continuous", "dtype": "FLOAT", "range": [4, 8]},
+        {"name": "petal", "ftype": "continuous", "dtype": "FLOAT", "repeat": 2, "range": [0, 3]},
+    ],
+    "targets": [
+        {"name": "proba", "ftype": "continuous", "dtype": "FLOAT", "range": [0, 1], "shape": [3]}
+    ],
+}
+
+
+class Proba(SeldonComponent):
+    def predict(self, X, names, meta=None):
+        X = np.asarray(X, dtype=float)
+        z = np.abs(X[:, :1]) + 1.0
+        out = np.concatenate([0.2 * np.ones_like(z), 0.3 * np.ones_like(z), 0.5 * np.ones_like(z)], axis=1)
+        return out
+
+    def aggregate(self, features_list, names_list, meta_list=None):
+        return np.mean([np.asarray(f, dtype=float) for f in features_list], axis=0)
+
+    def send_feedback(self, features, names, reward, truth, routing=None):
+        self.last_reward = reward
+        return []
+
+
+@pytest.fixture(scope="module")
+def microservice_endpoint():
+    port, gport = free_port(), free_port()
+    obj = Proba()
+    app = get_rest_microservice(obj)
+    loop = asyncio.new_event_loop()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(app.serve_forever("127.0.0.1", port))
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    server = get_grpc_server(obj)
+    server.add_insecure_port(f"127.0.0.1:{gport}")
+    server.start()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.2).close()
+            break
+        except OSError:
+            time.sleep(0.02)
+    yield f"127.0.0.1:{port}", f"127.0.0.1:{gport}"
+    server.stop(grace=0)
+    loop.call_soon_threadsafe(loop.stop)
+
+
+# -- contract machinery -----------------------------------------------------
+
+
+def test_unfold_contract_repeat():
+    c = unfold_contract(CONTRACT)
+    assert [f["name"] for f in c["features"]] == ["sepal_length", "petal1", "petal2"]
+    assert "repeat" not in c["features"][1]
+
+
+def test_generate_batch_shapes_and_ranges():
+    c = unfold_contract(CONTRACT)
+    batch = generate_batch(c, 8, seed=0)
+    assert batch.shape == (8, 3)
+    assert batch[:, 0].min() >= 4 and batch[:, 0].max() <= 8
+    assert batch[:, 1:].min() >= 0 and batch[:, 1:].max() <= 3
+
+
+def test_generate_batch_categorical_mixed():
+    c = {"features": [
+        {"name": "color", "ftype": "categorical", "dtype": "STRING", "values": ["r", "g"]},
+        {"name": "x", "ftype": "continuous", "dtype": "FLOAT", "range": [0, 1]},
+    ], "targets": []}
+    batch = generate_batch(c, 4, seed=1)
+    assert batch.dtype == object
+    assert set(batch[:, 0]) <= {"r", "g"}
+    with pytest.raises(ContractError):
+        generate_batch({"features": [{"name": "bad", "ftype": "nope"}]}, 1)
+
+
+def test_validate_response():
+    c = unfold_contract(CONTRACT)
+    good = {"data": {"ndarray": [[0.2, 0.3, 0.5]]}}
+    assert validate_response(c, good) == []
+    bad_width = {"data": {"ndarray": [[0.2, 0.3]]}}
+    assert any("width" in p for p in validate_response(c, bad_width))
+    bad_range = {"data": {"ndarray": [[0.2, 0.3, 1.5]]}}
+    assert any("outside" in p for p in validate_response(c, bad_range))
+    assert validate_response(c, {}) == ["response has no data block"]
+
+
+def test_generate_contract_from_data():
+    X = np.array([[1.5, 0.5], [3.0, 0.7]])
+    c = generate_contract_from_data(X, names=["a", "b"])
+    assert c["features"][0] == {
+        "name": "a", "ftype": "continuous", "dtype": "FLOAT", "range": [1.5, 3.0]
+    }
+    c_int = generate_contract_from_data(np.array([[1], [3]]), names=["n"])
+    assert c_int["features"][0]["dtype"] == "INT"
+    mixed = np.array([["r", 1.0], ["g", 2.0]], dtype=object)
+    c = generate_contract_from_data(mixed)
+    assert c["features"][0]["ftype"] == "categorical"
+    assert sorted(c["features"][0]["values"]) == ["g", "r"]
+
+
+# -- client against a live microservice ------------------------------------
+
+
+def test_client_microservice_rest(microservice_endpoint):
+    rest, _ = microservice_endpoint
+    client = SeldonClient(microservice_endpoint=rest)
+    resp = client.microservice(np.array([[5.0, 1.0, 1.0]]), names=["a", "b", "c"])
+    assert resp.success
+    np.testing.assert_allclose(resp.data, [[0.2, 0.3, 0.5]])
+
+
+def test_client_microservice_grpc(microservice_endpoint):
+    _, grpc_ep = microservice_endpoint
+    client = SeldonClient(microservice_endpoint=grpc_ep, transport="grpc")
+    resp = client.microservice(np.array([[5.0, 1.0, 1.0]]))
+    assert resp.success
+    np.testing.assert_allclose(resp.data, [[0.2, 0.3, 0.5]])
+
+
+def test_client_connection_refused_is_graceful():
+    client = SeldonClient(microservice_endpoint="127.0.0.1:1", timeout_s=0.5)
+    resp = client.microservice(np.array([[1.0]]))
+    assert not resp.success and resp.msg
+
+
+def test_client_aggregate_rest_and_grpc(microservice_endpoint):
+    rest, grpc_ep = microservice_endpoint
+    batches = [np.array([[1.0, 2.0]]), np.array([[3.0, 4.0]])]
+    for ep, transport in ((rest, "rest"), (grpc_ep, "grpc")):
+        client = SeldonClient(microservice_endpoint=ep, transport=transport)
+        resp = client.microservice(batches, method="aggregate")
+        assert resp.success, resp.msg
+        np.testing.assert_allclose(resp.data, [[2.0, 3.0]])
+
+
+def test_client_payload_types(microservice_endpoint):
+    rest, _ = microservice_endpoint
+    for ptype in ("ndarray", "tensor", "raw"):
+        client = SeldonClient(microservice_endpoint=rest, payload_type=ptype)
+        resp = client.microservice(np.array([[5.0, 1.0, 1.0]]))
+        assert resp.success, (ptype, resp.msg)
+        assert resp.data.shape == (1, 3)
+
+
+# -- contract tester end-to-end --------------------------------------------
+
+
+def test_contract_fuzz_microservice(microservice_endpoint):
+    rest, _ = microservice_endpoint
+    client = SeldonClient(microservice_endpoint=rest)
+    summary = run_contract_test(client, CONTRACT, n_requests=5, batch_size=4, seed=0)
+    assert summary["ok"] == 5 and summary["failed"] == 0, summary
+
+
+def test_contract_feedback_microservice(microservice_endpoint):
+    rest, _ = microservice_endpoint
+    client = SeldonClient(microservice_endpoint=rest)
+    summary = run_contract_test(
+        client, CONTRACT, n_requests=2, endpoint="send-feedback", seed=0
+    )
+    assert summary["failed"] == 0, summary
+
+
+def test_tester_cli(microservice_endpoint, tmp_path, capsys):
+    rest, _ = microservice_endpoint
+    host, port = rest.split(":")
+    cpath = tmp_path / "contract.json"
+    cpath.write_text(json.dumps(CONTRACT))
+    from seldon_core_tpu.tester import main
+
+    main([str(cpath), host, port, "-n", "2", "-b", "2", "--seed", "0"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["ok"] == 2
+
+
+# -- client against engine + gateway ---------------------------------------
+
+
+def test_client_external_engine_and_gateway():
+    async def go():
+        from seldon_core_tpu.controlplane import (
+            DeploymentController,
+            Gateway,
+            ResourceStore,
+            SeldonDeployment,
+        )
+        from seldon_core_tpu.controlplane.runtime import InProcessRuntime
+
+        store = ResourceStore()
+        gw = Gateway(seed=3)
+        ctl = DeploymentController(store, runtime=InProcessRuntime(), gateway=gw)
+        dep = SeldonDeployment.from_dict(
+            {"name": "cl", "predictors": [
+                {"name": "main", "graph": {"name": "clf", "implementation": "SIMPLE_MODEL"}}]}
+        )
+        store.apply(dep)
+        await ctl.reconcile(dep.clone())
+        gw_port = free_port()
+        gw_task = asyncio.create_task(gw.app().serve_forever("127.0.0.1", gw_port))
+        await asyncio.sleep(0.1)
+
+        engine_port = next(iter(ctl.components.values()))[0].spec.http_port
+
+        def drive():
+            ec = SeldonClient(engine_endpoint=f"127.0.0.1:{engine_port}")
+            r1 = ec.predict(np.array([[1.0, 2.0]]))
+            gc = SeldonClient(deployment_name="cl", gateway_endpoint=f"127.0.0.1:{gw_port}")
+            r2 = gc.predict(np.array([[1.0, 2.0]]))
+            r3 = gc.feedback(r2.request, r2.response, reward=1.0)
+            return r1, r2, r3
+
+        r1, r2, r3 = await asyncio.get_running_loop().run_in_executor(None, drive)
+        assert r1.success and r1.data.shape == (1, 3)
+        assert r2.success and r2.meta.get("puid")
+        assert r3.success
+        gw_task.cancel()
+        await ctl.shutdown()
+
+    asyncio.run(go())

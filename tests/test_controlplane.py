@@ -434,6 +434,87 @@ def test_gateway_form_encoded_body_and_unknown_path():
     run(go())
 
 
+def test_annotation_flip_replaces_components():
+    async def go():
+        store = ResourceStore()
+        ctl = DeploymentController(store, runtime=InProcessRuntime(open_ports=False))
+        dep, _ = store.apply(simple_dep())
+        await ctl.reconcile(dep.clone())
+        before = set(ctl.components)
+        dep2 = simple_dep()
+        dep2.annotations["seldon.io/some-flag"] = "true"
+        applied, event = store.apply(dep2)
+        assert event == "MODIFIED"
+        await ctl.reconcile(applied.clone())
+        # annotation change must produce new component names (full restart)
+        assert set(ctl.components) and set(ctl.components) != before
+
+    run(go())
+
+
+def test_no_engine_mode_exposes_model_directly(tmp_path):
+    import joblib
+    from sklearn.linear_model import LogisticRegression
+
+    X = [[0.0, 0.0], [1.0, 1.0], [0.0, 1.0], [1.0, 0.0]]
+    y = [0, 1, 0, 1]
+    joblib.dump(LogisticRegression().fit(X, y), tmp_path / "model.joblib")
+
+    async def go():
+        store = ResourceStore()
+        gw = Gateway(seed=5)
+        ctl = DeploymentController(store, runtime=InProcessRuntime(open_ports=False), gateway=gw)
+        dep = SeldonDeployment.from_dict(
+            {
+                "name": "ne",
+                "annotations": {"seldon.io/no-engine": "true"},
+                "predictors": [
+                    {"name": "p0", "graph": {"name": "m", "implementation": "SKLEARN_SERVER",
+                                             "modelUri": str(tmp_path)}}
+                ],
+            }
+        )
+        store.apply(dep)
+        status = await ctl.reconcile(dep.clone())
+        assert status.state == STATE_AVAILABLE, status.description
+        assert all(h.spec.kind == "microservice" for h, _ in ctl.components.values())
+        from seldon_core_tpu.http_server import Request
+
+        app = gw.app()
+        body = json.dumps({"data": {"ndarray": [[1.0, 1.0]]}}).encode()
+        req = Request("POST", "/seldon/default/ne/api/v0.1/predictions", "",
+                      {"content-type": "application/json"}, body)
+        resp = await app._dispatch(req)
+        assert resp.status == 200, resp.body
+        out = json.loads(resp.body)
+        assert "data" in out
+
+        # multi-node graph rejects no-engine
+        bad = SeldonDeployment.from_dict(
+            {
+                "name": "ne2",
+                "annotations": {"seldon.io/no-engine": "true"},
+                "predictors": [
+                    {"name": "p0", "graph": {"name": "r", "implementation": "SIMPLE_ROUTER",
+                                             "children": [{"name": "a", "implementation": "SIMPLE_MODEL"}]}}
+                ],
+            }
+        )
+        status = await ctl.reconcile(bad)
+        assert status.state == STATE_FAILED and "single-node" in status.description
+
+    run(go())
+
+
+def test_store_load_skips_bad_files(tmp_path):
+    store = ResourceStore(persist_dir=str(tmp_path))
+    store.apply(simple_dep())
+    (tmp_path / "torn.json").write_text('{"name": "x", "predi')
+    (tmp_path / "schema_drift.json").write_text('{"name": "y", "predictors": []}')
+    store2 = ResourceStore(persist_dir=str(tmp_path))  # must not raise
+    assert [d.name for d in store2.list()] == ["dep"]
+
+
 def test_reconcile_with_placement_insufficient_devices():
     async def go():
         devs = [FakeDevice(i, 0) for i in range(2)]
