@@ -33,6 +33,10 @@ def main(argv=None) -> None:
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
 
+    from .tracing import init_tracer
+
+    init_tracer("seldon-tpu-engine")  # enabled iff TRACING env set
+
     if args.spec:
         with open(args.spec) as f:
             spec = PredictorSpec.from_dict(json.load(f))

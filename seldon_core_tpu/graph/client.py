@@ -92,12 +92,19 @@ class RestClient(UnitClient):
         return await asyncio.open_connection(self.host, self.port, limit=64 * 1024 * 1024)
 
     async def _request(self, path: str, body: bytes) -> Dict[str, Any]:
+        from ..tracing import get_tracer
+
         reader, writer = await self._connection()
         pooled = False
         try:
+            # propagate the active span across the process hop (reference:
+            # TracingRestTemplateInterceptor, InternalPredictionService.java:141-144)
+            trace_headers = get_tracer().inject({})
+            extra = "".join(f"{k}: {v}\r\n" for k, v in trace_headers.items())
             head = (
                 f"POST {path} HTTP/1.1\r\nHost: {self.host}\r\n"
-                f"Content-Type: application/json\r\nContent-Length: {len(body)}\r\n\r\n"
+                f"Content-Type: application/json\r\nContent-Length: {len(body)}\r\n"
+                f"{extra}\r\n"
             ).encode()
             writer.write(head + body)
             await writer.drain()

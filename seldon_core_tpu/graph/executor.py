@@ -177,7 +177,16 @@ class GraphExecutor:
         return out
 
     async def _call(self, rt: UnitRuntime, method: str, message, ctx: RequestCtx):
-        response = await rt.client.call(method, message)
+        from ..tracing import get_tracer
+
+        # span per graph hop (reference: async span re-activation,
+        # PredictiveUnitBean.java:85-118)
+        with get_tracer().span(
+            f"{rt.name}.{method}",
+            tags={"unit": rt.name, "method": method,
+                  "transport": rt.unit.endpoint.transport},
+        ):
+            response = await rt.client.call(method, message)
         ctx.absorb(rt.name, response)
         return response
 

@@ -76,11 +76,17 @@ class EngineApp:
 
     # -- core entrypoints (shared by REST and gRPC fronts) ------------------
 
-    async def predict(self, message: Dict[str, Any]) -> Dict[str, Any]:
+    async def predict(self, message: Dict[str, Any],
+                      headers: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+        from ..tracing import get_tracer
+
         t0 = time.perf_counter()
         labels = {"deployment": self.spec.name}
         try:
-            out = await self.executor.predict(message)
+            with get_tracer().span(
+                "predictions", tags={"deployment": self.spec.name}, headers=headers
+            ):
+                out = await self.executor.predict(message)
         except UnitCallError as e:
             self.metrics.counter_inc("seldon_api_engine_server_errors", labels)
             raise
@@ -127,7 +133,7 @@ class EngineApp:
             if body is None:
                 return Response(error_body(400, "empty request body"), 400)
             try:
-                return Response(await self.predict(body))
+                return Response(await self.predict(body, headers=req.headers))
             except UnitCallError as e:
                 return Response(error_body(e.status, e.info), e.status)
 
@@ -159,6 +165,11 @@ class EngineApp:
         async def prometheus(req: Request) -> Response:
             return Response(self.metrics.expose(), content_type="text/plain; version=0.0.4")
 
+        async def traces(req: Request) -> Response:
+            from ..tracing import get_tracer
+
+            return Response(get_tracer().export_jaeger())
+
         app.add_route("/api/v0.1/predictions", predictions)
         app.add_route("/api/v1.0/predictions", predictions)
         app.add_route("/predict", predictions)
@@ -171,6 +182,7 @@ class EngineApp:
         app.add_route("/unpause", unpause)
         app.add_route("/metrics", prometheus)
         app.add_route("/prometheus", prometheus)
+        app.add_route("/traces", traces)
         return app
 
     # -- gRPC front ---------------------------------------------------------

@@ -120,6 +120,10 @@ def main(argv=None) -> None:
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
 
+    from .tracing import init_tracer
+
+    init_tracer(args.interface_name.rsplit(".", 1)[-1])  # enabled iff TRACING env
+
     persistence_thread = None
     if args.persistence:
         from seldon_core_tpu import persistence

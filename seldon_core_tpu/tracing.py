@@ -1,0 +1,241 @@
+"""Distributed tracing: per-hop spans + JAX device-trace hooks.
+
+Parity with the reference's Jaeger/OpenTracing wiring (reference: engine
+TracingProvider + span re-activation across async graph hops
+PredictiveUnitBean.java:85-118, outbound header injection
+InternalPredictionService.java:141-144, Python wrapper jaeger setup
+python/seldon_core/microservice.py:116-151). The image has no jaeger
+client, so spans are collected in-process and exported in Jaeger-JSON
+shape (loadable in the Jaeger UI); propagation uses the Jaeger
+``uber-trace-id`` header format so traces stitch across engine →
+microservice process hops.
+
+TPU deltas: ``device_trace`` wraps ``jax.profiler.TraceAnnotation`` so a
+span's name shows up inside XLA device profiles, and
+``start_device_profile``/``stop_device_profile`` expose the JAX profiler
+(TensorBoard-loadable) for the hot path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+TRACE_HEADER = "uber-trace-id"  # trace_id:span_id:parent_span_id:flags
+BAGGAGE_PREFIX = "uberctx-"
+
+_current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "seldon_tpu_span", default=None
+)
+
+
+def _rand_id() -> str:
+    return f"{random.getrandbits(64):016x}"
+
+
+@dataclass
+class Span:
+    operation: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str] = None
+    start_us: int = 0
+    duration_us: int = 0
+    tags: Dict[str, Any] = field(default_factory=dict)
+    logs: List[Dict[str, Any]] = field(default_factory=list)
+
+    def set_tag(self, key: str, value: Any) -> "Span":
+        self.tags[key] = value
+        return self
+
+    def log(self, **fields) -> None:
+        self.logs.append({"timestamp": int(time.time() * 1e6), "fields": fields})
+
+    def context_header(self) -> str:
+        return f"{self.trace_id}:{self.span_id}:{self.parent_id or '0'}:1"
+
+
+class Tracer:
+    """In-process span collector with contextvar activation."""
+
+    def __init__(self, service_name: str = "seldon-tpu", max_spans: int = 4096,
+                 enabled: bool = True):
+        self.service_name = service_name
+        self.enabled = enabled
+        self._spans: deque = deque(maxlen=max_spans)
+        self._lock = threading.Lock()
+
+    # -- span lifecycle -----------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, operation: str, tags: Optional[Dict[str, Any]] = None,
+             headers: Optional[Dict[str, str]] = None):
+        """Open a span as a child of (priority order) the extracted header
+        context or the currently active span; activate it for the body."""
+        if not self.enabled:
+            yield _NOOP_SPAN
+            return
+        parent = self.extract(headers) if headers and TRACE_HEADER in headers else _current_span.get()
+        s = Span(
+            operation=operation,
+            trace_id=parent.trace_id if parent else _rand_id(),
+            span_id=_rand_id(),
+            parent_id=parent.span_id if parent else None,
+            start_us=int(time.time() * 1e6),
+            tags=dict(tags or {}),
+        )
+        token = _current_span.set(s)
+        t0 = time.perf_counter()
+        try:
+            yield s
+        except Exception as e:
+            s.set_tag("error", True)
+            s.log(event="error", message=str(e))
+            raise
+        finally:
+            s.duration_us = int((time.perf_counter() - t0) * 1e6)
+            _current_span.reset(token)
+            with self._lock:
+                self._spans.append(s)
+
+    def active_span(self) -> Optional[Span]:
+        return _current_span.get()
+
+    # -- propagation --------------------------------------------------------
+
+    def inject(self, headers: Dict[str, str]) -> Dict[str, str]:
+        s = _current_span.get()
+        if s is not None and self.enabled:
+            headers[TRACE_HEADER] = s.context_header()
+        return headers
+
+    @staticmethod
+    def extract(headers: Dict[str, str]) -> Optional[Span]:
+        """Parse an incoming uber-trace-id into a remote parent stub."""
+        raw = headers.get(TRACE_HEADER) or headers.get(TRACE_HEADER.title())
+        if not raw:
+            return None
+        parts = raw.split(":")
+        if len(parts) != 4:
+            return None
+        return Span(operation="<remote>", trace_id=parts[0], span_id=parts[1],
+                    parent_id=None if parts[2] == "0" else parts[2])
+
+    # -- export -------------------------------------------------------------
+
+    def finished_spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def export_jaeger(self) -> Dict[str, Any]:
+        """Jaeger HTTP API JSON shape: {"data": [{traceID, spans, processes}]}."""
+        by_trace: Dict[str, List[Span]] = {}
+        for s in self.finished_spans():
+            by_trace.setdefault(s.trace_id, []).append(s)
+        data = []
+        for trace_id, spans in by_trace.items():
+            data.append(
+                {
+                    "traceID": trace_id,
+                    "spans": [
+                        {
+                            "traceID": s.trace_id,
+                            "spanID": s.span_id,
+                            "operationName": s.operation,
+                            "references": (
+                                [{"refType": "CHILD_OF", "traceID": s.trace_id,
+                                  "spanID": s.parent_id}] if s.parent_id else []
+                            ),
+                            "startTime": s.start_us,
+                            "duration": s.duration_us,
+                            "tags": [
+                                {"key": k, "type": "string", "value": str(v)}
+                                for k, v in s.tags.items()
+                            ],
+                            "logs": s.logs,
+                            "processID": "p1",
+                        }
+                        for s in spans
+                    ],
+                    "processes": {"p1": {"serviceName": self.service_name, "tags": []}},
+                }
+            )
+        return {"data": data}
+
+
+class _NoopSpan(Span):
+    def __init__(self):
+        super().__init__("noop", "0", "0")
+
+    def set_tag(self, key, value):
+        return self
+
+    def log(self, **fields):
+        pass
+
+
+_NOOP_SPAN = _NoopSpan()
+
+# -- global tracer (the reference reads JAEGER_* env in both wrapper and
+# engine; TRACING=1 gates setup — microservice.py:116-151) ------------------
+
+_GLOBAL: Optional[Tracer] = None
+
+
+def init_tracer(service_name: Optional[str] = None, enabled: Optional[bool] = None) -> Tracer:
+    global _GLOBAL
+    if enabled is None:
+        enabled = os.environ.get("TRACING", "0") not in ("0", "false", "")
+    _GLOBAL = Tracer(
+        service_name or os.environ.get("JAEGER_SERVICE_NAME", "seldon-tpu"),
+        enabled=enabled,
+    )
+    return _GLOBAL
+
+
+def get_tracer() -> Tracer:
+    global _GLOBAL
+    if _GLOBAL is None:
+        _GLOBAL = init_tracer()
+    return _GLOBAL
+
+
+# -- TPU device tracing -----------------------------------------------------
+
+
+@contextlib.contextmanager
+def device_trace(name: str):
+    """Annotate the enclosed device work so it shows up named inside XLA
+    profiles (TPU equivalent of the reference's span around the model call)."""
+    try:
+        import jax.profiler
+
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    except ImportError:  # pragma: no cover
+        yield
+
+
+def start_device_profile(logdir: str) -> None:
+    """TensorBoard-loadable XLA profile (reference equivalent: JMX :9090 +
+    testing/profiling/engine)."""
+    import jax.profiler
+
+    jax.profiler.start_trace(logdir)
+
+
+def stop_device_profile() -> None:
+    import jax.profiler
+
+    jax.profiler.stop_trace()

@@ -51,7 +51,15 @@ def get_rest_microservice(user_object, state: Optional[ServerState] = None) -> H
             body = req.json()
             if body is None and needs_body:
                 return Response(error_body(400, "empty request body"), 400)
-            out = await _sync(method_fn, user_object, body)
+            from .tracing import get_tracer
+
+            # server-side span stitched to the engine's via uber-trace-id
+            # (reference: FlaskTracer, microservice.py:274-283)
+            with get_tracer().span(
+                method_fn.__name__, tags={"component": type(user_object).__name__},
+                headers=req.headers,
+            ):
+                out = await _sync(method_fn, user_object, body)
             return Response(out)
 
         return handler

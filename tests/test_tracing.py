@@ -1,0 +1,173 @@
+"""Tracing tests: span tree, context propagation across engine graph hops
+and REST process boundaries, Jaeger export shape (reference behavior:
+engine TracingProvider + wrapper FlaskTracer, SURVEY §5)."""
+
+import asyncio
+import json
+import threading
+import time
+
+import numpy as np
+
+from seldon_core_tpu import tracing
+from seldon_core_tpu.graph.service import EngineApp
+from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
+from seldon_core_tpu.tracing import TRACE_HEADER, Tracer, get_tracer, init_tracer
+
+
+def test_span_nesting_and_collection():
+    t = Tracer("test", enabled=True)
+    with t.span("root", tags={"a": 1}) as root:
+        with t.span("child") as child:
+            child.log(event="work")
+        assert t.active_span() is root
+    spans = t.finished_spans()
+    assert [s.operation for s in spans] == ["child", "root"]
+    assert spans[0].trace_id == spans[1].trace_id
+    assert spans[0].parent_id == spans[1].span_id
+    assert spans[1].tags == {"a": 1}
+    assert spans[0].logs[0]["fields"] == {"event": "work"}
+
+
+def test_span_error_tagging():
+    t = Tracer(enabled=True)
+    try:
+        with t.span("boom"):
+            raise ValueError("nope")
+    except ValueError:
+        pass
+    s = t.finished_spans()[0]
+    assert s.tags["error"] is True
+    assert any(f["fields"].get("message") == "nope" for f in s.logs)
+
+
+def test_disabled_tracer_is_noop():
+    t = Tracer(enabled=False)
+    with t.span("x") as s:
+        s.set_tag("ignored", 1)
+    assert t.finished_spans() == []
+    assert t.inject({}) == {}
+
+
+def test_inject_extract_roundtrip():
+    t = Tracer(enabled=True)
+    with t.span("parent"):
+        headers = t.inject({})
+        assert TRACE_HEADER in headers
+    remote = Tracer.extract(headers)
+    parent = t.finished_spans()[0]
+    assert remote.trace_id == parent.trace_id
+    assert remote.span_id == parent.span_id
+    # malformed header is ignored
+    assert Tracer.extract({TRACE_HEADER: "garbage"}) is None
+    assert Tracer.extract({}) is None
+
+
+def test_header_continues_trace():
+    t = Tracer(enabled=True)
+    with t.span("server", headers={TRACE_HEADER: "aaaa:bbbb:0:1"}) as s:
+        assert s.trace_id == "aaaa"
+        assert s.parent_id == "bbbb"
+
+
+def test_jaeger_export_shape():
+    t = Tracer("svc", enabled=True)
+    with t.span("op", tags={"k": "v"}):
+        pass
+    out = t.export_jaeger()
+    trace = out["data"][0]
+    span = trace["spans"][0]
+    assert span["operationName"] == "op"
+    assert span["tags"] == [{"key": "k", "type": "string", "value": "v"}]
+    assert trace["processes"]["p1"]["serviceName"] == "svc"
+    json.dumps(out)  # serializable
+
+
+def test_engine_graph_spans():
+    """One request through a 2-level graph yields a stitched span tree."""
+    init_tracer("engine-test", enabled=True)
+    spec = default_predictor(
+        PredictorSpec.from_dict(
+            {
+                "name": "p",
+                "graph": {
+                    "name": "combiner",
+                    "implementation": "AVERAGE_COMBINER",
+                    "children": [
+                        {"name": "m1", "implementation": "SIMPLE_MODEL"},
+                        {"name": "m2", "implementation": "SIMPLE_MODEL"},
+                    ],
+                },
+            }
+        )
+    )
+    app = EngineApp(spec)
+    out = asyncio.run(app.predict({"data": {"ndarray": [[1.0, 2.0]]}}))
+    assert "data" in out
+    spans = get_tracer().finished_spans()
+    ops = {s.operation for s in spans}
+    assert {"predictions", "m1.predict", "m2.predict", "combiner.aggregate"} <= ops
+    root = next(s for s in spans if s.operation == "predictions")
+    assert all(s.trace_id == root.trace_id for s in spans)
+    hops = [s for s in spans if s.operation != "predictions"]
+    assert all(s.parent_id == root.span_id for s in hops)
+    init_tracer(enabled=False)  # don't leak into other tests
+
+
+def test_trace_crosses_rest_process_boundary():
+    """Engine → remote microservice over a real socket: microservice-side
+    spans continue the engine's trace via the injected header."""
+    from seldon_core_tpu.user_model import SeldonComponent
+    from seldon_core_tpu.wrapper import get_rest_microservice
+
+    from _net import free_port
+
+    class Doubler(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return np.asarray(X) * 2
+
+    tracer = init_tracer("xproc", enabled=True)
+    port = free_port()
+    ms_app = get_rest_microservice(Doubler())
+    loop = asyncio.new_event_loop()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(ms_app.serve_forever("127.0.0.1", port))
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(0.3)
+
+    spec = default_predictor(
+        PredictorSpec.from_dict(
+            {
+                "name": "p",
+                "graph": {
+                    "name": "remote",
+                    "type": "MODEL",
+                    "endpoint": {"service_host": "127.0.0.1",
+                                 "service_port": port, "transport": "REST"},
+                },
+            }
+        )
+    )
+    engine = EngineApp(spec)
+    out = asyncio.run(engine.predict({"data": {"ndarray": [[1.0]]}}))
+    assert out["data"]["ndarray"] == [[2.0]]
+    spans = tracer.finished_spans()
+    root = next(s for s in spans if s.operation == "predictions")
+    server_side = [s for s in spans if s.operation == "predict"]
+    assert server_side, [s.operation for s in spans]
+    # same trace id across the socket hop
+    assert server_side[0].trace_id == root.trace_id
+    loop.call_soon_threadsafe(loop.stop)
+    init_tracer(enabled=False)
+
+
+def test_device_trace_annotation_smoke():
+    import jax.numpy as jnp
+
+    with tracing.device_trace("matmul"):
+        x = jnp.ones((4, 4))
+        (x @ x).block_until_ready()
