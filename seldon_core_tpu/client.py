@@ -168,7 +168,11 @@ class SeldonClient:
         from .payload import json_to_proto, proto_to_json
         from .proto import prediction_pb2 as pb
 
-        endpoint = self.engine_endpoint or self.gateway_endpoint
+        endpoint = self.engine_endpoint
+        if not endpoint:
+            raise ValueError(
+                "gateway does not serve gRPC; set engine_endpoint for transport='grpc'"
+            )
         msg_cls = pb.Feedback if method == "SendFeedback" else pb.SeldonMessage
         with grpc.insecure_channel(endpoint) as channel:
             call = channel.unary_unary(

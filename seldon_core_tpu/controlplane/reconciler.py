@@ -272,6 +272,18 @@ class DeploymentController:
             return fail(f"component start failed: {e}")
 
         if ok:
+            # repoint the gateway at the new generation BEFORE tearing the
+            # old one down — otherwise there's a window where the route
+            # table still targets stopped components (502s under
+            # SubprocessRuntime), defeating create-before-delete
+            if self.gateway is not None:
+                self.gateway.set_routes(
+                    dep,
+                    {
+                        pred: [h for h in handles if h.spec.name in desired_names]
+                        for pred, handles in self._routable_endpoints(dep).items()
+                    },
+                )
             for name in mine - desired_names:
                 handle, _ = self.components.pop(name)
                 if self.placement is not None:

@@ -80,11 +80,19 @@ class ComponentHandle:
 
 
 class _InProcessHandle(ComponentHandle):
-    def __init__(self, spec: ComponentSpec, tasks: List[asyncio.Task], probe, grpc_server=None):
+    def __init__(
+        self,
+        spec: ComponentSpec,
+        tasks: List[asyncio.Task],
+        probe,
+        grpc_server=None,
+        app=None,
+    ):
         super().__init__(spec)
         self._tasks = tasks
         self._probe = probe
         self._grpc_server = grpc_server
+        self.app = app
 
     async def ready(self) -> bool:
         try:
@@ -98,12 +106,25 @@ class _InProcessHandle(ComponentHandle):
     async def stop(self) -> None:
         if self._grpc_server is not None:
             await self._grpc_server.stop(grace=0.1)
-        for t in self._tasks:
+        tasks = list(self._tasks)
+        # engine handles: the readiness poll loop and the executor's unit
+        # clients outlive the server tasks unless shut down here — leaking
+        # them keeps dead graphs polling forever across rolling updates
+        if self.app is not None:
+            ready_task = getattr(self.app, "_ready_task", None)
+            if ready_task is not None:
+                tasks.append(ready_task)
+        for t in tasks:
             t.cancel()
-        for t in self._tasks:
+        for t in tasks:
             try:
                 await t
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        if self.app is not None:
+            try:
+                await self.app.executor.close()
+            except Exception:  # noqa: BLE001
                 pass
 
 
@@ -144,8 +165,9 @@ class InProcessRuntime:
             # probe the graph directly rather than app.graph_ready — the
             # cached flag initializes True before the first poll, which would
             # make the reconciler's rolling-update readiness gate vacuous
-            handle = _InProcessHandle(spec, tasks, lambda: app.executor.ready(), grpc_server)
-            handle.app = app
+            handle = _InProcessHandle(
+                spec, tasks, lambda: app.executor.ready(), grpc_server, app=app
+            )
             return handle
 
         if spec.kind in ("microservice", "explainer"):

@@ -10,6 +10,8 @@ and a long-running controller share state.
 from __future__ import annotations
 
 import asyncio
+import contextlib
+import fcntl
 import json
 import os
 import threading
@@ -59,13 +61,31 @@ class ResourceStore:
                 continue
             self._items[dep.key] = dep
 
+    @staticmethod
+    @contextlib.contextmanager
+    def _file_lock(path: str):
+        """Cross-process exclusive lock scoped to one store file, so a CLI
+        ``apply`` and a controller status write serialize their
+        read-modify-write cycles instead of clobbering each other."""
+        with open(path + ".lock", "w") as lf:
+            fcntl.flock(lf, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(lf, fcntl.LOCK_UN)
+
+    @staticmethod
+    def _write_json(path: str, doc: dict) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2)
+        os.replace(tmp, path)  # atomic: readers never see a torn file
+
     def _persist(self, dep: SeldonDeployment) -> None:
         if self._persist_dir:
             path = self._path(dep.key)
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(dep.to_dict(), f, indent=2)
-            os.replace(tmp, path)  # atomic: readers never see a torn file
+            with self._file_lock(path):
+                self._write_json(path, dep.to_dict())
 
     def _unpersist(self, key: str) -> None:
         if self._persist_dir and os.path.exists(self._path(key)):
@@ -114,11 +134,33 @@ class ResourceStore:
         return True
 
     def update_status(self, dep: SeldonDeployment) -> None:
-        """Status-only write: no generation bump, no reconcile retrigger."""
+        """Status-only write: no generation bump, no reconcile retrigger.
+
+        Persists via read-merge-write of only the ``status`` field, under
+        the per-file flock, so a concurrent CLI ``apply`` that already
+        wrote a newer spec to the store file is not clobbered by an
+        in-flight reconcile's status rollup (the rescan would otherwise
+        see no diff and drop the apply).
+        """
         with self._lock:
-            if dep.key in self._items:
-                self._items[dep.key].status = dep.status
-                self._persist(self._items[dep.key])
+            if dep.key not in self._items:
+                return
+            self._items[dep.key].status = dep.status
+            if not self._persist_dir:
+                return
+            path = self._path(dep.key)
+            with self._file_lock(path):
+                doc = None
+                if os.path.exists(path):
+                    try:
+                        with open(path) as f:
+                            doc = json.load(f)
+                    except Exception:  # torn write: rewrite from memory
+                        doc = None
+                if doc is None:
+                    doc = self._items[dep.key].to_dict()
+                doc["status"] = dep.status.to_dict()
+                self._write_json(path, doc)
 
     # -- watch --------------------------------------------------------------
 

@@ -150,7 +150,11 @@ class PredictorSpec:
     name: str
     graph: PredictiveUnit
     replicas: int = 1
-    traffic: int = 100
+    # 0, not 100: the reference CRD's Traffic is omitempty (defaults 0) so
+    # shadow predictors and single-predictor manifests may omit it
+    # (reference: seldondeployment_types.go PredictorSpec.Traffic,
+    # seldondeployment_webhook.go:372-386 checkTraffic)
+    traffic: int = 0
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     # TPU placement: mesh shape this predictor wants, e.g. {"data": 1, "model": 8}
@@ -164,7 +168,7 @@ class PredictorSpec:
             name=d.get("name", "default"),
             graph=PredictiveUnit.from_dict(d["graph"]),
             replicas=int(d.get("replicas", 1)),
-            traffic=int(d.get("traffic", 100)),
+            traffic=int(d.get("traffic", 0)),
             labels=d.get("labels", {}),
             annotations=d.get("annotations", {}),
             tpu_mesh=d.get("tpuMesh") or d.get("tpu_mesh"),
@@ -252,9 +256,15 @@ def validate_deployment(predictors: List[PredictorSpec]) -> None:
     names = [p.name for p in predictors]
     if len(names) != len(set(names)):
         raise GraphSpecError(f"duplicate predictor names: {names}")
-    if len(predictors) > 1:
-        total = sum(p.traffic for p in predictors)
-        if total != 100:
-            raise GraphSpecError(f"traffic weights must sum to 100, got {total}")
+    # shadow predictors carry no traffic weight (they receive mirrored
+    # traffic, not routed traffic) — exclude them from the sum, mirroring
+    # the ambassador/istio weight handling (reference: ambassador.go
+    # shadow mappings; checkTraffic seldondeployment_webhook.go:372-386)
+    live = [p for p in predictors if p.annotations.get("seldon.io/shadow", "false") != "true"]
+    total = sum(p.traffic for p in live)
+    if len(live) > 1 and total != 100:
+        raise GraphSpecError(f"traffic weights must sum to 100, got {total}")
+    if len(live) == 1 and total not in (0, 100):
+        raise GraphSpecError(f"traffic must be 100 for a single predictor when set, got {total}")
     for p in predictors:
         validate_predictor(p)

@@ -223,6 +223,11 @@ def main(argv=None) -> None:
         contract = json.load(f)
     endpoint_addr = f"{args.host}:{args.port}"
     if args.api and args.deployment:
+        if args.grpc:
+            parser.error(
+                "the gateway serves REST only; point --api at an engine "
+                "host:port (drop --deployment) for gRPC"
+            )
         client = SeldonClient(
             deployment_name=args.deployment, namespace=args.namespace,
             gateway_endpoint=endpoint_addr,

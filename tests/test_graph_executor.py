@@ -284,6 +284,24 @@ def test_validate_traffic_weights():
     validate_deployment([a, b])
 
 
+def test_validate_shadow_predictor_exempt_from_traffic_sum():
+    # shadow predictors receive mirrored traffic only — a manifest that
+    # omits traffic on the shadow must validate (reference: ambassador.go
+    # shadow mappings; Traffic is omitempty in the CRD)
+    a = make_spec({"name": "m", "implementation": "SIMPLE_MODEL"}, name="main")
+    b = make_spec({"name": "m", "implementation": "SIMPLE_MODEL"}, name="shadow")
+    a.traffic = 100
+    b.annotations["seldon.io/shadow"] = "true"
+    validate_deployment([a, b])
+    # omitted traffic everywhere is also fine for a single live predictor
+    a.traffic = 0
+    validate_deployment([a, b])
+    # but a partial weight on the single live predictor is rejected
+    a.traffic = 60
+    with pytest.raises(GraphSpecError, match="traffic"):
+        validate_deployment([a, b])
+
+
 def test_spec_b64_roundtrip():
     spec = make_spec({"name": "m", "implementation": "SIMPLE_MODEL"})
     blob = spec.to_env_b64()
