@@ -56,11 +56,14 @@ class Gateway:
     def __init__(self, seed: Optional[int] = None):
         # deployment key -> list of routes
         self._routes: Dict[str, List[_Route]] = {}
+        # deployment key -> predictor -> explainer handles (reference:
+        # "<deployment>-explainer" service, seldondeployment_explainers.go:160)
+        self._explainers: Dict[str, Dict[str, List]] = {}
         self._rng = random.Random(seed)
 
     # -- route table maintenance (called by the reconciler) -----------------
 
-    def set_routes(self, dep, endpoints: Dict[str, List]) -> None:
+    def set_routes(self, dep, endpoints: Dict[str, List], explainers: Optional[Dict[str, List]] = None) -> None:
         routes = []
         for pspec in dep.predictors:
             shadow = pspec.annotations.get(ANNOTATION_SHADOW, "false") == "true"
@@ -68,9 +71,27 @@ class Gateway:
                 _Route(pspec.name, pspec.traffic, endpoints.get(pspec.name, []), shadow)
             )
         self._routes[dep.key] = routes
+        self._explainers[dep.key] = dict(explainers or {})
 
     def drop_routes(self, key: str) -> None:
         self._routes.pop(key, None)
+        self._explainers.pop(key, None)
+
+    def select_explainer(self, key: str, header_predictor: Optional[str] = None):
+        """Explainer handle for the (chosen) predictor of a deployment."""
+        explainers = self._explainers.get(key) or {}
+        if not explainers:
+            return None
+        if header_predictor:
+            handles = explainers.get(header_predictor) or []
+            return handles[0] if handles else None
+        # only live (non-shadow) predictors' explainers are eligible — a
+        # shadow's explainer explains a model serving 0% of real traffic
+        routes = self._routes.get(key) or []
+        for r in routes:
+            if not r.shadow and r.predictor in explainers and explainers[r.predictor]:
+                return explainers[r.predictor][0]
+        return None
 
     def route_table(self) -> Dict[str, List[Tuple[str, int, int, bool]]]:
         return {
@@ -130,6 +151,8 @@ class Gateway:
                 fn = seldon_methods.send_feedback
             elif path.endswith("/predictions") or path == "/predict":
                 fn = seldon_methods.predict
+            elif path.endswith("/explain"):
+                fn = seldon_methods.explain
             else:
                 raise LookupError(f"no model route {path}")
             return await asyncio.get_running_loop().run_in_executor(
@@ -161,6 +184,15 @@ class Gateway:
             ns, name = parts[1], parts[2]
             api_path = "/" + "/".join(parts[3:])
             key = f"{ns}/{name}"
+            if api_path.endswith("/explain"):
+                handle = gw.select_explainer(key, req.headers.get(HEADER_PREDICTOR))
+                if handle is None:
+                    return Response(error_body(404, f"no explainer for {key}"), 404)
+                try:
+                    out = await gw._forward(handle, "/explain", req.json())
+                except Exception as e:  # noqa: BLE001 - gateway must answer
+                    return Response(error_body(502, str(e)), 502)
+                return Response(out)
             primary, shadows = gw.select(key, req.headers.get(HEADER_PREDICTOR))
             if primary is None:
                 return Response(error_body(503, f"no live predictor for {key}"), 503)

@@ -135,6 +135,26 @@ class DeploymentController:
                                 ],
                             )
                         )
+            def explainer_spec() -> Optional[ComponentSpec]:
+                explainer = pspec.annotations.get("seldon.io/explainer-type")
+                if not explainer:
+                    return None
+                return ComponentSpec(
+                    name=f"{dep.key}/{pspec.name}/explainer-{h[:8]}",
+                    kind="explainer",
+                    deployment=dep.key,
+                    predictor=pspec.name,
+                    interface_name="seldon_core_tpu.components.explainer.Explainer",
+                    parameters=[
+                        {"name": "explainer_type", "value": explainer, "type": "STRING"},
+                        {
+                            "name": "model_uri",
+                            "value": pspec.annotations.get("seldon.io/explainer-model-uri", ""),
+                            "type": "STRING",
+                        },
+                    ],
+                )
+
             if no_engine:
                 # no-engine mode: expose the single graph node directly, no
                 # orchestrator hop (reference: seldon.io/no-engine annotation,
@@ -166,6 +186,9 @@ class DeploymentController:
                             ],
                         )
                     )
+                espec = explainer_spec()
+                if espec is not None:
+                    specs.append(espec)
                 continue
             for replica in range(max(1, pspec.replicas)):
                 name = f"{dep.key}/{pspec.name}/{replica}/engine-{h[:8]}"
@@ -180,25 +203,9 @@ class DeploymentController:
                         engine_spec=pspec.to_dict(),
                     )
                 )
-            explainer = pspec.annotations.get("seldon.io/explainer-type")
-            if explainer:
-                specs.append(
-                    ComponentSpec(
-                        name=f"{dep.key}/{pspec.name}/explainer-{h[:8]}",
-                        kind="explainer",
-                        deployment=dep.key,
-                        predictor=pspec.name,
-                        interface_name="seldon_core_tpu.components.explainer.Explainer",
-                        parameters=[
-                            {"name": "explainer_type", "value": explainer, "type": "STRING"},
-                            {
-                                "name": "model_uri",
-                                "value": pspec.annotations.get("seldon.io/explainer-model-uri", ""),
-                                "type": "STRING",
-                            },
-                        ],
-                    )
-                )
+            espec = explainer_spec()
+            if espec is not None:
+                specs.append(espec)
         return specs
 
     # -- reconcile ----------------------------------------------------------
@@ -221,7 +228,9 @@ class DeploymentController:
                 # routes must track what actually survives a failed
                 # reconcile (e.g. the recreate fallback tore the old
                 # generation down) — never leave stale handles routable
-                self.gateway.set_routes(dep, self._routable_endpoints(dep))
+                self.gateway.set_routes(
+                    dep, self._routable_endpoints(dep), self._explainer_endpoints(dep)
+                )
             return status
 
         try:
@@ -258,6 +267,13 @@ class DeploymentController:
         try:
             for spec in desired:
                 if spec.name not in self.components:
+                    if spec.kind == "explainer":
+                        # point the explainer at a live engine of its
+                        # predictor (reference: --predictor_host arg,
+                        # seldondeployment_explainers.go:105-110); engines
+                        # precede explainers in desired order so the port
+                        # is known by now
+                        self._wire_explainer_endpoint(spec, desired_names)
                     handle = await self.runtime.start(spec)
                     self.components[spec.name] = (handle, dep.spec_hash())
                     created.append(handle)
@@ -282,6 +298,10 @@ class DeploymentController:
                     {
                         pred: [h for h in handles if h.spec.name in desired_names]
                         for pred, handles in self._routable_endpoints(dep).items()
+                    },
+                    {
+                        pred: [h for h in handles if h.spec.name in desired_names]
+                        for pred, handles in self._explainer_endpoints(dep).items()
                     },
                 )
             for name in mine - desired_names:
@@ -321,8 +341,36 @@ class DeploymentController:
         dep.status = status
         self.store.update_status(dep)
         if self.gateway is not None:
-            self.gateway.set_routes(dep, self._routable_endpoints(dep))
+            self.gateway.set_routes(
+                dep, self._routable_endpoints(dep), self._explainer_endpoints(dep)
+            )
         return status
+
+    def _wire_explainer_endpoint(self, spec: ComponentSpec, desired_names) -> None:
+        if any((p or {}).get("name") == "predictor_endpoint" for p in spec.parameters or []):
+            return
+        candidates = [
+            handle.spec
+            for _name, (handle, _) in self.components.items()
+            if (
+                handle.spec.deployment == spec.deployment
+                and handle.spec.predictor == spec.predictor
+                and handle.spec.routable
+                and handle.spec.http_port
+            )
+        ]
+        # during a rolling update both generations are alive here — wire
+        # against the NEW generation (in desired_names); the old one is
+        # torn down at the end of this same reconcile
+        new_gen = [c for c in candidates if c.name in desired_names]
+        target = (new_gen or candidates or [None])[0]
+        if target is None:
+            return
+        path = "/predict" if target.kind == "microservice" else "/api/v0.1/predictions"
+        spec.parameters = (spec.parameters or []) + [
+            {"name": "predictor_endpoint", "value": f"127.0.0.1:{target.http_port}", "type": "STRING"},
+            {"name": "predictor_path", "value": path, "type": "STRING"},
+        ]
 
     def _allocate_blocks(self, dep: SeldonDeployment, desired: List[ComponentSpec]) -> None:
         """All-or-nothing device allocation for the desired engines: on a
@@ -349,12 +397,18 @@ class DeploymentController:
             if spec.name not in keep and spec.name not in self.components:
                 self.placement.release(spec.name)
 
-    def _routable_endpoints(self, dep: SeldonDeployment) -> Dict[str, List[ComponentHandle]]:
+    def _endpoints_by(self, dep: SeldonDeployment, want) -> Dict[str, List[ComponentHandle]]:
         out: Dict[str, List[ComponentHandle]] = {}
         for name, (handle, _) in self.components.items():
-            if handle.spec.deployment == dep.key and handle.spec.routable:
+            if handle.spec.deployment == dep.key and want(handle.spec):
                 out.setdefault(handle.spec.predictor, []).append(handle)
         return out
+
+    def _routable_endpoints(self, dep: SeldonDeployment) -> Dict[str, List[ComponentHandle]]:
+        return self._endpoints_by(dep, lambda s: s.routable)
+
+    def _explainer_endpoints(self, dep: SeldonDeployment) -> Dict[str, List[ComponentHandle]]:
+        return self._endpoints_by(dep, lambda s: s.kind == "explainer")
 
     async def _await_ready(self, handles: List[ComponentHandle]) -> bool:
         deadline = asyncio.get_running_loop().time() + self.ready_timeout_s

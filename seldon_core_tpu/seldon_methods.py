@@ -23,6 +23,7 @@ from .user_model import (
     client_custom_metrics,
     client_custom_tags,
     client_has_raw,
+    client_explain,
     client_predict,
     client_raw,
     client_route,
@@ -137,6 +138,16 @@ def aggregate(user_model, request) -> Message:
     )
     first = parts_list[0]
     return _respond(user_model, first, result, is_proto)
+
+
+def explain(user_model, request: Message) -> Message:
+    """Explanation endpoint: result rides ``jsonData`` (attributions are a
+    structured document, not a tensor). REST-first like the reference's
+    alibi explainer (seldondeployment_explainers.go:32-187)."""
+    is_proto = isinstance(request, pb.SeldonMessage)
+    parts = _extract(request, is_proto)
+    result = client_explain(user_model, parts.payload, parts.names, parts.meta)
+    return _respond(user_model, parts, result, is_proto)
 
 
 def send_feedback(user_model, feedback) -> Message:

@@ -52,6 +52,13 @@ class SeldonComponent:
     def send_feedback(self, X, names: Iterable[str], reward: float, truth, routing: Optional[int] = None):
         raise NotImplementedError
 
+    def explain(self, X, names: Iterable[str], meta: Optional[Dict] = None) -> Dict:
+        """Return a JSON-serializable explanation for the batch X
+        (feature attributions, anchors, ...). Served at ``/explain``
+        (reference: per-predictor alibi explainer deployments,
+        operator/controllers/seldondeployment_explainers.go:32-187)."""
+        raise NotImplementedError
+
     # --- proto-level hooks (full SeldonMessage in/out, bypass marshaling) ---
 
     def predict_raw(self, msg):
@@ -165,6 +172,18 @@ def client_aggregate(user_model, Xs, names_list, metas=None):
         except TypeError:
             return user_model.aggregate(Xs, names_list)
     raise SeldonNotImplementedError("aggregate not implemented")
+
+
+def client_explain(user_model, X, names, meta=None) -> Dict:
+    if _has_hook(user_model, "explain"):
+        try:
+            out = user_model.explain(X, names, meta)
+        except TypeError:
+            out = user_model.explain(X, names)
+        if not isinstance(out, dict):
+            raise ValueError(f"explain() must return a dict, got {type(out).__name__}")
+        return out
+    raise SeldonNotImplementedError("explain not implemented")
 
 
 def client_send_feedback(user_model, X, names, reward, truth, routing=None):

@@ -72,6 +72,8 @@ def get_rest_microservice(user_object, state: Optional[ServerState] = None) -> H
     app.add_route("/route", endpoint(seldon_methods.route))
     app.add_route("/aggregate", endpoint(seldon_methods.aggregate))
     app.add_route("/send-feedback", endpoint(seldon_methods.send_feedback))
+    app.add_route("/explain", endpoint(seldon_methods.explain))
+    app.add_route("/api/v1.0/explain", endpoint(seldon_methods.explain))
 
     async def health(req: Request) -> Response:
         out = await _sync(seldon_methods.health_status, user_object)
