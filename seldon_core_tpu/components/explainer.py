@@ -43,7 +43,8 @@ BLACK_BOX_TYPES = ("ablation",)
 ALIAS_TYPES = {
     "anchor_tabular": "ablation",
     "anchor_images": "ablation",
-    "anchor_text": "ablation",
+    # anchor_text is NOT aliased: string features can't ride numeric
+    # occlusion; rejecting at construction beats a 500 on first /explain
 }
 
 
@@ -93,7 +94,13 @@ class Explainer(SeldonComponent):
 
         server = JAXServer(self.model_uri, mesh=self._mesh)
         apply_fn, params = server.build()
-        self._params = jax.device_put(params)
+        if self._mesh is not None:
+            # same layout as the predictor (JAXComponent.load): params a
+            # replicated copy would OOM where the served model fits sharded
+            params = jax.device_put(params, server.param_sharding(self._mesh, params))
+            self._params = params
+        else:
+            self._params = jax.device_put(params)
         self._apply = apply_fn
         self._explain_fn = jax.jit(self._build_white_box(apply_fn))
         logger.info(
@@ -192,9 +199,8 @@ class Explainer(SeldonComponent):
 
     def explain(self, X, names: Iterable[str], meta: Optional[Dict] = None) -> Dict:
         x = np.asarray(X, dtype=np.float32)
-        squeeze = x.ndim == 1
-        if squeeze:
-            x = x[None, :]
+        if x.ndim == 1:
+            x = x[None, :]  # responses stay batched, like predict
         req_meta = meta or {}
         baseline = np.asarray(
             req_meta.get("tags", {}).get("baseline", np.zeros_like(x)), np.float32
@@ -214,7 +220,14 @@ class Explainer(SeldonComponent):
             prediction = np.asarray(logits, np.float32)
             target = np.asarray(target)
         else:
-            attr, prediction, target = self._explain_ablation(x, baseline)
+            # occlusion works on flat feature vectors; images and other
+            # >2-D batches are flattened per-row and the attribution map
+            # reshaped back (anchor_images alias lands here)
+            shape = x.shape
+            flat_x = x.reshape(shape[0], -1)
+            flat_b = baseline.reshape(shape[0], -1)
+            attr, prediction, target = self._explain_ablation(flat_x, flat_b)
+            attr = attr.reshape(shape)
 
         names_list: List[str] = list(names or [])
         out: Dict = {

@@ -55,6 +55,7 @@ PREPACKAGED_SERVERS = {
     "MLFLOW_SERVER": "seldon_core_tpu.servers.mlflowserver.MLFlowServer",
     "TENSORFLOW_SERVER": "seldon_core_tpu.servers.tfserver.TFServer",
     "JAX_SERVER": "seldon_core_tpu.servers.jaxserver.JAXServer",
+    "GENERATE_SERVER": "seldon_core_tpu.servers.generateserver.GenerateServer",
 }
 
 FIRST_PORT = 9000

@@ -159,10 +159,17 @@ class DecoderLM(ServedModel):
         k = _rope(k, rope_pos, cfg.rope_theta)
         new_cache = None
         if kv_cache is not None:
-            # decode: append this step's k/v at position `positions`
+            # decode: append this step's k/v at position `cache_pos` —
+            # scalar (uniform batch) or [B] vector (ragged continuous
+            # batch: every row writes at its own position)
             ck, cv, cache_pos = kv_cache
-            ck = lax.dynamic_update_slice(ck, k, (0, 0, cache_pos, 0))
-            cv = lax.dynamic_update_slice(cv, v, (0, 0, cache_pos, 0))
+            if getattr(cache_pos, "ndim", 0):
+                rows = jnp.arange(B)
+                ck = ck.at[rows, :, cache_pos, :].set(k[:, :, 0, :])
+                cv = cv.at[rows, :, cache_pos, :].set(v[:, :, 0, :])
+            else:
+                ck = lax.dynamic_update_slice(ck, k, (0, 0, cache_pos, 0))
+                cv = lax.dynamic_update_slice(cv, v, (0, 0, cache_pos, 0))
             k, v = ck, cv
             new_cache = (ck, cv)
         if KVl < Hl:  # GQA: repeat kv groups
@@ -306,11 +313,46 @@ class DecoderLM(ServedModel):
         logits = (x[:, 0] @ params["unembed"].astype(dt)).astype(jnp.float32)
         return logits, {"k": nk, "v": nv}
 
-    def prefill(self, params, prompt, max_seq: int):
+    def decode_step_ragged(self, params, cache, tokens, pos):
+        """One decode step over a RAGGED batch: tokens [B, 1], pos [B]
+        int32 — every row sits at its own position (continuous batching:
+        requests admitted mid-flight decode side-by-side with older ones).
+        K/V land via a per-row scatter; attention masks each row to its
+        own prefix. Static shapes throughout, so one XLA executable serves
+        every mix of in-flight requests. Returns (logits [B, V], cache).
+        """
+        import jax.numpy as jnp
+        from jax import lax
+
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        pos = pos.astype(jnp.int32)
+        x = params["embed"][tokens.astype(jnp.int32)].astype(dt)  # [B,1,D]
+
+        def body(x, inputs):
+            layer_p, ck, cv = inputs
+            attn_out, new_cache = self._attention(
+                layer_p, x, pos, kv_cache=(ck, cv, pos)
+            )
+            x = x + attn_out
+            ffn_out, _ = self._ffn(layer_p, x)
+            return x + ffn_out, new_cache
+
+        x, (nk, nv) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
+        x = _rms_norm(x, params["ln_f"].astype(dt))
+        logits = (x[:, 0] @ params["unembed"].astype(dt)).astype(jnp.float32)
+        return logits, {"k": nk, "v": nv}
+
+    def prefill(self, params, prompt, max_seq: int, last_index=None):
         """Batched prefill: ONE forward over the whole prompt, K/V for all
         positions computed in parallel and written into a fresh cache of
         length ``max_seq``. Returns (last-position logits [B, V], cache).
-        ~Tp x cheaper time-to-first-token than stepping decode_step."""
+        ~Tp x cheaper time-to-first-token than stepping decode_step.
+
+        ``last_index`` ([B] int32, optional): per-row index of the last
+        REAL prompt token when the batch is right-padded to a bucket
+        length (continuous batching pads prompts to a few fixed lengths
+        to bound XLA compilations); defaults to the final position."""
         import jax.numpy as jnp
         from jax import lax
 
@@ -351,7 +393,11 @@ class DecoderLM(ServedModel):
 
         x, (ck, cv) = lax.scan(body, x, params["blocks"])
         x = _rms_norm(x, params["ln_f"].astype(dt))
-        logits = (x[:, -1] @ params["unembed"].astype(dt)).astype(jnp.float32)
+        if last_index is None:
+            x_last = x[:, -1]
+        else:
+            x_last = x[jnp.arange(B), last_index.astype(jnp.int32)]
+        logits = (x_last @ params["unembed"].astype(dt)).astype(jnp.float32)
         return logits, {"k": ck, "v": cv}
 
     def generate(self, params, prompt, max_new_tokens: int, temperature: float = 0.0, seed: int = 0):

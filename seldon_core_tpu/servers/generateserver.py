@@ -1,0 +1,141 @@
+"""Generate prepackaged server: LLM token generation with continuous
+batching behind the standard unary predict protocol.
+
+BASELINE.json config 5 ("Llama-2-7B generate() with engine-side dynamic
+batching"); no reference counterpart — the reference's servers are all
+unary classifiers (servers/sklearnserver/... — SURVEY §2 #32-35).
+
+Model URI layout: same ``jax_config.json`` as jaxserver with
+``"family": "llm"``; extra server params tune the scheduler::
+
+    slots            decode lanes (default 8)
+    max_seq          cache length override
+    shard_cache_seq  shard the KV cache length over the mesh's `seq` axis
+
+Request (jsonData)::
+
+    {"prompt_tokens": [1, 2, ...],        # or "prompt": "text" (byte-level)
+     "max_new_tokens": 32, "temperature": 0.0, "eos_id": null, "seed": 0}
+
+Batched form: ``prompt_tokens`` may be a list of lists — each prompt is
+submitted separately and rides the SAME in-flight decode batch (that is
+the continuous-batching win; no padding to the longest prompt).
+
+Response (jsonData): ``{"tokens": [[...]], "text": [...]}`` — ``text``
+only for byte-level string prompts.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Iterable, List, Optional
+
+from ..user_model import SeldonComponent
+from .jaxserver import JAXServer
+
+logger = logging.getLogger(__name__)
+
+
+class GenerateServer(SeldonComponent):
+    def __init__(
+        self,
+        model_uri: str,
+        mesh=None,
+        slots: int = 8,
+        max_seq: Optional[int] = None,
+        shard_cache_seq: bool = False,
+        **kwargs,
+    ):
+        self.model_uri = model_uri
+        self._mesh = mesh
+        self._slots = int(slots)
+        self._max_seq = int(max_seq) if max_seq else None
+        self._shard_cache_seq = bool(shard_cache_seq) if not isinstance(
+            shard_cache_seq, str
+        ) else shard_cache_seq.lower() == "true"
+        self._extra = kwargs
+        self.batcher = None
+        self._model = None
+
+    def load(self) -> None:
+        from ..serving.continuous import ContinuousBatcher
+
+        server = JAXServer(self.model_uri)
+        apply_fn, params = server.build()
+        self._model = server._model
+        if self._model is None or not hasattr(self._model, "decode_step_ragged"):
+            raise RuntimeError(
+                f"model family {getattr(self._model, '__class__', None)} "
+                "does not support generate(); use family 'llm'"
+            )
+        self.batcher = ContinuousBatcher(
+            self._model,
+            params,
+            slots=self._slots,
+            max_seq=self._max_seq,
+            mesh=self._mesh,
+            shard_cache_seq=self._shard_cache_seq,
+        )
+        self.batcher.start()
+        logger.info(
+            "generateserver: %s ready (slots=%d, max_seq=%d)",
+            self.model_uri, self._slots, self.batcher.max_seq,
+        )
+
+    # -- byte-level text fallback (no tokenizer shipped in-image) ----------
+
+    def _encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def _decode(self, tokens: Iterable[int]) -> str:
+        return bytes(t for t in tokens if 0 <= t < 256).decode("utf-8", "replace")
+
+    def predict(self, X, names, meta=None):
+        if self.batcher is None:
+            self.load()
+        body = X if isinstance(X, dict) else None
+        text_mode = False
+        if body is None:
+            if isinstance(X, str):
+                body, text_mode = {"prompt": X}, True
+            else:
+                raise ValueError(
+                    "generate expects jsonData {prompt_tokens|prompt, ...} or strData"
+                )
+        if "prompt" in body and "prompt_tokens" not in body:
+            text_mode = True
+            prompts = body["prompt"]
+            prompts = [prompts] if isinstance(prompts, str) else list(prompts)
+            token_lists = [self._encode(p) for p in prompts]
+        else:
+            pt = body.get("prompt_tokens")
+            if not pt:
+                raise ValueError("need prompt_tokens or prompt")
+            token_lists = [list(p) for p in pt] if isinstance(pt[0], (list, tuple)) else [list(pt)]
+        kw = dict(
+            max_new_tokens=int(body.get("max_new_tokens", 32)),
+            temperature=float(body.get("temperature", 0.0)),
+            eos_id=body.get("eos_id"),
+            seed=int(body.get("seed", 0)),
+        )
+        futures = [self.batcher.submit(toks, **kw) for toks in token_lists]
+        results = [f.result(timeout=600.0) for f in futures]
+        out: Dict[str, Any] = {"tokens": results}
+        if text_mode:
+            out["text"] = [
+                self._decode(r[len(p):]) for r, p in zip(results, token_lists)
+            ]
+        return out
+
+    def tags(self) -> Dict:
+        return {"server": "generateserver"}
+
+    def metrics(self) -> List[Dict]:
+        if self.batcher is None:
+            return []
+        s = self.batcher.stats
+        return [
+            {"type": "GAUGE", "key": "gen_tokens_total", "value": float(s["tokens"])},
+            {"type": "GAUGE", "key": "gen_steps_total", "value": float(s["steps"])},
+            {"type": "GAUGE", "key": "gen_finished_total", "value": float(s["finished"])},
+        ]

@@ -1,0 +1,337 @@
+"""Continuous batching for ``generate()`` serving.
+
+The reference's protocol is unary request/response; its only batching is
+the client's (SURVEY §7 hard part (b): "dynamic micro-batching +
+continuous batching for generate() under a protocol designed for unary
+calls"). This scheduler closes that gap the TPU way:
+
+* A fixed pool of ``slots`` decode lanes and a fixed cache length — every
+  device computation has STATIC shapes, so XLA compiles exactly three
+  executables (prefill per bucket, slot-insert, fused decode+sample) and
+  the MXU never waits on a recompile.
+* New requests are admitted into free slots **while older requests are
+  mid-decode**: prefill runs as its own batched forward (bucketed prompt
+  lengths), its K/V is spliced into the shared cache with a
+  ``dynamic_update_slice``, and the next fused step decodes old + new
+  lanes together (``DecoderLM.decode_step_ragged`` — per-row positions).
+* Sampling is fused into the decode executable (greedy/temperature per
+  lane), so the only host<->device traffic per step is one int32 per lane.
+* With a mesh, params/cache shard over the ``model`` axis (KV heads) and
+  optionally the ``seq`` axis (cache length) — long prompts span ICI.
+
+No reference counterpart (category: new TPU-native capability; BASELINE
+config 5 "Llama-2-7B generate() with engine-side dynamic batching").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class GenRequest:
+    tokens: List[int]
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    eos_id: Optional[int] = None
+    seed: int = 0
+    future: Future = dataclasses.field(default_factory=Future)
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: GenRequest
+    emitted: List[int] = dataclasses.field(default_factory=list)
+
+
+class ContinuousBatcher:
+    """Slot-based continuous batching scheduler over a DecoderLM.
+
+    ``submit()`` is thread-safe and returns a Future resolving to the
+    generated token list. A single scheduler thread owns the device loop.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        slots: int = 8,
+        max_seq: Optional[int] = None,
+        mesh=None,
+        shard_cache_seq: bool = False,
+        prefill_buckets: Sequence[int] = (32, 128, 512),
+        steps_per_poll: int = 8,
+    ):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        self.model = model
+        self.slots = int(slots)
+        self.max_seq = int(max_seq or model.cfg.max_seq)
+        self.mesh = mesh
+        self.steps_per_poll = int(steps_per_poll)
+        self.prefill_buckets = tuple(
+            sorted(b for b in prefill_buckets if b <= self.max_seq)
+        ) or (self.max_seq,)
+
+        self._queue: "queue.Queue[GenRequest]" = queue.Queue()
+        self._active: Dict[int, _Slot] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self.stats = {"admitted": 0, "finished": 0, "steps": 0, "tokens": 0}
+
+        # -- device state ----------------------------------------------------
+        cache_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            params = jax.device_put(params, model.param_sharding(mesh, params))
+            model_ax = "model" if "model" in mesh.axis_names else None
+            seq_ax = (
+                "seq"
+                if shard_cache_seq and "seq" in mesh.axis_names and mesh.shape["seq"] > 1
+                else None
+            )
+            # cache [L, S, KV, T, Dh]: KV heads over `model` (tp), cache
+            # length over `seq` (long context spans ICI)
+            cache_sharding = NamedSharding(mesh, P(None, None, model_ax, seq_ax, None))
+        self.params = params
+        cache = model.init_cache(self.slots, self.max_seq)
+        if cache_sharding is not None:
+            cache = jax.device_put(cache, {"k": cache_sharding, "v": cache_sharding})
+        self._cache = cache
+        self._cur_tok = jnp.zeros((self.slots,), jnp.int32)
+        self._pos = jnp.zeros((self.slots,), jnp.int32)
+        # per-lane PRNG streams: each request's sampling is seeded by ITS
+        # seed (folded in at admit), so results are reproducible no matter
+        # which other requests share the decode batch
+        self._keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(self.slots))
+
+        # -- executables -----------------------------------------------------
+
+        def fused_step(params, cache, cur_tok, pos, active, temps, keys):
+            logits, cache = model.decode_step_ragged(params, cache, cur_tok[:, None], pos)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            split = jax.vmap(jax.random.split)(keys)  # [S, 2, key]
+            keys, subs = split[:, 0], split[:, 1]
+            sampled = jax.vmap(
+                lambda k, lg, t: jax.random.categorical(k, lg / jnp.maximum(t, 1e-6))
+            )(subs, logits, temps).astype(jnp.int32)
+            nxt = jnp.where(temps > 0, sampled, greedy)
+            nxt = jnp.where(active, nxt, 0)
+            pos = jnp.where(active, pos + 1, pos)
+            return nxt, pos, cache, keys
+
+        def insert(cache, cache_one, slot, first_tok, first_pos, lane_key, cur_tok, pos, keys):
+            new = {
+                k: lax.dynamic_update_slice(cache[k], cache_one[k], (0, slot, 0, 0, 0))
+                for k in ("k", "v")
+            }
+            cur_tok = cur_tok.at[slot].set(first_tok)
+            pos = pos.at[slot].set(first_pos)
+            keys = keys.at[slot].set(lane_key)
+            return new, cur_tok, pos, keys
+
+        def prefill_one(params, prompt, last_index, seed, temp):
+            logits, cache_one = model.prefill(
+                params, prompt, self.max_seq, last_index=last_index
+            )
+            key = jax.random.PRNGKey(seed)
+            key, sub = jax.random.split(key)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            sampled = jax.random.categorical(
+                sub, logits / jnp.maximum(temp, 1e-6), axis=-1
+            ).astype(jnp.int32)
+            first = jnp.where(temp > 0, sampled, greedy)
+            return first, cache_one, key
+
+        self._step_fn = jax.jit(fused_step, donate_argnums=(1,))
+        self._insert_fn = jax.jit(insert, donate_argnums=(0,))
+        self._prefill_fn = jax.jit(prefill_one)
+
+    # -- public api ----------------------------------------------------------
+
+    def submit(
+        self,
+        tokens: Sequence[int],
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        eos_id: Optional[int] = None,
+        seed: int = 0,
+    ) -> Future:
+        if self._stop.is_set():
+            raise RuntimeError("batcher is closed")
+        if not len(tokens):
+            raise ValueError("empty prompt")
+        if len(tokens) >= self.max_seq:
+            raise ValueError(f"prompt of {len(tokens)} exceeds max_seq {self.max_seq}")
+        budget = self.max_seq - len(tokens)
+        req = GenRequest(
+            tokens=list(map(int, tokens)),
+            max_new_tokens=min(int(max_new_tokens), budget),
+            temperature=float(temperature),
+            eos_id=eos_id,
+            seed=int(seed),
+        )
+        self._queue.put(req)
+        self.start()
+        return req.future
+
+    def generate(self, tokens, **kw) -> List[int]:
+        """Blocking convenience: submit and wait for the generated ids."""
+        return self.submit(tokens, **kw).result()
+
+    def start(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name="continuous-batcher", daemon=True
+            )
+            self._thread.start()
+        self._started.wait()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    # -- scheduler loop --------------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return self.max_seq
+
+    def _admit(self, slot: int, req: GenRequest) -> None:
+        import jax.numpy as jnp
+
+        n = len(req.tokens)
+        bucket = self._bucket(n)
+        prompt = np.zeros((1, bucket), np.int32)
+        prompt[0, :n] = req.tokens
+        first, cache_one, lane_key = self._prefill_fn(
+            self.params,
+            jnp.asarray(prompt),
+            jnp.asarray([n - 1], jnp.int32),
+            jnp.int32(req.seed),
+            jnp.float32(req.temperature),
+        )
+        self._cache, self._cur_tok, self._pos, self._keys = self._insert_fn(
+            self._cache, cache_one, slot, first[0], n, lane_key,
+            self._cur_tok, self._pos, self._keys,
+        )
+        self._active[slot] = _Slot(request=req, emitted=[int(first[0])])
+        self.stats["admitted"] += 1
+        self.stats["tokens"] += 1
+
+    def _finish(self, slot: int) -> None:
+        s = self._active.pop(slot)
+        toks = s.emitted
+        if s.request.eos_id is not None and toks and toks[-1] == s.request.eos_id:
+            pass  # keep the eos token, like HF generate
+        if not s.request.future.done():
+            s.request.future.set_result(s.request.tokens + toks)
+        self.stats["finished"] += 1
+
+    def _check_done(self) -> None:
+        for slot in list(self._active):
+            s = self._active[slot]
+            req = s.request
+            if len(s.emitted) >= req.max_new_tokens or (
+                req.eos_id is not None and s.emitted and s.emitted[-1] == req.eos_id
+            ):
+                self._finish(slot)
+
+    def _loop(self) -> None:
+        import jax.numpy as jnp
+
+        self._started.set()
+        temps = np.zeros((self.slots,), np.float32)
+        try:
+            while not self._stop.is_set():
+                # admit as many queued requests as there are free slots
+                admitted = False
+                while len(self._active) < self.slots:
+                    try:
+                        req = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    free = next(i for i in range(self.slots) if i not in self._active)
+                    try:
+                        self._admit(free, req)
+                        admitted = True
+                    except Exception as e:  # noqa: BLE001 - bad request
+                        logger.exception("admit failed")
+                        if not req.future.done():
+                            req.future.set_exception(e)
+                if admitted:
+                    self._check_done()  # 1-token requests finish at prefill
+                if not self._active:
+                    try:
+                        req = self._queue.get(timeout=0.05)
+                    except queue.Empty:
+                        continue
+                    self._queue.put(req)
+                    continue
+                for i in range(self.slots):
+                    temps[i] = (
+                        self._active[i].request.temperature if i in self._active else 0.0
+                    )
+                active = np.zeros((self.slots,), bool)
+                for i in self._active:
+                    active[i] = True
+                active_dev = jnp.asarray(active)
+                temps_dev = jnp.asarray(temps)
+                # run a burst of fused steps, then poll the queue again —
+                # bounds admission latency without a host sync per token
+                for _ in range(self.steps_per_poll):
+                    nxt, self._pos, self._cache, self._keys = self._step_fn(
+                        self.params, self._cache, self._cur_tok, self._pos,
+                        active_dev, temps_dev, self._keys,
+                    )
+                    self._cur_tok = nxt
+                    self.stats["steps"] += 1
+                    host_next = np.asarray(nxt)
+                    done_any = False
+                    for slot, s in self._active.items():
+                        s.emitted.append(int(host_next[slot]))
+                        self.stats["tokens"] += 1
+                        req = s.request
+                        if len(s.emitted) >= req.max_new_tokens or (
+                            req.eos_id is not None and s.emitted[-1] == req.eos_id
+                        ):
+                            done_any = True
+                    if done_any:
+                        self._check_done()
+                        break
+                    if not self._queue.empty() and len(self._active) < self.slots:
+                        break
+        except Exception:  # noqa: BLE001 - surface scheduler death to callers
+            logger.exception("continuous batcher loop died")
+            # poison the batcher: the donated cache buffers are gone, a
+            # relaunched loop would compute on invalidated state
+            self._stop.set()
+            err = RuntimeError("continuous batcher died; see server log")
+            for slot in list(self._active):
+                s = self._active.pop(slot)
+                if not s.request.future.done():
+                    s.request.future.set_exception(err)
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if not req.future.done():
+                    req.future.set_exception(err)
+            raise

@@ -120,6 +120,19 @@ def test_ablation_batched_single_roundtrip(monkeypatch):
     assert calls == [(12, 5)]
 
 
+def test_ablation_image_batch_flattens(monkeypatch):
+    """anchor_images alias: 4-D image batches flatten per-row for the
+    occlusion sweep and the attribution map comes back image-shaped."""
+    e = Explainer(explainer_type="anchor_images", predictor_endpoint="fake:1")
+    monkeypatch.setattr(
+        e, "_query_predictor",
+        lambda batch: batch.sum(axis=1, keepdims=True),
+    )
+    x = np.random.RandomState(0).rand(2, 4, 4, 1).astype(np.float32)
+    out = e.explain(x, [])
+    assert np.asarray(out["attributions"]).shape == (2, 4, 4, 1)
+
+
 def test_explain_microservice_route(rest_client, monkeypatch):
     """/explain on the wrapper dispatches to the explain hook."""
     from seldon_core_tpu.wrapper import get_rest_microservice
