@@ -87,12 +87,14 @@ class _InProcessHandle(ComponentHandle):
         probe,
         grpc_server=None,
         app=None,
+        rest_app=None,
     ):
         super().__init__(spec)
         self._tasks = tasks
         self._probe = probe
         self._grpc_server = grpc_server
         self.app = app
+        self.rest_app = rest_app
 
     async def ready(self) -> bool:
         try:
@@ -126,6 +128,9 @@ class _InProcessHandle(ComponentHandle):
                 await self.app.executor.close()
             except Exception:  # noqa: BLE001
                 pass
+        pool = getattr(self.rest_app, "_hook_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
 
 
 class InProcessRuntime:
@@ -151,11 +156,12 @@ class InProcessRuntime:
             tasks = []
             if self.open_ports:
                 spec.http_port = spec.http_port or free_port()
-                tasks.append(
-                    asyncio.create_task(
-                        app.rest_app().serve_forever("127.0.0.1", spec.http_port)
-                    )
-                )
+                rest = app.rest_app()
+                # bind BEFORE returning the handle: readiness is probed
+                # in-process (no socket), so a lazily-bound listener could
+                # report Available while the port still refuses connections
+                await rest.start("127.0.0.1", spec.http_port)
+                tasks.append(asyncio.create_task(rest.serve()))
             grpc_server = None
             if self.open_ports and self.grpc:
                 spec.grpc_port = spec.grpc_port or free_port()
@@ -185,10 +191,14 @@ class InProcessRuntime:
             tasks = []
             if self.open_ports:
                 spec.http_port = spec.http_port or free_port()
-                tasks.append(
-                    asyncio.create_task(rest.serve_forever("127.0.0.1", spec.http_port))
-                )
-            handle = _InProcessHandle(spec, tasks, lambda: state.ready)
+                await rest.start("127.0.0.1", spec.http_port)
+                tasks.append(asyncio.create_task(rest.serve()))
+            handle = _InProcessHandle(
+                spec,
+                tasks,
+                lambda: state.ready and (not tasks or rest.is_serving()),
+                rest_app=rest,
+            )
             handle.user_object = user_object
             return handle
 

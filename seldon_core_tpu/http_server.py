@@ -178,8 +178,18 @@ class HTTPServer:
 
     async def serve_forever(self, host: str, port: int):
         await self.start(host, port)
+        await self.serve()
+
+    async def serve(self):
+        """Serve on an already-``start()``-ed listener. Callers that must
+        guarantee the socket is bound before advertising readiness (the
+        component runtime) await ``start()`` first, then run this in a
+        task."""
         async with self._server:
             await self._server.serve_forever()
+
+    def is_serving(self) -> bool:
+        return self._server is not None and self._server.is_serving()
 
     def close(self):
         if self._server is not None:

@@ -286,32 +286,39 @@ class DecoderLM(ServedModel):
         dt = jnp.dtype(cfg.dtype)
         return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
-    def decode_step(self, params, cache, tokens, pos):
-        """One decode step: tokens [B, 1], pos scalar int. Returns
-        (logits [B, V], updated cache). jit-friendly: static shapes."""
+    def _decode(self, params, cache, tokens, positions, cache_pos):
+        """Shared decode-step pipeline: embed -> scan blocks with KV-cache
+        attention -> final norm -> unembed. ``positions`` is [B] int32;
+        ``cache_pos`` is a scalar (aligned batch) or [B] (ragged batch) —
+        ``_attention`` branches on its rank for the K/V write + mask."""
         import jax.numpy as jnp
         from jax import lax
 
         cfg = self.cfg
         dt = jnp.dtype(cfg.dtype)
         x = params["embed"][tokens.astype(jnp.int32)].astype(dt)  # [B,1,D]
-        positions = jnp.full((tokens.shape[0],), pos, jnp.int32)
 
         def body(x, inputs):
             layer_p, ck, cv = inputs
             attn_out, new_cache = self._attention(
-                layer_p, x, positions, kv_cache=(ck, cv, pos)
+                layer_p, x, positions, kv_cache=(ck, cv, cache_pos)
             )
             x = x + attn_out
             ffn_out, _ = self._ffn(layer_p, x)
             return x + ffn_out, new_cache
 
-        x, (nk, nv) = lax.scan(
-            body, x, (params["blocks"], cache["k"], cache["v"])
-        )
+        x, (nk, nv) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
         x = _rms_norm(x, params["ln_f"].astype(dt))
         logits = (x[:, 0] @ params["unembed"].astype(dt)).astype(jnp.float32)
         return logits, {"k": nk, "v": nv}
+
+    def decode_step(self, params, cache, tokens, pos):
+        """One decode step: tokens [B, 1], pos scalar int. Returns
+        (logits [B, V], updated cache). jit-friendly: static shapes."""
+        import jax.numpy as jnp
+
+        positions = jnp.full((tokens.shape[0],), pos, jnp.int32)
+        return self._decode(params, cache, tokens, positions, pos)
 
     def decode_step_ragged(self, params, cache, tokens, pos):
         """One decode step over a RAGGED batch: tokens [B, 1], pos [B]
@@ -322,26 +329,9 @@ class DecoderLM(ServedModel):
         every mix of in-flight requests. Returns (logits [B, V], cache).
         """
         import jax.numpy as jnp
-        from jax import lax
 
-        cfg = self.cfg
-        dt = jnp.dtype(cfg.dtype)
         pos = pos.astype(jnp.int32)
-        x = params["embed"][tokens.astype(jnp.int32)].astype(dt)  # [B,1,D]
-
-        def body(x, inputs):
-            layer_p, ck, cv = inputs
-            attn_out, new_cache = self._attention(
-                layer_p, x, pos, kv_cache=(ck, cv, pos)
-            )
-            x = x + attn_out
-            ffn_out, _ = self._ffn(layer_p, x)
-            return x + ffn_out, new_cache
-
-        x, (nk, nv) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
-        x = _rms_norm(x, params["ln_f"].astype(dt))
-        logits = (x[:, 0] @ params["unembed"].astype(dt)).astype(jnp.float32)
-        return logits, {"k": nk, "v": nv}
+        return self._decode(params, cache, tokens, pos, pos)
 
     def prefill(self, params, prompt, max_seq: int, last_index=None):
         """Batched prefill: ONE forward over the whole prompt, K/V for all

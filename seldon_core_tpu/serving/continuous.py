@@ -88,6 +88,7 @@ class ContinuousBatcher:
         self._active: Dict[int, _Slot] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._thread_lock = threading.Lock()
         self._started = threading.Event()
         self.stats = {"admitted": 0, "finished": 0, "steps": 0, "tokens": 0}
 
@@ -185,6 +186,12 @@ class ContinuousBatcher:
             seed=int(seed),
         )
         self._queue.put(req)
+        if self._stop.is_set():
+            # the loop died between the entry check and the put: its drain
+            # already ran, so nothing will ever pop this request — fail the
+            # stranded queue here instead of leaving the future unresolved
+            self._drain_queue(RuntimeError("continuous batcher died; see server log"))
+            return req.future
         self.start()
         return req.future
 
@@ -193,17 +200,32 @@ class ContinuousBatcher:
         return self.submit(tokens, **kw).result()
 
     def start(self) -> None:
-        if self._thread is None or not self._thread.is_alive():
-            self._thread = threading.Thread(
-                target=self._loop, name="continuous-batcher", daemon=True
-            )
-            self._thread.start()
+        if self._stop.is_set():
+            raise RuntimeError("batcher is closed")
+        with self._thread_lock:
+            # check-then-act under a lock: two racing submits must not spawn
+            # two scheduler threads over the same donated device state
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name="continuous-batcher", daemon=True
+                )
+                self._thread.start()
         self._started.wait()
 
     def close(self) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
+        self._drain_queue(RuntimeError("batcher is closed"))
+
+    def _drain_queue(self, err: Exception) -> None:
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if not req.future.done():
+                req.future.set_exception(err)
 
     # -- scheduler loop --------------------------------------------------------
 
@@ -236,12 +258,10 @@ class ContinuousBatcher:
         self.stats["tokens"] += 1
 
     def _finish(self, slot: int) -> None:
+        # a trailing eos token is kept in the output, like HF generate
         s = self._active.pop(slot)
-        toks = s.emitted
-        if s.request.eos_id is not None and toks and toks[-1] == s.request.eos_id:
-            pass  # keep the eos token, like HF generate
         if not s.request.future.done():
-            s.request.future.set_result(s.request.tokens + toks)
+            s.request.future.set_result(s.request.tokens + s.emitted)
         self.stats["finished"] += 1
 
     def _check_done(self) -> None:
@@ -327,11 +347,5 @@ class ContinuousBatcher:
                 s = self._active.pop(slot)
                 if not s.request.future.done():
                     s.request.future.set_exception(err)
-            while True:
-                try:
-                    req = self._queue.get_nowait()
-                except queue.Empty:
-                    break
-                if not req.future.done():
-                    req.future.set_exception(err)
+            self._drain_queue(err)
             raise

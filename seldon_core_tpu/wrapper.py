@@ -35,14 +35,24 @@ class ServerState:
         self.ready = True
 
 
-def get_rest_microservice(user_object, state: Optional[ServerState] = None) -> HTTPServer:
+def get_rest_microservice(
+    user_object, state: Optional[ServerState] = None, hook_workers: int = 64
+) -> HTTPServer:
     app = HTTPServer("microservice-rest")
     state = state or ServerState()
+    # Hooks run on a pool OWNED by this app, not the loop's default
+    # executor: a long-blocking hook (e.g. generate() waiting minutes on
+    # the continuous batcher) must not starve health probes, the engine's
+    # internal clients, or co-hosted in-process components that share the
+    # loop. Threads are created lazily; idle pools cost nothing.
+    pool = futures.ThreadPoolExecutor(
+        max_workers=hook_workers, thread_name_prefix=f"hooks-{type(user_object).__name__}"
+    )
+    app._hook_pool = pool
 
     def _sync(fn, *args):
-        # Hooks are sync (numpy/jax); run on the loop's default executor so
-        # a slow model doesn't starve health probes.
-        return asyncio.get_running_loop().run_in_executor(None, fn, *args)
+        # Hooks are sync (numpy/jax); never run them on the event loop.
+        return asyncio.get_running_loop().run_in_executor(pool, fn, *args)
 
     def endpoint(method_fn, needs_body=True):
         async def handler(req: Request) -> Response:
