@@ -132,8 +132,10 @@ class RestClient(UnitClient):
                 writer.close()
 
     async def call(self, method: str, message: Dict[str, Any]) -> Dict[str, Any]:
+        from ..payload import jsonable
+
         path, _ = METHOD_TABLE[method]
-        body = json.dumps(message, separators=(",", ":")).encode()
+        body = json.dumps(jsonable(message), separators=(",", ":")).encode()
         last_err: Optional[Exception] = None
         for attempt in range(RETRIES):
             try:

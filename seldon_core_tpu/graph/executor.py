@@ -107,14 +107,25 @@ class GraphExecutor:
         registry: Optional[Dict[str, Any]] = None,
         timeout_s: float = 5.0,
         batching: Optional[Dict[str, Dict]] = None,
+        inprocess_workers: int = 32,
     ):
         """registry: unit name -> user object for INPROCESS units that are
         neither builtin implementations nor prepackaged servers.
-        batching: unit name -> kwargs for MicroBatcher (see batching.py)."""
+        batching: unit name -> kwargs for MicroBatcher (see batching.py).
+        inprocess_workers: thread-pool size for in-process unit calls.
+        Sized independently of cpu_count (asyncio's default pool is
+        cpu+4 — on a 1-vCPU TPU VM that is 5 threads, which serialises
+        concurrent device calls that would otherwise overlap their
+        dispatch/transfer latency)."""
+        from concurrent.futures import ThreadPoolExecutor
+
         self.spec = spec
         self._registry = registry or {}
         self._timeout = timeout_s
         self._batching = batching or {}
+        self._pool = ThreadPoolExecutor(
+            max_workers=int(inprocess_workers), thread_name_prefix="unit-call"
+        )
         self.root = self._build(spec.graph)
 
     # -- construction -------------------------------------------------------
@@ -135,7 +146,7 @@ class GraphExecutor:
                 unit.endpoint.service_host, unit.endpoint.grpc_port, self._timeout
             )
         else:
-            client = InProcessClient(self._resolve_object(unit))
+            client = InProcessClient(self._resolve_object(unit), executor=self._pool)
         if unit.name in self._batching and (unit.type in (None, UnitType.MODEL)):
             from .batching import MicroBatchingClient
 
@@ -276,3 +287,4 @@ class GraphExecutor:
 
     async def close(self) -> None:
         await asyncio.gather(*(rt.client.close() for rt in self._walk(self.root)))
+        self._pool.shutdown(wait=False)

@@ -44,13 +44,15 @@ class RequestLogger:
     def log(self, puid: str, request: Dict, response: Dict) -> None:
         if self.sink is None:
             return
+        from ..payload import jsonable
+
         try:
             self.sink(
                 {
                     "specversion": "1.0",
                     "type": "seldon.message.pair",
                     "id": puid,
-                    "data": {"request": request, "response": response},
+                    "data": {"request": jsonable(request), "response": jsonable(response)},
                 }
             )
         except Exception as e:  # noqa: BLE001 - logging must not break serving
@@ -126,16 +128,35 @@ class EngineApp:
     def rest_app(self) -> HTTPServer:
         app = HTTPServer("engine-rest")
 
+        PROTO_TYPES = ("application/x-protobuf", "application/octet-stream")
+
         async def predictions(req: Request) -> Response:
             if self.paused:
                 return Response(error_body(503, "paused"), 503)
-            body = req.json()
+            ctype = (req.headers.get("content-type") or "").split(";")[0].strip()
+            binary = ctype in PROTO_TYPES
+            if binary:
+                # binary SeldonMessage body: no JSON text parse, and raw
+                # tensors cross the wire as bytes instead of base64 — the
+                # zero-copy encoding's REST transport
+                try:
+                    body = proto_to_json(pb.SeldonMessage.FromString(req.body))
+                except Exception as e:  # noqa: BLE001 - malformed proto
+                    return Response(error_body(400, f"bad protobuf body: {e}"), 400)
+            else:
+                body = req.json()
             if body is None:
                 return Response(error_body(400, "empty request body"), 400)
             try:
-                return Response(await self.predict(body, headers=req.headers))
+                out = await self.predict(body, headers=req.headers)
             except UnitCallError as e:
                 return Response(error_body(e.status, e.info), e.status)
+            if binary:
+                return Response(
+                    json_to_proto(out).SerializeToString(),
+                    content_type="application/x-protobuf",
+                )
+            return Response(out)
 
         async def feedback(req: Request) -> Response:
             body = req.json()

@@ -61,12 +61,22 @@ class Request:
         return None
 
 
+def _json_default(obj):
+    """bytes -> base64 string, the proto-JSON convention: interior message
+    dicts may carry raw tensor bytes (payload.proto_to_json fast path)."""
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        import base64
+
+        return base64.b64encode(bytes(obj)).decode("ascii")
+    raise TypeError(f"not JSON serializable: {type(obj).__name__}")
+
+
 class Response:
     __slots__ = ("status", "body", "content_type")
 
     def __init__(self, body, status: int = 200, content_type: str = "application/json"):
         if isinstance(body, (dict, list)):
-            body = json.dumps(body, separators=(",", ":")).encode()
+            body = json.dumps(body, separators=(",", ":"), default=_json_default).encode()
         elif isinstance(body, str):
             body = body.encode()
         self.body = body or b""

@@ -1,0 +1,484 @@
+"""Model-level benchmark tier: real models behind the engine on TPU.
+
+The reference's published benchmark only measured the orchestrator with an
+in-engine stub (reference: doc/source/reference/benchmarking.md:33-64,
+notebooks/benchmark_simple_model.ipynb); no model-level numbers exist
+in-tree. This module measures the north-star metric from BASELINE.json:
+req/s/chip + p50/p99 + MFU for
+
+  * ResNet-50 over engine REST with the zero-copy ``raw`` encoding
+    (uint8 images as a binary SeldonMessage body — application/x-protobuf),
+  * BERT-base over engine gRPC (int32 token ids as a binary RawTensor
+    inside the proto — no JSON/b64 on the wire),
+  * DecoderLM ``generate()`` through the continuous batcher (tokens/s).
+
+Each bench serves the model through the REAL stack — storage download,
+jaxserver build + jit + warmup, EngineApp on sockets — and drives it with
+a closed-loop multi-worker client, so the numbers include marshaling and
+orchestration, not just device time.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import socket
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+# Peak dense bf16 FLOP/s by TPU generation (public spec sheets), matched
+# against jax.devices()[0].device_kind. CPU/unknown -> None (no MFU).
+PEAK_BF16_FLOPS = [
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6", 918e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+]
+
+
+def device_info() -> Dict[str, Any]:
+    import jax
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", str(dev))
+    peak = None
+    low = kind.lower()
+    if "tpu" in low or "axon" in getattr(dev, "platform", "").lower():
+        for frag, flops in PEAK_BF16_FLOPS:
+            if frag in low:
+                peak = flops
+                break
+    return {"platform": dev.platform, "device_kind": kind, "peak_bf16_flops": peak}
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def write_model_dir(root: str, family: str, config: Dict[str, Any]) -> str:
+    """Materialise a jax_config.json model dir (random-init params, the
+    layout jaxserver loads via the storage path)."""
+    model_dir = os.path.join(root, family)
+    os.makedirs(model_dir, exist_ok=True)
+    with open(os.path.join(model_dir, "jax_config.json"), "w") as f:
+        json.dump({"family": family, "config": config}, f)
+    return model_dir
+
+
+class EngineHarness:
+    """EngineApp over an in-process unit, served on real sockets from a
+    background event-loop thread."""
+
+    def __init__(self, component, unit_name: str = "model", name: str = "bench"):
+        from .graph.service import EngineApp
+        from .graph.spec import PredictorSpec, default_predictor
+
+        spec = default_predictor(
+            PredictorSpec.from_dict(
+                {"name": name, "graph": {"name": unit_name, "type": "MODEL"}}
+            )
+        )
+        self.app = EngineApp(spec, registry={unit_name: component})
+        self.http_port = free_port()
+        self.grpc_port = free_port()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+
+    def start(self) -> "EngineHarness":
+        started = threading.Event()
+
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            stop = asyncio.Event()
+            self._stop_event = stop
+
+            async def amain():
+                http = self.app.rest_app()
+                await http.start("127.0.0.1", self.http_port)
+                gsrv = self.app.grpc_server()
+                gsrv.add_insecure_port(f"127.0.0.1:{self.grpc_port}")
+                await gsrv.start()
+                started.set()
+                await stop.wait()
+                http.close()
+                await gsrv.stop(grace=0.1)
+                await self.app.executor.close()
+
+            loop.run_until_complete(amain())
+            loop.close()
+            self._stopped.set()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        if not started.wait(120.0):
+            raise RuntimeError("engine harness failed to start within 120s")
+        return self
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._stop_event.set)
+            self._stopped.wait(10.0)
+
+
+def closed_loop(
+    make_call: Callable[[], Callable[[], int]],
+    seconds: float,
+    concurrency: int,
+    warmup_calls: int = 3,
+) -> Dict[str, Any]:
+    """Drive ``concurrency`` workers, each looping a fresh call fn from
+    ``make_call`` (one per worker: own connection/channel). The call fn
+    returns the number of rows it processed. Reports req/s, rows/s and
+    latency percentiles over the measure window."""
+    warm = make_call()
+    for _ in range(warmup_calls):
+        warm()
+
+    latencies: List[float] = []
+    rows_total = [0]
+    errors = [0]
+    lock = threading.Lock()
+    stop_at = [0.0]
+    barrier = threading.Barrier(concurrency + 1)
+
+    def worker():
+        call = make_call()
+        local_lat: List[float] = []
+        local_rows = 0
+        local_err = 0
+        barrier.wait()
+        try:
+            while time.perf_counter() < stop_at[0]:
+                t0 = time.perf_counter()
+                try:
+                    n = call()
+                except Exception:  # noqa: BLE001 - count, keep the lane running
+                    local_err += 1
+                    continue
+                local_lat.append(time.perf_counter() - t0)
+                local_rows += n
+        finally:
+            with lock:
+                latencies.extend(local_lat)
+                rows_total[0] += local_rows
+                errors[0] += local_err
+
+    threads = [threading.Thread(target=worker, daemon=True) for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    t_start = time.perf_counter()
+    stop_at[0] = t_start + seconds
+    barrier.wait()
+    for t in threads:
+        t.join(timeout=seconds + 120.0)
+    elapsed = time.perf_counter() - t_start
+
+    lat = np.sort(np.asarray(latencies, dtype=np.float64))
+    n = len(lat)
+    if n == 0:
+        raise RuntimeError(
+            f"benchmark produced no completed requests ({errors[0]} errors)"
+        )
+    if errors[0]:
+        raise RuntimeError(
+            f"benchmark had {errors[0]} failed requests ({n} ok) — "
+            "numbers would be skewed, not publishing them"
+        )
+    return {
+        "requests": n,
+        "req_per_s": round(n / elapsed, 2),
+        "rows_per_s": round(rows_total[0] / elapsed, 2),
+        "p50_ms": round(float(lat[n // 2]) * 1e3, 3),
+        "p99_ms": round(float(lat[min(n - 1, int(n * 0.99))]) * 1e3, 3),
+        "mean_ms": round(float(lat.mean()) * 1e3, 3),
+        "concurrency": concurrency,
+        "seconds": round(elapsed, 2),
+    }
+
+
+def _mfu(rows_per_s: float, flops_per_row: Optional[float], peak: Optional[float]):
+    if not flops_per_row or not peak:
+        return None
+    return round(100.0 * rows_per_s * flops_per_row / peak, 2)
+
+
+# ---------------------------------------------------------------------------
+# Bench configs. Tiny-model overrides keep the CPU test tier fast; the
+# defaults are the real thing on the chip.
+# ---------------------------------------------------------------------------
+
+
+def bench_resnet50_rest(
+    root: str,
+    seconds: float = 8.0,
+    concurrency: int = 16,
+    batch: int = 32,
+    image_size: int = 224,
+    peak: Optional[float] = None,
+) -> Dict[str, Any]:
+    """ResNet-50 behind engine REST: binary SeldonMessage body carrying a
+    raw uint8 image tensor (no JSON text parse, no base64 on the wire)."""
+    import http.client
+
+    from .proto import prediction_pb2 as pb
+    from .servers.jaxserver import JAXServer
+
+    model_dir = write_model_dir(root, "resnet50", {"image_size": image_size})
+    component = JAXServer(model_uri=model_dir)
+    component.load()
+    harness = EngineHarness(component).start()
+    img = np.random.RandomState(0).randint(
+        0, 256, (batch, image_size, image_size, 3), dtype=np.uint8
+    )
+    body = pb.SeldonMessage(
+        data=pb.DefaultData(
+            raw=pb.RawTensor(dtype="uint8", shape=list(img.shape), data=img.tobytes())
+        )
+    ).SerializeToString()
+    headers = {"Content-Type": "application/x-protobuf", "Connection": "keep-alive"}
+    port = harness.http_port
+
+    def make_call():
+        conn = http.client.HTTPConnection("127.0.0.1", port)
+
+        def call() -> int:
+            conn.request("POST", "/api/v0.1/predictions", body, headers)
+            resp = conn.getresponse()
+            payload = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"resnet bench HTTP {resp.status}: {payload[:200]}")
+            return batch
+
+        return call
+
+    try:
+        stats = closed_loop(make_call, seconds, concurrency)
+    finally:
+        harness.stop()
+    model = component._model
+    stats.update(
+        {
+            "model": "resnet50",
+            "transport": "engine REST, binary proto raw uint8",
+            "batch": batch,
+            "image_size": image_size,
+            "mfu_pct": _mfu(stats["rows_per_s"], model.flops_per_row(), peak),
+        }
+    )
+    return stats
+
+
+def bench_bert_grpc(
+    root: str,
+    seconds: float = 8.0,
+    concurrency: int = 16,
+    batch: int = 16,
+    seq: int = 128,
+    config: Optional[Dict[str, Any]] = None,
+    peak: Optional[float] = None,
+) -> Dict[str, Any]:
+    """BERT classifier behind engine gRPC, int32 token ids as binary raw."""
+    import grpc
+
+    from .proto import prediction_pb2 as pb
+    from .servers.jaxserver import JAXServer
+
+    cfg = dict(config or {})
+    cfg.setdefault("max_seq", max(512, seq))
+    model_dir = write_model_dir(root, "bert", cfg)
+    component = JAXServer(model_uri=model_dir)
+    component.load()
+    harness = EngineHarness(component).start()
+    tokens = np.random.RandomState(0).randint(
+        1, cfg.get("vocab_size", 30522), (batch, seq), dtype=np.int32
+    )
+    request = pb.SeldonMessage(
+        data=pb.DefaultData(
+            raw=pb.RawTensor(
+                dtype="int32", shape=list(tokens.shape), data=tokens.tobytes()
+            )
+        )
+    ).SerializeToString()
+    target = f"127.0.0.1:{harness.grpc_port}"
+
+    def make_call():
+        channel = grpc.insecure_channel(target)
+        rpc = channel.unary_unary(
+            "/seldontpu.Seldon/Predict",
+            request_serializer=lambda b: b,
+            response_deserializer=pb.SeldonMessage.FromString,
+        )
+
+        def call() -> int:
+            out = rpc(request, timeout=120.0)
+            if out.status.code not in (0,):
+                raise RuntimeError(f"bert bench status {out.status}")
+            return batch
+
+        return call
+
+    try:
+        stats = closed_loop(make_call, seconds, concurrency)
+    finally:
+        harness.stop()
+    model = component._model
+    stats.update(
+        {
+            "model": "bert",
+            "transport": "engine gRPC, raw int32",
+            "batch": batch,
+            "seq": seq,
+            "mfu_pct": _mfu(stats["rows_per_s"], model.flops_per_row(seq), peak),
+        }
+    )
+    return stats
+
+
+def bench_generate(
+    root: str,
+    seconds: float = 8.0,
+    concurrency: int = 16,
+    prompt_len: int = 32,
+    max_new_tokens: int = 32,
+    slots: int = 16,
+    steps_per_poll: int = 16,
+    config: Optional[Dict[str, Any]] = None,
+    peak: Optional[float] = None,
+) -> Dict[str, Any]:
+    """DecoderLM generate() through engine REST + continuous batcher.
+
+    Metric: decoded tokens/s across all in-flight requests (BASELINE.json
+    config 5 — "generate() with engine-side dynamic batching")."""
+    import http.client
+
+    from .servers.generateserver import GenerateServer
+
+    cfg = dict(config or {})
+    cfg.setdefault("max_seq", max(256, 2 * (prompt_len + max_new_tokens)))
+    model_dir = write_model_dir(root, "llm", cfg)
+    component = GenerateServer(
+        model_uri=model_dir, slots=slots, steps_per_poll=steps_per_poll
+    )
+    component.load()
+    harness = EngineHarness(component).start()
+    prompt = list(range(1, prompt_len + 1))
+    body = json.dumps(
+        {
+            "jsonData": {
+                "prompt_tokens": [prompt],
+                "max_new_tokens": max_new_tokens,
+                "temperature": 0.0,
+            }
+        }
+    ).encode()
+    headers = {"Content-Type": "application/json", "Connection": "keep-alive"}
+    port = harness.http_port
+
+    def make_call():
+        conn = http.client.HTTPConnection("127.0.0.1", port)
+
+        def call() -> int:
+            conn.request("POST", "/api/v0.1/predictions", body, headers)
+            resp = conn.getresponse()
+            payload = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"generate bench HTTP {resp.status}: {payload[:200]}")
+            out = json.loads(payload)
+            toks = out["jsonData"]["tokens"][0]
+            return len(toks) - prompt_len  # new tokens only
+
+        return call
+
+    try:
+        stats = closed_loop(make_call, seconds, concurrency, warmup_calls=2)
+    finally:
+        harness.stop()
+        if component.batcher is not None:
+            component.batcher.close()
+    model = component._model
+    avg_ctx = prompt_len + max_new_tokens / 2.0
+    tokens_per_s = stats.pop("rows_per_s")
+    stats.update(
+        {
+            "model": "llm-decoder",
+            "transport": "engine REST, continuous batching",
+            "tokens_per_s": tokens_per_s,
+            "prompt_len": prompt_len,
+            "max_new_tokens": max_new_tokens,
+            "slots": slots,
+            "steps_per_poll": steps_per_poll,
+            "mfu_pct": _mfu(tokens_per_s, model.flops_per_token(avg_ctx), peak),
+        }
+    )
+    return stats
+
+
+def run_model_tier(
+    seconds: float = 8.0,
+    tiny: bool = False,
+) -> Dict[str, Any]:
+    """Run all three model benches; ``tiny=True`` shrinks models/windows for
+    the CPU test tier."""
+    info = device_info()
+    peak = info["peak_bf16_flops"]
+    results: Dict[str, Any] = {"device": info}
+    with tempfile.TemporaryDirectory(prefix="seldon-tpu-bench-") as root:
+        if tiny:
+            results["resnet50_rest"] = bench_resnet50_rest(
+                root, seconds=seconds, concurrency=2, batch=2, image_size=64, peak=peak
+            )
+            results["bert_grpc"] = bench_bert_grpc(
+                root,
+                seconds=seconds,
+                concurrency=2,
+                batch=2,
+                seq=16,
+                config={
+                    "vocab_size": 512, "d_model": 64, "n_layers": 2,
+                    "n_heads": 2, "d_ff": 128, "max_seq": 64,
+                },
+                peak=peak,
+            )
+            results["llm_generate"] = bench_generate(
+                root,
+                seconds=seconds,
+                concurrency=2,
+                prompt_len=4,
+                max_new_tokens=8,
+                slots=2,
+                config={
+                    "vocab_size": 256, "d_model": 64, "n_layers": 2, "n_heads": 2,
+                    "n_kv_heads": 2, "d_ff": 128, "max_seq": 64,
+                },
+                peak=peak,
+            )
+        else:
+            results["resnet50_rest"] = bench_resnet50_rest(root, seconds=seconds, peak=peak)
+            results["bert_grpc"] = bench_bert_grpc(root, seconds=seconds, peak=peak)
+            results["llm_generate"] = bench_generate(
+                root,
+                seconds=seconds,
+                prompt_len=128,
+                max_new_tokens=64,
+                config={
+                    "vocab_size": 32000, "d_model": 1024, "n_layers": 12,
+                    "n_heads": 16, "n_kv_heads": 16, "d_ff": 2816, "max_seq": 512,
+                },
+                peak=peak,
+            )
+    return results

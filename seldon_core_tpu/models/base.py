@@ -44,3 +44,9 @@ class ServedModel:
 
         repl = NamedSharding(mesh, PartitionSpec())
         return jax.tree_util.tree_map(lambda _: repl, params)
+
+    def flops_per_row(self, seq_len: int = None) -> float:
+        """Analytic forward-pass FLOPs for one input row (one image / one
+        sequence of ``seq_len`` tokens). Used by the benchmark tier to
+        report MFU against the chip's peak; ``None`` means unknown."""
+        return None

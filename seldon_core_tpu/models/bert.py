@@ -133,6 +133,16 @@ class BertClassifier(ServedModel):
         logits = pooled.astype(jnp.float32) @ params["classifier"]["w"] + params["classifier"]["b"]
         return logits
 
+    def flops_per_row(self, seq_len: int = None) -> float:
+        """Matmul FLOPs for one sequence of ``seq_len`` tokens (default:
+        example_input_shape): per token per layer 8*D^2 (qkv+out) + 4*T*D
+        (scores + attn*V) + 4*D*F (FFN), plus pooler + classifier head."""
+        cfg = self.cfg
+        T = int(seq_len or self.example_input_shape[0])
+        D, F = cfg.d_model, cfg.d_ff
+        per_token = cfg.n_layers * (8.0 * D * D + 4.0 * T * D + 4.0 * D * F)
+        return T * per_token + 2.0 * D * D + 2.0 * D * cfg.num_classes
+
     def param_sharding(self, mesh, params):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P

@@ -86,6 +86,27 @@ class DecoderLM(ServedModel):
         self.example_input_shape = (16,)  # token ids
         self.compute_dtype = self.cfg.dtype
 
+    def flops_per_token(self, context_len: int) -> float:
+        """Matmul FLOPs to process ONE token attending over ``context_len``
+        keys: q/kv/out projections + scores/attn*V + gated FFN (3 matmuls;
+        only the routed expert is active under MoE) + lm head."""
+        cfg = self.cfg
+        D, F = cfg.d_model, cfg.d_ff
+        kv_dim = cfg.n_kv_heads * cfg.head_dim
+        per_layer = (
+            2.0 * D * D                  # q proj
+            + 2.0 * 2.0 * D * kv_dim     # k,v proj
+            + 2.0 * D * D                # out proj
+            + 4.0 * context_len * D      # scores + attn*V
+            + 6.0 * D * F                # SwiGLU: gate, up, down
+        )
+        return cfg.n_layers * per_layer + 2.0 * D * cfg.vocab_size
+
+    def flops_per_row(self, seq_len: int = None) -> float:
+        """Full-forward FLOPs for one sequence (causal: average context T/2)."""
+        T = int(seq_len or self.example_input_shape[0])
+        return T * self.flops_per_token(T / 2.0)
+
     # ------------------------------------------------------------------
     # params
     # ------------------------------------------------------------------

@@ -129,3 +129,28 @@ class ResNet50(ServedModel):
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         logits = x.astype(jnp.float32) @ params["fc"]["w"] + params["fc"]["b"]
         return logits
+
+    def flops_per_row(self, seq_len: int = None) -> float:
+        """Exact conv+fc FLOPs for one image, counting a multiply-add as 2
+        (the MFU convention) — ~8.2 GFLOP at 224x224 (= 2 x 4.1 GMAC)."""
+
+        def conv(h, kh, kw, cin, cout, stride):
+            h_out = -(-h // stride)  # SAME padding
+            return h_out, 2.0 * h_out * h_out * kh * kw * cin * cout
+
+        h, total = conv(self.image_size, 7, 7, 3, 64, 2)
+        h = -(-h // 2)  # 3x3/2 max pool
+        c_in = 64
+        for stage_idx, (blocks, c_out) in enumerate(STAGES):
+            width = c_out // 4
+            for b in range(blocks):
+                stride = 2 if (b == 0 and stage_idx > 0) else 1
+                _, f1 = conv(h, 1, 1, c_in, width, 1)
+                h2, f2 = conv(h, 3, 3, width, width, stride)
+                _, f3 = conv(h2, 1, 1, width, c_out, 1)
+                total += f1 + f2 + f3
+                if b == 0:
+                    _, fp = conv(h, 1, 1, c_in, c_out, stride)
+                    total += fp
+                h, c_in = h2, c_out
+        return total + 2.0 * 2048 * self.num_classes

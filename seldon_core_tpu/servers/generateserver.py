@@ -44,6 +44,7 @@ class GenerateServer(SeldonComponent):
         slots: int = 8,
         max_seq: Optional[int] = None,
         shard_cache_seq: bool = False,
+        steps_per_poll: int = 8,
         **kwargs,
     ):
         self.model_uri = model_uri
@@ -53,6 +54,7 @@ class GenerateServer(SeldonComponent):
         self._shard_cache_seq = bool(shard_cache_seq) if not isinstance(
             shard_cache_seq, str
         ) else shard_cache_seq.lower() == "true"
+        self._steps_per_poll = int(steps_per_poll)
         self._extra = kwargs
         self.batcher = None
         self._model = None
@@ -75,6 +77,7 @@ class GenerateServer(SeldonComponent):
             max_seq=self._max_seq,
             mesh=self._mesh,
             shard_cache_seq=self._shard_cache_seq,
+            steps_per_poll=self._steps_per_poll,
         )
         self.batcher.start()
         logger.info(

@@ -15,7 +15,10 @@ calls"). This scheduler closes that gap the TPU way:
   ``dynamic_update_slice``, and the next fused step decodes old + new
   lanes together (``DecoderLM.decode_step_ragged`` — per-row positions).
 * Sampling is fused into the decode executable (greedy/temperature per
-  lane), so the only host<->device traffic per step is one int32 per lane.
+  lane), and bursts of up to ``steps_per_poll`` decode steps run as ONE
+  device call (``lax.scan`` over the fused step), so the host syncs once
+  per burst — not once per token. Dispatch/sync latency is the decode
+  bottleneck off-device; this amortises it k-fold.
 * With a mesh, params/cache shard over the ``model`` axis (KV heads) and
   optionally the ``seq`` axis (cache length) — long prompts span ICI.
 
@@ -51,6 +54,10 @@ class GenRequest:
 class _Slot:
     request: GenRequest
     emitted: List[int] = dataclasses.field(default_factory=list)
+    # the prefill's first sampled token stays ON DEVICE at admit (reading
+    # it would cost a host sync per admission); the next burst's [0] row
+    # carries it to the host instead
+    first_pending: bool = True
 
 
 class ContinuousBatcher:
@@ -157,7 +164,26 @@ class ContinuousBatcher:
             first = jnp.where(temp > 0, sampled, greedy)
             return first, cache_one, key
 
-        self._step_fn = jax.jit(fused_step, donate_argnums=(1,))
+        def fused_burst(params, cache, cur_tok, pos, active, temps, keys, k):
+            """k fused decode steps as one executable; returns [k, slots]
+            tokens so the host syncs once per burst."""
+
+            def body(carry, _):
+                cache, cur_tok, pos, keys = carry
+                nxt, pos, cache, keys = fused_step(
+                    params, cache, cur_tok, pos, active, temps, keys
+                )
+                return (cache, nxt, pos, keys), nxt
+
+            (cache, cur_tok_out, pos, keys), toks = lax.scan(
+                body, (cache, cur_tok, pos, keys), None, length=k
+            )
+            # row 0 = the tokens the burst STARTED from (deferred prefill
+            # firsts ride home with the burst's one sync)
+            toks = jnp.concatenate([cur_tok[None, :], toks], axis=0)
+            return toks, cur_tok_out, pos, cache, keys
+
+        self._burst_fn = jax.jit(fused_burst, donate_argnums=(1,), static_argnums=(7,))
         self._insert_fn = jax.jit(insert, donate_argnums=(0,))
         self._prefill_fn = jax.jit(prefill_one)
 
@@ -253,9 +279,10 @@ class ContinuousBatcher:
             self._cache, cache_one, slot, first[0], n, lane_key,
             self._cur_tok, self._pos, self._keys,
         )
-        self._active[slot] = _Slot(request=req, emitted=[int(first[0])])
+        # no host read here: prefill + insert stay fully async; the first
+        # token reaches the host with the next burst's sync
+        self._active[slot] = _Slot(request=req)
         self.stats["admitted"] += 1
-        self.stats["tokens"] += 1
 
     def _finish(self, slot: int) -> None:
         # a trailing eos token is kept in the output, like HF generate
@@ -281,7 +308,6 @@ class ContinuousBatcher:
         try:
             while not self._stop.is_set():
                 # admit as many queued requests as there are free slots
-                admitted = False
                 while len(self._active) < self.slots:
                     try:
                         req = self._queue.get_nowait()
@@ -290,13 +316,10 @@ class ContinuousBatcher:
                     free = next(i for i in range(self.slots) if i not in self._active)
                     try:
                         self._admit(free, req)
-                        admitted = True
                     except Exception as e:  # noqa: BLE001 - bad request
                         logger.exception("admit failed")
                         if not req.future.done():
                             req.future.set_exception(e)
-                if admitted:
-                    self._check_done()  # 1-token requests finish at prefill
                 if not self._active:
                     try:
                         req = self._queue.get(timeout=0.05)
@@ -313,30 +336,44 @@ class ContinuousBatcher:
                     active[i] = True
                 active_dev = jnp.asarray(active)
                 temps_dev = jnp.asarray(temps)
-                # run a burst of fused steps, then poll the queue again —
-                # bounds admission latency without a host sync per token
-                for _ in range(self.steps_per_poll):
-                    nxt, self._pos, self._cache, self._keys = self._step_fn(
+                # one fused burst of k steps = ONE device call + ONE host
+                # sync; k never overshoots the tightest remaining budget so
+                # requests still stop at exactly max_new_tokens (a pending
+                # prefill-first consumes one unit of that budget)
+                min_remaining = min(
+                    s.request.max_new_tokens
+                    - len(s.emitted)
+                    - (1 if s.first_pending else 0)
+                    for s in self._active.values()
+                )
+                k = max(1, min(self.steps_per_poll, min_remaining))
+                # power-of-two bucket: at most log2(steps_per_poll)+1
+                # compiled burst variants
+                while k & (k - 1):
+                    k &= k - 1
+                toks, self._cur_tok, self._pos, self._cache, self._keys = (
+                    self._burst_fn(
                         self.params, self._cache, self._cur_tok, self._pos,
-                        active_dev, temps_dev, self._keys,
+                        active_dev, temps_dev, self._keys, k,
                     )
-                    self._cur_tok = nxt
-                    self.stats["steps"] += 1
-                    host_next = np.asarray(nxt)
-                    done_any = False
-                    for slot, s in self._active.items():
-                        s.emitted.append(int(host_next[slot]))
+                )
+                self.stats["steps"] += k
+                # [k+1, slots]; row 0 = burst-start tokens — the one sync
+                host_toks = np.asarray(toks)
+                for slot, s in self._active.items():
+                    req = s.request
+                    start = 0 if s.first_pending else 1
+                    s.first_pending = False
+                    for t in host_toks[start:, slot]:
+                        s.emitted.append(int(t))
                         self.stats["tokens"] += 1
-                        req = s.request
                         if len(s.emitted) >= req.max_new_tokens or (
-                            req.eos_id is not None and s.emitted[-1] == req.eos_id
+                            req.eos_id is not None and int(t) == req.eos_id
                         ):
-                            done_any = True
-                    if done_any:
-                        self._check_done()
-                        break
-                    if not self._queue.empty() and len(self._active) < self.slots:
-                        break
+                            # tokens decoded past eos in this burst are
+                            # dropped here; the lane is reclaimed below
+                            break
+                self._check_done()
         except Exception:  # noqa: BLE001 - surface scheduler death to callers
             logger.exception("continuous batcher loop died")
             # poison the batcher: the donated cache buffers are gone, a

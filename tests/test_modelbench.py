@@ -1,0 +1,57 @@
+"""Model-level benchmark tier smoke tests (tiny models on the CPU mesh).
+
+The real numbers come from ``python bench.py`` on the chip; here we only
+prove the harness measures the full stack without errors and reports the
+expected fields (counterpart of the reference's reproducible benchmark
+notebook — reference: notebooks/benchmark_simple_model.ipynb)."""
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu import modelbench
+
+
+def test_device_info_reports_platform():
+    info = modelbench.device_info()
+    assert info["platform"]
+    assert "device_kind" in info
+
+
+def test_flops_analytics_sane():
+    from seldon_core_tpu.models.bert import BertClassifier
+    from seldon_core_tpu.models.llm import DecoderLM
+    from seldon_core_tpu.models.resnet import ResNet50
+
+    # ResNet-50 @224 is ~8.2 GFLOP under the 2xMAC convention
+    assert 7.5e9 < ResNet50().flops_per_row() < 9.0e9
+    # BERT-base @128 tokens ~22 GFLOP
+    assert 18e9 < BertClassifier().flops_per_row(128) < 26e9
+    lm = DecoderLM()
+    assert lm.flops_per_token(64) > 0
+    assert lm.flops_per_row(64) > lm.flops_per_token(64)
+
+
+def test_model_tier_tiny_end_to_end():
+    results = modelbench.run_model_tier(seconds=1.5, tiny=True)
+    for key in ("resnet50_rest", "bert_grpc", "llm_generate"):
+        stats = results[key]
+        assert stats["requests"] > 0, key
+        assert stats["req_per_s"] > 0, key
+        assert stats["p50_ms"] > 0, key
+        assert stats["p99_ms"] >= stats["p50_ms"], key
+    assert results["llm_generate"]["tokens_per_s"] > 0
+    # CPU has no published peak -> MFU is None there; on TPU it's a number
+    mfu = results["resnet50_rest"]["mfu_pct"]
+    assert mfu is None or 0 < mfu < 100
+
+
+def test_closed_loop_counts_rows():
+    def make_call():
+        def call():
+            return 3
+
+        return call
+
+    stats = modelbench.closed_loop(make_call, seconds=0.2, concurrency=2)
+    assert stats["rows_per_s"] == pytest.approx(3 * stats["req_per_s"], rel=0.01)
+    assert stats["requests"] > 0
