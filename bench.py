@@ -56,6 +56,10 @@ def run_model_tier(repo: str) -> dict:
         # smoke-test mode: never overwrite the published chip numbers
         results["tiny"] = True
         return results
+    if results.get("device", {}).get("platform") != "tpu":
+        # dev-box run: report but never replace the published chip numbers
+        results["publish_skipped"] = "not a TPU device"
+        return results
     try:
         path = os.path.join(repo, "BASELINE.json")
         with open(path) as f:

@@ -47,7 +47,9 @@ def main(argv=None) -> None:
     spec = default_predictor(spec)
     validate_predictor(spec)
 
-    app = EngineApp(spec)
+    from .graph.service import RequestLogger
+
+    app = EngineApp(spec, request_logger=RequestLogger.from_env())
     try:
         asyncio.run(app.serve(args.host, args.http_port, None if args.no_grpc else args.grpc_port))
     except KeyboardInterrupt:

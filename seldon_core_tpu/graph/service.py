@@ -41,6 +41,20 @@ class RequestLogger:
     def __init__(self, sink=None):
         self.sink = sink
 
+    @classmethod
+    def from_env(cls) -> "RequestLogger":
+        """CloudEvents POST sink when SELDON_MESSAGE_LOGGING_SERVICE is set
+        (reference: PredictionService.java:121-190, props
+        application.properties:20-30); no-op logger otherwise."""
+        import os
+
+        url = os.environ.get("SELDON_MESSAGE_LOGGING_SERVICE")
+        if not url:
+            return cls()
+        from ..request_logging import CloudEventsSink
+
+        return cls(CloudEventsSink(url))
+
     def log(self, puid: str, request: Dict, response: Dict) -> None:
         if self.sink is None:
             return

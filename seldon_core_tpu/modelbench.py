@@ -296,6 +296,7 @@ def bench_bert_grpc(
     import grpc
 
     from .proto import prediction_pb2 as pb
+    from .proto.services import method_path
     from .servers.jaxserver import JAXServer
 
     cfg = dict(config or {})
@@ -319,7 +320,7 @@ def bench_bert_grpc(
     def make_call():
         channel = grpc.insecure_channel(target)
         rpc = channel.unary_unary(
-            "/seldontpu.Seldon/Predict",
+            method_path("Seldon", "Predict"),
             request_serializer=lambda b: b,
             response_deserializer=pb.SeldonMessage.FromString,
         )

@@ -1,10 +1,13 @@
-"""Model artifact download: file://, gs://, s3://, http(s)://.
+"""Model artifact download: file://, gs://, s3://, azure blob, http(s)://.
 
-Parity with reference: python/seldon_core/storage.py:37-160 (GCS/S3/Azure/
-file pulls into a local dir used by prepackaged servers). Cloud SDKs are
-not in this image, so gs:// and s3:// are gated behind optional imports and
-raise a clear error when the SDK is missing; file:// and plain paths work
-everywhere (and are what the tests and local scheduler use).
+Parity with reference: python/seldon_core/storage.py:25-160 (GCS/S3/Azure/
+file pulls into a local dir used by prepackaged servers; azure URIs are
+``https://<account>.blob.core.windows.net/<container>/<path>``). Cloud SDKs
+are not in this image, so the cloud branches resolve their client through
+an injectable factory (``Storage.set_client_factory``): production uses
+the real SDK, tests inject fakes so every branch is exercised; a missing
+SDK raises a clear error. file:// and plain paths work everywhere (and are
+what the tests and local scheduler use).
 """
 
 from __future__ import annotations
@@ -13,18 +16,36 @@ import logging
 import os
 import shutil
 import tempfile
+from typing import Callable, Dict, Optional
 from urllib.parse import urlparse
 
 logger = logging.getLogger(__name__)
 
+_AZURE_HOST_SUFFIX = ".blob.core.windows.net"
+
 
 class Storage:
+    # kind -> zero/one-arg factory returning a cloud client; tests inject
+    # fakes here, production lazily builds the real SDK client
+    _client_factories: Dict[str, Optional[Callable]] = {
+        "gcs": None,
+        "s3": None,
+        "azure": None,
+    }
+
+    @classmethod
+    def set_client_factory(cls, kind: str, factory: Optional[Callable]) -> None:
+        if kind not in cls._client_factories:
+            raise ValueError(f"unknown storage kind {kind!r}")
+        cls._client_factories[kind] = factory
+
     @staticmethod
     def download(uri: str, out_dir: str | None = None) -> str:
         logger.info("Copying contents of %s to local", uri)
         if out_dir is None:
             out_dir = tempfile.mkdtemp()
-        scheme = urlparse(uri).scheme
+        parsed = urlparse(uri)
+        scheme = parsed.scheme
         if scheme in ("", "file"):
             return Storage._download_local(uri, out_dir)
         if scheme == "gs":
@@ -32,9 +53,12 @@ class Storage:
         if scheme == "s3":
             return Storage._download_s3(uri, out_dir)
         if scheme in ("http", "https"):
+            if parsed.netloc.endswith(_AZURE_HOST_SUFFIX):
+                return Storage._download_azure(uri, out_dir)
             return Storage._download_http(uri, out_dir)
         raise ValueError(
-            f"cannot recognize storage type for {uri}; supported: file://, gs://, s3://, http(s)://"
+            f"cannot recognize storage type for {uri}; supported: file://, "
+            f"gs://, s3://, https://*{_AZURE_HOST_SUFFIX}/..., http(s)://"
         )
 
     @staticmethod
@@ -55,50 +79,120 @@ class Storage:
         return out_dir
 
     @staticmethod
-    def _download_gcs(uri: str, out_dir: str) -> str:
+    def _under_prefix(key: str, prefix: str) -> bool:
+        """True when key is the prefix object itself or inside the prefix
+        "directory". Listings are STRING-prefix matches, so without this a
+        sibling like models/iris2/x would match prefix models/iris and its
+        relpath would escape out_dir via '..'."""
+        if not prefix or prefix.endswith("/"):
+            return True
+        return key == prefix or key.startswith(prefix + "/")
+
+    @staticmethod
+    def _dst_path(out_dir: str, key: str, prefix: str) -> str:
+        rel = os.path.relpath(key, prefix)
+        if rel.startswith(".."):
+            raise RuntimeError(f"object key {key!r} escapes prefix {prefix!r}")
+        dst = os.path.join(out_dir, rel if rel != "." else os.path.basename(key))
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        return dst
+
+    @staticmethod
+    def _gcs_client():
+        factory = Storage._client_factories["gcs"]
+        if factory is not None:
+            return factory()
         try:
             from google.cloud import storage as gcs  # type: ignore
         except ImportError as e:
             raise RuntimeError(
                 "gs:// model URIs need google-cloud-storage, not present in this image"
             ) from e
+        return gcs.Client()
+
+    @staticmethod
+    def _download_gcs(uri: str, out_dir: str) -> str:
         parsed = urlparse(uri)
-        client = gcs.Client()
+        client = Storage._gcs_client()
         bucket = client.bucket(parsed.netloc)
         prefix = parsed.path.lstrip("/")
-        blobs = list(bucket.list_blobs(prefix=prefix))
+        blobs = [
+            b for b in bucket.list_blobs(prefix=prefix)
+            if Storage._under_prefix(b.name, prefix)
+        ]
         if not blobs:
             raise RuntimeError(f"no objects under {uri}")
         for blob in blobs:
-            rel = os.path.relpath(blob.name, prefix)
-            dst = os.path.join(out_dir, rel if rel != "." else os.path.basename(blob.name))
-            os.makedirs(os.path.dirname(dst), exist_ok=True)
-            blob.download_to_filename(dst)
+            blob.download_to_filename(Storage._dst_path(out_dir, blob.name, prefix))
         return out_dir
 
     @staticmethod
-    def _download_s3(uri: str, out_dir: str) -> str:
+    def _s3_client():
+        factory = Storage._client_factories["s3"]
+        if factory is not None:
+            return factory()
         try:
             import boto3  # type: ignore
         except ImportError as e:
             raise RuntimeError("s3:// model URIs need boto3, not present in this image") from e
+        return boto3.client("s3", endpoint_url=os.environ.get("S3_ENDPOINT") or None)
+
+    @staticmethod
+    def _download_s3(uri: str, out_dir: str) -> str:
         parsed = urlparse(uri)
-        s3 = boto3.client(
-            "s3",
-            endpoint_url=os.environ.get("S3_ENDPOINT") or None,
-        )
+        s3 = Storage._s3_client()
         prefix = parsed.path.lstrip("/")
         paginator = s3.get_paginator("list_objects_v2")
         n = 0
         for page in paginator.paginate(Bucket=parsed.netloc, Prefix=prefix):
             for obj in page.get("Contents", []):
-                rel = os.path.relpath(obj["Key"], prefix)
-                dst = os.path.join(out_dir, rel if rel != "." else os.path.basename(obj["Key"]))
-                os.makedirs(os.path.dirname(dst), exist_ok=True)
-                s3.download_file(parsed.netloc, obj["Key"], dst)
+                if not Storage._under_prefix(obj["Key"], prefix):
+                    continue
+                s3.download_file(
+                    parsed.netloc, obj["Key"],
+                    Storage._dst_path(out_dir, obj["Key"], prefix),
+                )
                 n += 1
         if n == 0:
             raise RuntimeError(f"no objects under {uri}")
+        return out_dir
+
+    @staticmethod
+    def _azure_client(account_url: str):
+        factory = Storage._client_factories["azure"]
+        if factory is not None:
+            return factory(account_url)
+        try:
+            from azure.storage.blob import BlobServiceClient  # type: ignore
+        except ImportError as e:
+            raise RuntimeError(
+                "azure blob model URIs need azure-storage-blob, not present in this image"
+            ) from e
+        return BlobServiceClient(account_url=account_url)
+
+    @staticmethod
+    def _download_azure(uri: str, out_dir: str) -> str:
+        """https://<account>.blob.core.windows.net/<container>/<prefix>
+        (reference: python/seldon_core/storage.py:25-65 azure handling)."""
+        parsed = urlparse(uri)
+        parts = parsed.path.lstrip("/").split("/", 1)
+        container = parts[0]
+        prefix = parts[1] if len(parts) > 1 else ""
+        if not container:
+            raise ValueError(f"azure URI {uri} has no container")
+        service = Storage._azure_client(f"{parsed.scheme}://{parsed.netloc}")
+        container_client = service.get_container_client(container)
+        blobs = [
+            b for b in container_client.list_blobs(name_starts_with=prefix)
+            if Storage._under_prefix(getattr(b, "name", None) or b["name"], prefix)
+        ]
+        if not blobs:
+            raise RuntimeError(f"no objects under {uri}")
+        for blob in blobs:
+            name = getattr(blob, "name", None) or blob["name"]
+            dst = Storage._dst_path(out_dir, name, prefix)
+            with open(dst, "wb") as f:
+                f.write(container_client.download_blob(name).readall())
         return out_dir
 
     @staticmethod

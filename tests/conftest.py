@@ -34,10 +34,12 @@ class RestTestClient:
     def __init__(self, app):
         self.app = app
 
-    def call(self, path: str, body=None, method: str = "POST", query: str = ""):
+    def call(self, path: str, body=None, method: str = "POST", query: str = "",
+             headers=None):
         raw = _json.dumps(body).encode() if body is not None else b""
-        headers = {"content-type": "application/json"} if raw else {}
-        req = Request(method, path, query, headers, raw)
+        hdrs = {"content-type": "application/json"} if raw else {}
+        hdrs.update(headers or {})
+        req = Request(method, path, query, hdrs, raw)
         resp = asyncio.run(self.app._dispatch(req))
         payload = _json.loads(resp.body) if resp.body else None
         return resp.status, payload

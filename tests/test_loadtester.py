@@ -1,0 +1,112 @@
+"""Load tester against a live engine (counterpart of reference
+util/loadtester/ locust suite, reporting benchmarking.md's table)."""
+
+import asyncio
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from seldon_core_tpu import loadtester
+from seldon_core_tpu.graph.service import EngineApp
+from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
+
+from _net import free_port
+
+
+@pytest.fixture
+def engine_port():
+    spec = default_predictor(
+        PredictorSpec.from_dict(
+            {"name": "lt", "graph": {"name": "m", "implementation": "SIMPLE_MODEL"}}
+        )
+    )
+    app = EngineApp(spec)
+    port = free_port()
+    loop = asyncio.new_event_loop()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(app.rest_app().serve_forever("127.0.0.1", port))
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.2).close()
+            break
+        except OSError:
+            time.sleep(0.02)
+    yield port
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def test_build_payload_fixed_ndarray():
+    body = loadtester.build_payload({"ndarray": "[[1.0, 2.0]]"})
+    assert body == {"data": {"ndarray": [[1.0, 2.0]]}}
+
+
+def test_build_payload_from_contract(tmp_path):
+    contract = {
+        "features": [
+            {"name": "f", "ftype": "continuous", "range": [0, 1], "repeat": 3}
+        ],
+        "targets": [],
+    }
+    path = tmp_path / "contract.json"
+    path.write_text(json.dumps(contract))
+    body = loadtester.build_payload({"contract": str(path), "batch": 4})
+    assert len(body["data"]["ndarray"]) == 4
+    assert len(body["data"]["names"]) == 3
+
+
+def test_rest_load_against_engine(engine_port):
+    stats = loadtester.run_load(
+        f"http://127.0.0.1:{engine_port}",
+        workers=2,
+        clients_per_worker=2,
+        seconds=1.5,
+        ndarray="[[1.0, 2.0]]",
+    )
+    assert stats["requests"] > 0
+    assert stats["failures"] == 0
+    assert stats["rps"] > 0
+    assert stats["p50_ms"] is not None
+    assert stats["p99_ms"] >= stats["p50_ms"]
+
+
+def test_binary_rest_load_against_engine(engine_port):
+    stats = loadtester.run_load(
+        f"http://127.0.0.1:{engine_port}",
+        workers=1,
+        clients_per_worker=2,
+        seconds=1.0,
+        ndarray="[[1.0, 2.0]]",
+        binary=True,
+    )
+    assert stats["requests"] > 0
+    assert stats["failures"] == 0
+
+
+def test_failures_counted_against_dead_target():
+    stats = loadtester.run_load(
+        "http://127.0.0.1:1",
+        workers=1,
+        clients_per_worker=2,
+        seconds=0.5,
+        timeout=0.3,
+    )
+    assert stats["requests"] == 0
+    assert stats["failures"] > 0
+
+
+def test_format_table_shape():
+    stats = loadtester.aggregate([([0.01, 0.02, 0.03], 1)], elapsed=1.0, name="predict")
+    table = loadtester.format_table(stats)
+    lines = table.splitlines()
+    assert "# reqs" in lines[0] and "req/s" in lines[0]
+    assert "p50%" in lines[2] and "p99%" in lines[2]
+    assert stats["requests"] == 3 and stats["failures"] == 1
