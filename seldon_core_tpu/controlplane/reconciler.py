@@ -381,10 +381,16 @@ class DeploymentController:
             for spec in desired:
                 if spec.kind != "engine":
                     continue
+                pspec = dep.predictor(spec.predictor)
+                mesh_spec = pspec.tpu_mesh if pspec else None
                 if self.placement.assigned(spec.name) is None:
-                    pspec = dep.predictor(spec.predictor)
-                    self.placement.allocate(spec.name, pspec.tpu_mesh if pspec else None)
+                    self.placement.allocate(spec.name, mesh_spec)
                     fresh.append(spec.name)
+                if mesh_spec:
+                    # hand the placed device block to the engine as a
+                    # named mesh: its in-process jaxserver units shard
+                    # over exactly the chips this engine was allocated
+                    spec.mesh = self.placement.mesh_for(spec.name, mesh_spec)
         except PlacementError:
             for name in fresh:
                 self.placement.release(name)

@@ -59,6 +59,10 @@ class ComponentSpec:
     # routable components receive external traffic from the gateway:
     # engines, and direct-exposed models in no-engine mode
     routable: bool = False
+    # jax.sharding.Mesh over the engine's placement-allocated device block
+    # (in-process runtime only; subprocess engines rebuild it from the
+    # spec's tpuMesh over their own host's devices)
+    mesh: Any = None
 
 
 class ComponentHandle:
@@ -148,10 +152,14 @@ class InProcessRuntime:
         from ..graph.spec import PredictorSpec, default_predictor, validate_predictor
 
         if spec.kind == "engine":
+            from ..graph.service import RequestLogger
+
             pspec = PredictorSpec.from_dict(spec.engine_spec)
             pspec = default_predictor(pspec)
             validate_predictor(pspec)
-            app = EngineApp(pspec)
+            app = EngineApp(
+                pspec, mesh=spec.mesh, request_logger=RequestLogger.from_env()
+            )
             app.start_readiness_loop()
             tasks = []
             if self.open_ports:

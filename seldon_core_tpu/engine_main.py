@@ -49,7 +49,13 @@ def main(argv=None) -> None:
 
     from .graph.service import RequestLogger
 
-    app = EngineApp(spec, request_logger=RequestLogger.from_env())
+    mesh = None
+    if spec.tpu_mesh:
+        # standalone engine process: the mesh spans this host's own devices
+        from .parallel import make_mesh
+
+        mesh = make_mesh(spec.tpu_mesh)
+    app = EngineApp(spec, request_logger=RequestLogger.from_env(), mesh=mesh)
     try:
         asyncio.run(app.serve(args.host, args.http_port, None if args.no_grpc else args.grpc_port))
     except KeyboardInterrupt:

@@ -108,6 +108,7 @@ class GraphExecutor:
         timeout_s: float = 5.0,
         batching: Optional[Dict[str, Dict]] = None,
         inprocess_workers: int = 32,
+        mesh=None,
     ):
         """registry: unit name -> user object for INPROCESS units that are
         neither builtin implementations nor prepackaged servers.
@@ -116,13 +117,18 @@ class GraphExecutor:
         Sized independently of cpu_count (asyncio's default pool is
         cpu+4 — on a 1-vCPU TPU VM that is 5 threads, which serialises
         concurrent device calls that would otherwise overlap their
-        dispatch/transfer latency)."""
+        dispatch/transfer latency).
+        mesh: jax.sharding.Mesh handed to mesh-aware in-process prepackaged
+        servers (jaxserver/generateserver) so one served model spans the
+        engine's allocated TPU block (tensor parallelism over ICI —
+        the predictor spec's tpuMesh, placed by the control plane)."""
         from concurrent.futures import ThreadPoolExecutor
 
         self.spec = spec
         self._registry = registry or {}
         self._timeout = timeout_s
         self._batching = batching or {}
+        self._mesh = mesh
         self._pool = ThreadPoolExecutor(
             max_workers=int(inprocess_workers), thread_name_prefix="unit-call"
         )
@@ -165,9 +171,30 @@ class GraphExecutor:
             except TypeError:
                 return cls()
         if impl in PREPACKAGED_SERVERS:
+            import inspect
+
             module_name, cls_name = PREPACKAGED_SERVERS[impl].rsplit(".", 1)
             cls = getattr(importlib.import_module(module_name), cls_name)
-            obj = cls(model_uri=unit.model_uri, **params)
+            if self._mesh is not None:
+                # signature-gated, NOT try/except: a constructor bug must
+                # surface, never silently degrade an N-chip allocation to
+                # an unsharded single-device model
+                sig = inspect.signature(cls.__init__)
+                mesh_aware = "mesh" in sig.parameters or any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in sig.parameters.values()
+                )
+                if mesh_aware:
+                    obj = cls(model_uri=unit.model_uri, mesh=self._mesh, **params)
+                else:
+                    logger.warning(
+                        "unit %s: %s is not mesh-aware; serving unsharded "
+                        "despite a %d-device allocation",
+                        unit.name, cls_name, self._mesh.size,
+                    )
+                    obj = cls(model_uri=unit.model_uri, **params)
+            else:
+                obj = cls(model_uri=unit.model_uri, **params)
             if hasattr(obj, "load"):
                 obj.load()
             return obj

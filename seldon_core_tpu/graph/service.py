@@ -81,9 +81,10 @@ class EngineApp:
         metrics: MetricsRegistry = REGISTRY,
         request_logger: Optional[RequestLogger] = None,
         batching: Optional[Dict[str, Dict]] = None,
+        mesh=None,
     ):
         self.spec = spec
-        self.executor = GraphExecutor(spec, registry=registry, batching=batching)
+        self.executor = GraphExecutor(spec, registry=registry, batching=batching, mesh=mesh)
         self.metrics = metrics
         self.request_logger = request_logger or RequestLogger()
         self.paused = False

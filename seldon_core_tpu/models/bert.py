@@ -49,7 +49,7 @@ class BertClassifier(ServedModel):
     def __init__(self, **config):
         fields = {f.name for f in dataclasses.fields(BertConfig)}
         self.cfg = BertConfig(**{k: v for k, v in config.items() if k in fields})
-        self.example_input_shape = (64,)
+        self.example_input_shape = (min(64, self.cfg.max_seq),)
         self.compute_dtype = self.cfg.dtype
 
     def init_params(self, seed: int = 0):

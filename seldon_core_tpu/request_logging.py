@@ -53,6 +53,7 @@ class CloudEventsSink:
         self.source = source
         self.timeout_s = timeout_s
         self._queue: "queue.Queue[Optional[Dict]]" = queue.Queue(maxsize=maxsize)
+        self._closing = threading.Event()
         self.stats = {"posted": 0, "dropped": 0, "errors": 0}
         self._thread = threading.Thread(
             target=self._worker, name="cloudevents-sink", daemon=True
@@ -60,6 +61,9 @@ class CloudEventsSink:
         self._thread.start()
 
     def __call__(self, event: Dict[str, Any]) -> None:
+        if self._closing.is_set():
+            self.stats["dropped"] += 1
+            return
         try:
             self._queue.put_nowait(event)
         except queue.Full:
@@ -68,8 +72,13 @@ class CloudEventsSink:
 
     def _worker(self) -> None:
         while True:
-            event = self._queue.get()
-            if event is None:
+            try:
+                event = self._queue.get(timeout=0.5)
+            except queue.Empty:
+                if self._closing.is_set():
+                    return
+                continue
+            if event is None or self._closing.is_set():
                 return
             try:
                 event.setdefault("source", self.source)
@@ -92,7 +101,14 @@ class CloudEventsSink:
                 logger.warning("cloudevents post to %s failed: %s", self.url, e)
 
     def close(self) -> None:
-        self._queue.put(None)
+        # non-blocking even with a full queue and a hung collector: the
+        # flag stops the worker at its next poll; the sentinel (when it
+        # fits) just wakes it early
+        self._closing.set()
+        try:
+            self._queue.put_nowait(None)
+        except queue.Full:
+            pass
         self._thread.join(timeout=self.timeout_s + 1.0)
 
 

@@ -286,7 +286,11 @@ class JAXComponent(SeldonComponent):
         donate = (1,) if self._donate else ()
         self._apply = jax.jit(apply_fn, donate_argnums=donate)
         if self.warmup_shape is not None:
-            x = np.zeros((1, *self.warmup_shape), dtype=self.warmup_dtype)
+            # batch must tile the mesh's data axis for the sharded input path
+            batch = 1
+            if self._mesh is not None:
+                batch = int(dict(self._mesh.shape).get("data", 1)) or 1
+            x = np.zeros((batch, *self.warmup_shape), dtype=self.warmup_dtype)
             jax.block_until_ready(self._apply(self.params, self._to_dev(x)))
         logger.info("JAXComponent %s compiled and warm", type(self).__name__)
 

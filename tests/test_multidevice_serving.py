@@ -1,0 +1,169 @@
+"""Multi-device serving e2e on the 8-virtual-device CPU mesh:
+
+    store -> reconciler -> TpuPlacement -> jaxserver(tpuMesh) -> engine predict
+
+The full control-plane path places a predictor's engine on an allocated
+device block, the engine hands that block to its in-process jaxserver as a
+named mesh, and the served model's params are genuinely sharded over it
+(tensor parallelism) while predictions flow end to end. (Counterpart of
+the reference's kind e2e tier testing/scripts/test_prepackaged_servers.py,
+which could only scale replicas — model sharding has no reference
+equivalent.)
+"""
+
+import asyncio
+import json
+import math
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from seldon_core_tpu.controlplane import (
+    DeploymentController,
+    Gateway,
+    ResourceStore,
+    SeldonDeployment,
+    TpuPlacement,
+)
+from seldon_core_tpu.controlplane.resource import STATE_AVAILABLE
+from seldon_core_tpu.controlplane.runtime import InProcessRuntime
+
+BERT_TINY = {
+    "vocab_size": 128,
+    "d_model": 32,
+    "n_layers": 2,
+    "n_heads": 4,
+    "d_ff": 64,
+    "max_seq": 16,
+    "num_classes": 3,
+}
+
+
+@pytest.fixture
+def model_dir(tmp_path):
+    d = tmp_path / "bert"
+    d.mkdir()
+    (d / "jax_config.json").write_text(
+        json.dumps({"family": "bert", "config": BERT_TINY})
+    )
+    return str(d)
+
+
+def deployment(model_dir, mesh_spec):
+    return SeldonDeployment.from_dict(
+        {
+            "name": "mdep",
+            "predictors": [
+                {
+                    "name": "p0",
+                    "tpuMesh": mesh_spec,
+                    "graph": {
+                        "name": "m",
+                        "implementation": "JAX_SERVER",
+                        "modelUri": model_dir,
+                    },
+                }
+            ],
+        }
+    )
+
+
+def test_reconcile_places_engine_on_mesh_and_serves(model_dir):
+    async def go():
+        store = ResourceStore()
+        placement = TpuPlacement(devices=jax.devices())
+        ctl = DeploymentController(
+            store,
+            runtime=InProcessRuntime(open_ports=False),
+            placement=placement,
+            gateway=Gateway(),
+        )
+        dep = deployment(model_dir, {"data": 2, "model": 4})
+        store.apply(dep)
+        status = await ctl.reconcile(dep.clone())
+        assert status.state == STATE_AVAILABLE
+        assert placement.capacity()["used"] == 8
+
+        engines = [
+            handle for handle, _ in ctl.components.values()
+            if handle.spec.kind == "engine"
+        ]
+        assert len(engines) == 1
+        app = engines[0].app
+        assert app.executor._mesh is not None
+        assert dict(app.executor._mesh.shape) == {"data": 2, "model": 4}
+
+        # the served params are REALLY sharded over the allocated block:
+        # at least one attention/ffn weight is partitioned across all 8
+        server = app.executor.root.client.user_object
+        leaves = jax.tree_util.tree_leaves(server.params)
+        partitioned = [
+            leaf for leaf in leaves
+            if len(leaf.sharding.device_set) == 8
+            and not leaf.sharding.is_fully_replicated
+        ]
+        assert partitioned, "no param leaf is sharded over the mesh"
+
+        # prediction flows through the engine across the sharded model
+        tokens = np.arange(1, 17, dtype=np.int32).reshape(2, 8)
+        out = await app.predict({"data": {"ndarray": tokens.tolist()}})
+        logits = np.asarray(out["data"]["ndarray"], dtype=np.float64)
+        assert logits.shape == (2, BERT_TINY["num_classes"])
+        assert np.isfinite(logits).all()
+
+        # teardown releases the block
+        await ctl.delete(dep)
+        assert placement.capacity()["used"] == 0
+
+    asyncio.run(go())
+
+
+def test_generate_server_sharded_through_engine(tmp_path):
+    """generate() serving with the KV cache sharded over the engine's
+    mesh (model axis for KV heads) — BASELINE config 5 at mesh scale."""
+    d = tmp_path / "llm"
+    d.mkdir()
+    (d / "jax_config.json").write_text(
+        json.dumps(
+            {
+                "family": "llm",
+                "config": {
+                    "vocab_size": 64, "d_model": 32, "n_layers": 2,
+                    "n_heads": 4, "n_kv_heads": 4, "d_ff": 64, "max_seq": 32,
+                },
+            }
+        )
+    )
+
+    async def go():
+        from seldon_core_tpu.graph.service import EngineApp
+        from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
+        from seldon_core_tpu.parallel import make_mesh
+
+        mesh = make_mesh({"model": 4})
+        spec = default_predictor(
+            PredictorSpec.from_dict(
+                {
+                    "name": "gen",
+                    "graph": {
+                        "name": "g",
+                        "implementation": "GENERATE_SERVER",
+                        "modelUri": str(d),
+                    },
+                }
+            )
+        )
+        app = EngineApp(spec, mesh=mesh)
+        out = await app.predict(
+            {"jsonData": {"prompt_tokens": [[1, 2, 3]], "max_new_tokens": 4}}
+        )
+        toks = out["jsonData"]["tokens"][0]
+        assert len(toks) == 3 + 4
+        server = app.executor.root.client.user_object
+        assert server.batcher.mesh is mesh
+        server.batcher.close()
+        await app.executor.close()
+
+    asyncio.run(go())
