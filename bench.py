@@ -32,6 +32,7 @@ import subprocess
 import sys
 
 REFERENCE_REST_RPS = 12088.95  # reference benchmarking.md:33-44
+REFERENCE_GRPC_RPS = 28256.39  # reference benchmarking.md:52-58 (binary path)
 
 
 def free_port() -> int:
@@ -91,6 +92,19 @@ def main() -> None:
     stats = json.loads(out.stdout.strip().splitlines()[-1])
     if stats.get("errors"):
         raise SystemExit(f"bench had {stats['errors']} errors: {stats}")
+    # binary protobuf front (raw tensors, no JSON/base64) vs the
+    # reference's binary path headline (gRPC, benchmarking.md:52-58)
+    port_b = free_port()
+    out_b = subprocess.run(
+        [
+            BIN_PATH, "--port", str(port_b), "--bench-binary",
+            "--clients", str(clients), "--seconds", str(seconds),
+        ],
+        check=True, capture_output=True, text=True,
+    )
+    stats_b = json.loads(out_b.stdout.strip().splitlines()[-1])
+    if stats_b.get("errors"):
+        raise SystemExit(f"binary bench had {stats_b['errors']} errors: {stats_b}")
     result = {
         "metric": "engine REST predictions throughput (stub model, 1 core)",
         "value": round(stats["rps"], 2),
@@ -101,6 +115,16 @@ def main() -> None:
         "requests": stats["requests"],
         "baseline": REFERENCE_REST_RPS,
         "baseline_source": "reference doc/source/reference/benchmarking.md:33-44 (n1-standard-16)",
+        "binary_front": {
+            "value": round(stats_b["rps"], 2),
+            "unit": "req/s",
+            "vs_grpc_baseline": round(stats_b["rps"] / REFERENCE_GRPC_RPS, 3),
+            "p50_ms": stats_b["p50_ms"],
+            "p99_ms": stats_b["p99_ms"],
+            "transport": "binary protobuf REST (raw tensors)",
+            "baseline": REFERENCE_GRPC_RPS,
+            "baseline_source": "reference benchmarking.md:52-58 (gRPC, n1-standard-16)",
+        },
     }
     if os.environ.get("BENCH_MODELS", "1") != "0":
         result["model_tier"] = run_model_tier(repo)

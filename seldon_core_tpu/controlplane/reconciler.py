@@ -386,10 +386,12 @@ class DeploymentController:
                 if self.placement.assigned(spec.name) is None:
                     self.placement.allocate(spec.name, mesh_spec)
                     fresh.append(spec.name)
-                if mesh_spec:
+                if mesh_spec and spec.name not in self.components:
                     # hand the placed device block to the engine as a
-                    # named mesh: its in-process jaxserver units shard
-                    # over exactly the chips this engine was allocated
+                    # named mesh: its in-process jaxserver units shard over
+                    # exactly the chips this engine was allocated (only
+                    # components about to start — already-running engines
+                    # keep their mesh and their desired spec is discarded)
                     spec.mesh = self.placement.mesh_for(spec.name, mesh_spec)
         except PlacementError:
             for name in fresh:

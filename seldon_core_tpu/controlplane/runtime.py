@@ -132,6 +132,14 @@ class _InProcessHandle(ComponentHandle):
                 await self.app.executor.close()
             except Exception:  # noqa: BLE001
                 pass
+            # the CloudEvents sink owns a worker thread + queue; without
+            # this, every rolling update leaks one per replaced engine
+            sink = getattr(getattr(self.app, "request_logger", None), "sink", None)
+            if sink is not None and hasattr(sink, "close"):
+                try:
+                    sink.close()
+                except Exception:  # noqa: BLE001
+                    pass
         pool = getattr(self.rest_app, "_hook_pool", None)
         if pool is not None:
             pool.shutdown(wait=False, cancel_futures=True)

@@ -76,34 +76,46 @@ class CloudEventsSink:
                 event = self._queue.get(timeout=0.5)
             except queue.Empty:
                 if self._closing.is_set():
-                    return
+                    return  # backlog fully drained
                 continue
-            if event is None or self._closing.is_set():
-                return
-            try:
-                event.setdefault("source", self.source)
-                body = json.dumps(event).encode()
-                req = urllib.request.Request(
-                    self.url,
-                    data=body,
-                    headers={
-                        "Content-Type": "application/cloudevents+json",
-                        "ce-specversion": event.get("specversion", "1.0"),
-                        "ce-type": event.get("type", CE_TYPE),
-                        "ce-id": str(event.get("id", "")),
-                        "ce-source": self.source,
-                    },
-                )
-                urllib.request.urlopen(req, timeout=self.timeout_s).read()
-                self.stats["posted"] += 1
-            except Exception as e:  # noqa: BLE001 - logging must never crash
-                self.stats["errors"] += 1
-                logger.warning("cloudevents post to %s failed: %s", self.url, e)
+            if event is None:
+                # close() sentinel: drain the backlog, then exit — queued
+                # events are posted, not discarded
+                while True:
+                    try:
+                        event = self._queue.get_nowait()
+                    except queue.Empty:
+                        return
+                    if event is not None:
+                        self._post(event)
+            else:
+                self._post(event)
+
+    def _post(self, event: Dict[str, Any]) -> None:
+        try:
+            event.setdefault("source", self.source)
+            body = json.dumps(event).encode()
+            req = urllib.request.Request(
+                self.url,
+                data=body,
+                headers={
+                    "Content-Type": "application/cloudevents+json",
+                    "ce-specversion": event.get("specversion", "1.0"),
+                    "ce-type": event.get("type", CE_TYPE),
+                    "ce-id": str(event.get("id", "")),
+                    "ce-source": self.source,
+                },
+            )
+            urllib.request.urlopen(req, timeout=self.timeout_s).read()
+            self.stats["posted"] += 1
+        except Exception as e:  # noqa: BLE001 - logging must never crash
+            self.stats["errors"] += 1
+            logger.warning("cloudevents post to %s failed: %s", self.url, e)
 
     def close(self) -> None:
-        # non-blocking even with a full queue and a hung collector: the
-        # flag stops the worker at its next poll; the sentinel (when it
-        # fits) just wakes it early
+        # never blocks on a full queue: the flag stops intake immediately,
+        # the worker drains the backlog (posting, not discarding) and the
+        # bounded join returns even if a hung collector delays the drain
         self._closing.set()
         try:
             self._queue.put_nowait(None)
