@@ -1095,7 +1095,16 @@ static void result_to_proto(const json::Value& result, const std::string& reply_
       for (auto& n : *names->arr)
         if (n.type == json::Value::Str) pd->add_names(n.str);
   std::vector<std::vector<double>> rows;
-  if (!result_rows(*data, rows)) return;
+  if (!result_rows(*data, rows)) {
+    // non-numeric payload (e.g. string labels from a remote unit): carry
+    // it generically as an ndarray ListValue instead of dropping the data
+    if (const json::Value* nd = data->find("ndarray")) {
+      google::protobuf::Value wrap;
+      value_to_pbvalue(*nd, &wrap);
+      if (wrap.has_list_value()) *pd->mutable_ndarray() = wrap.list_value();
+    }
+    return;
+  }
   if (reply_enc == "raw") {
     auto* raw = pd->mutable_raw();
     raw->set_dtype("float64");
@@ -1294,7 +1303,9 @@ static bool process_buffer(Engine& eng, Conn& c, std::mt19937& rng,
 
     if (path == "/api/v0.1/predictions" || path == "/api/v1.0/predictions" || path == "/predict") {
       if (eng.paused.load(std::memory_order_relaxed)) {
-        http_response(c.out, 503, error_json(503, "paused"));
+        // binary clients parse SeldonMessage bodies, not JSON
+        if (binary) http_response(c.out, 503, proto_error_bytes(503, "paused"), "application/x-protobuf");
+        else http_response(c.out, 503, error_json(503, "paused"));
       } else {
         RequestCtx ctx;
         ctx.engine = &eng;
@@ -1317,6 +1328,8 @@ static bool process_buffer(Engine& eng, Conn& c, std::mt19937& rng,
       http_response(c.out, 200, "{\"status\":\"ok\"}");
     } else if (path == "/metrics" || path == "/prometheus") {
       http_response(c.out, 200, prometheus_text(eng), "text/plain; version=0.0.4");
+    } else if (binary) {
+      http_response(c.out, 404, proto_error_bytes(404, "no route " + path), "application/x-protobuf");
     } else {
       http_response(c.out, 404, error_json(404, "no route " + path));
     }

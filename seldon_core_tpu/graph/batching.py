@@ -16,7 +16,18 @@ jsonData, mismatched trailing dims) fall through as singletons.
 
 Batch sizes are bucketed to powers of two so XLA sees a small, stable set
 of shapes instead of recompiling per arrival pattern (padding rows are
-sliced off after the call).
+sliced off after the call; they do flow through the model, so batching is
+for PURE predict functions — per-row side-effectful models should disable
+it). Padding never exceeds ``max_batch``; oversized single flushes pass
+through unpadded.
+
+Config surface mirrors the reference's annotations-as-feature-flags idiom
+(reference: InternalPredictionService.java:82-91 reading seldon.io/*
+annotations): ``seldon.io/microbatch: "true"`` on a predictor enables
+batching for its MODEL units, with ``seldon.io/microbatch-max-batch``,
+``seldon.io/microbatch-timeout-ms`` and ``seldon.io/microbatch-pad``
+tuning it. Per-unit counters/gauges land in the engine metrics registry
+(flushes, fused rows, padded rows, queue depth).
 """
 
 from __future__ import annotations
@@ -40,6 +51,48 @@ def _bucket(n: int, max_batch: int) -> int:
     return min(b, max_batch)
 
 
+ANNOTATION_ENABLE = "seldon.io/microbatch"
+ANNOTATION_MAX_BATCH = "seldon.io/microbatch-max-batch"
+ANNOTATION_TIMEOUT_MS = "seldon.io/microbatch-timeout-ms"
+ANNOTATION_PAD = "seldon.io/microbatch-pad"
+
+
+def batching_from_annotations(spec) -> Dict[str, Dict]:
+    """Per-unit batching config from predictor annotations (the reference's
+    annotations-as-feature-flags idiom, InternalPredictionService.java:82-91).
+    Returns {} unless ``seldon.io/microbatch`` is "true"; otherwise every
+    MODEL unit in the graph gets the annotated kwargs."""
+    ann = getattr(spec, "annotations", None) or {}
+    if str(ann.get(ANNOTATION_ENABLE, "false")).lower() != "true":
+        return {}
+    kwargs: Dict[str, Any] = {}
+    try:
+        if ANNOTATION_MAX_BATCH in ann:
+            kwargs["max_batch"] = int(ann[ANNOTATION_MAX_BATCH])
+        if ANNOTATION_TIMEOUT_MS in ann:
+            kwargs["timeout_ms"] = float(ann[ANNOTATION_TIMEOUT_MS])
+    except (TypeError, ValueError) as e:
+        raise ValueError(
+            f"bad seldon.io/microbatch-* annotation on predictor "
+            f"{getattr(spec, 'name', '?')!r}: {e}"
+        ) from e
+    if ANNOTATION_PAD in ann:
+        kwargs["pad_to_bucket"] = str(ann[ANNOTATION_PAD]).lower() == "true"
+
+    from .spec import UnitType
+
+    out: Dict[str, Dict] = {}
+
+    def walk(unit):
+        if unit.type in (None, UnitType.MODEL):
+            out[unit.name] = dict(kwargs)
+        for child in unit.children:
+            walk(child)
+
+    walk(spec.graph)
+    return out
+
+
 class MicroBatchingClient(UnitClient):
     def __init__(
         self,
@@ -47,14 +100,30 @@ class MicroBatchingClient(UnitClient):
         max_batch: int = 32,
         timeout_ms: float = 2.0,
         pad_to_bucket: bool = True,
+        metrics=None,
+        unit: str = "",
     ):
         self.inner = inner
         self.max_batch = max_batch
         self.timeout_s = timeout_ms / 1000.0
         self.pad_to_bucket = pad_to_bucket
+        self.metrics = metrics
+        self._labels = {"unit": unit or "model"}
         self._queue: List[Tuple[np.ndarray, Dict, asyncio.Future]] = []
         self._flusher: Optional[asyncio.Task] = None
         self._lock = asyncio.Lock()
+
+    def _gauge_depth(self) -> None:
+        if self.metrics is not None:
+            self.metrics.gauge_set(
+                "seldon_engine_microbatch_queue_depth",
+                float(sum(a.shape[0] for a, _, _ in self._queue)),
+                self._labels,
+            )
+
+    def _count(self, name: str, value: float = 1.0) -> None:
+        if self.metrics is not None:
+            self.metrics.counter_inc(name, self._labels, value)
 
     async def call(self, method: str, message: Dict[str, Any]) -> Dict[str, Any]:
         if method != "predict":
@@ -73,6 +142,7 @@ class MicroBatchingClient(UnitClient):
         async with self._lock:
             self._queue.append((arr, message, fut))
             n_rows = sum(a.shape[0] for a, _, _ in self._queue)
+            self._gauge_depth()
             if n_rows >= self.max_batch:
                 self._launch_flush()
             elif self._flusher is None or self._flusher.done():
@@ -81,6 +151,7 @@ class MicroBatchingClient(UnitClient):
 
     def _launch_flush(self):
         batch, self._queue = self._queue, []
+        self._gauge_depth()
         if self._flusher is not None:
             self._flusher.cancel()
             self._flusher = None
@@ -94,6 +165,7 @@ class MicroBatchingClient(UnitClient):
         async with self._lock:
             if self._queue:
                 batch, self._queue = self._queue, []
+                self._gauge_depth()
                 asyncio.ensure_future(self._flush(batch))
 
     async def _flush(self, batch):
@@ -117,11 +189,18 @@ class MicroBatchingClient(UnitClient):
                 raise ValueError(f"mismatched feature shapes {sorted(map(str, trailing))}")
             fused = np.concatenate([a.astype(dtype, copy=False) for a in arrays], axis=0)
             rows = fused.shape[0]
-            if self.pad_to_bucket:
-                padded_rows = _bucket(rows, max(rows, self.max_batch))
+            self._count("seldon_engine_microbatch_flushes")
+            self._count("seldon_engine_microbatch_rows", float(rows))
+            if self.pad_to_bucket and rows <= self.max_batch:
+                # padding is capped at max_batch; an oversized flush (one
+                # request carrying > max_batch rows) passes through unpadded
+                padded_rows = _bucket(rows, self.max_batch)
                 if padded_rows > rows:
                     pad = np.zeros((padded_rows - rows, *fused.shape[1:]), dtype=fused.dtype)
                     fused = np.concatenate([fused, pad], axis=0)
+                    self._count(
+                        "seldon_engine_microbatch_padded_rows", float(padded_rows - rows)
+                    )
             names = (batch[0][1].get("data") or {}).get("names", [])
             enc = "raw" if fused.dtype.itemsize <= 4 and fused.dtype.kind == "f" else "ndarray"
             fused_msg = {"data": payload_mod.array_to_json_data(fused, names, enc)}

@@ -109,6 +109,7 @@ class GraphExecutor:
         batching: Optional[Dict[str, Dict]] = None,
         inprocess_workers: int = 32,
         mesh=None,
+        metrics=None,
     ):
         """registry: unit name -> user object for INPROCESS units that are
         neither builtin implementations nor prepackaged servers.
@@ -129,6 +130,7 @@ class GraphExecutor:
         self._timeout = timeout_s
         self._batching = batching or {}
         self._mesh = mesh
+        self._metrics = metrics
         self._pool = ThreadPoolExecutor(
             max_workers=int(inprocess_workers), thread_name_prefix="unit-call"
         )
@@ -156,7 +158,10 @@ class GraphExecutor:
         if unit.name in self._batching and (unit.type in (None, UnitType.MODEL)):
             from .batching import MicroBatchingClient
 
-            client = MicroBatchingClient(client, **self._batching[unit.name])
+            client = MicroBatchingClient(
+                client, metrics=self._metrics, unit=unit.name,
+                **self._batching[unit.name],
+            )
         return client
 
     def _resolve_object(self, unit: PredictiveUnit):

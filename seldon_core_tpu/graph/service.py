@@ -83,8 +83,16 @@ class EngineApp:
         batching: Optional[Dict[str, Dict]] = None,
         mesh=None,
     ):
+        if batching is None:
+            # annotation-driven config, the reference's feature-flag idiom
+            # (seldon.io/microbatch* — InternalPredictionService.java:82-91)
+            from .batching import batching_from_annotations
+
+            batching = batching_from_annotations(spec)
         self.spec = spec
-        self.executor = GraphExecutor(spec, registry=registry, batching=batching, mesh=mesh)
+        self.executor = GraphExecutor(
+            spec, registry=registry, batching=batching, mesh=mesh, metrics=metrics
+        )
         self.metrics = metrics
         self.request_logger = request_logger or RequestLogger()
         self.paused = False

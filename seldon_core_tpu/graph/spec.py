@@ -56,6 +56,8 @@ PREPACKAGED_SERVERS = {
     "TENSORFLOW_SERVER": "seldon_core_tpu.servers.tfserver.TFServer",
     "JAX_SERVER": "seldon_core_tpu.servers.jaxserver.JAXServer",
     "GENERATE_SERVER": "seldon_core_tpu.servers.generateserver.GenerateServer",
+    "TRITON_SERVER": "seldon_core_tpu.servers.trtserver.TRTServer",
+    "SAGEMAKER_SERVER": "seldon_core_tpu.servers.sagemakerserver.SageMakerServer",
 }
 
 FIRST_PORT = 9000

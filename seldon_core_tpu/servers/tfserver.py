@@ -18,12 +18,19 @@ from ..user_model import SeldonComponent
 
 
 class TFServer(SeldonComponent):
-    def __init__(self, model_uri: str, signature: str = "serving_default", **kwargs):
+    """``loader(model_dir, signature) -> fn(np.ndarray) -> np.ndarray`` is
+    injectable so the full load+predict path is testable without
+    tensorflow in the image (the real loader wraps tf.saved_model.load)."""
+
+    def __init__(self, model_uri: str, signature: str = "serving_default",
+                 loader=None, **kwargs):
         self.model_uri = model_uri
         self.signature = signature
+        self._loader = loader
         self._fn = None
 
-    def load(self) -> None:
+    @staticmethod
+    def _tf_loader(model_dir: str, signature: str):
         try:
             import tensorflow as tf  # noqa: F401
         except ImportError as e:
@@ -34,15 +41,32 @@ class TFServer(SeldonComponent):
             ) from e
         import tensorflow as tf
 
+        sig = tf.saved_model.load(model_dir).signatures[signature]
+
+        def fn(arr: np.ndarray) -> np.ndarray:
+            out = sig(tf.constant(arr))
+            return next(iter(out.values())).numpy()
+
+        return fn
+
+    def load(self) -> None:
+        if self._loader is None:
+            # fail on a missing tensorflow BEFORE the (potentially multi-GB)
+            # model download
+            try:
+                import tensorflow  # noqa: F401
+            except ImportError as e:
+                raise RuntimeError(
+                    "TENSORFLOW_SERVER requires tensorflow (absent in this "
+                    "image). Export the SavedModel to jaxserver format "
+                    "(jax_config.json + orbax checkpoint) and use JAX_SERVER "
+                    "instead."
+                ) from e
         model_dir = Storage.download(self.model_uri)
-        loaded = tf.saved_model.load(model_dir)
-        self._fn = loaded.signatures[self.signature]
+        loader = self._loader or self._tf_loader
+        self._fn = loader(model_dir, self.signature)
 
     def predict(self, X, names, meta=None):
-        import tensorflow as tf
-
         if self._fn is None:
             self.load()
-        out = self._fn(tf.constant(np.asarray(X)))
-        first = next(iter(out.values()))
-        return first.numpy()
+        return self._fn(np.asarray(X))

@@ -120,3 +120,159 @@ def test_gated_servers_give_clear_errors(tmp_path):
 
     with pytest.raises(RuntimeError, match="xgboost"):
         XGBoostServer(model_uri=str(tmp_path)).load()
+
+
+# -- TRT / Triton proxy ------------------------------------------------------
+
+
+def make_trt(transport):
+    from seldon_core_tpu.servers.trtserver import TRTServer
+
+    return TRTServer(url="http://trt:8000", model_name="resnet", transport=transport)
+
+
+def test_trt_proxy_negotiates_dtype_and_infers():
+    calls = []
+
+    def transport(url, body, timeout):
+        calls.append((url, body))
+        if body is None:
+            return {
+                "name": "resnet",
+                "inputs": [{"name": "input0", "datatype": "INT32", "shape": [-1, 3]}],
+                "outputs": [{"name": "prob"}],
+            }
+        req = json.loads(body)
+        assert req["inputs"][0]["datatype"] == "INT32"
+        assert req["inputs"][0]["shape"] == [2, 3]
+        return {
+            "outputs": [
+                {"name": "prob", "datatype": "FP32", "shape": [2, 2],
+                 "data": [0.9, 0.1, 0.2, 0.8]}
+            ]
+        }
+
+    server = make_trt(transport)
+    out = server.predict(np.asarray([[1.5, 2.5, 3.5], [4, 5, 6]]), [])
+    assert out.shape == (2, 2)
+    np.testing.assert_allclose(out[0], [0.9, 0.1])
+    # metadata fetched once, infer posted to /infer
+    assert calls[0][0] == "http://trt:8000/v2/models/resnet"
+    assert calls[1][0].endswith("/v2/models/resnet/infer")
+    assert server.class_names() == ["prob"]
+
+
+def test_trt_proxy_error_on_no_outputs():
+    def transport(url, body, timeout):
+        if body is None:
+            return {"inputs": [{"name": "x", "datatype": "FP32"}]}
+        return {"outputs": []}
+
+    server = make_trt(transport)
+    with pytest.raises(RuntimeError, match="no outputs"):
+        server.predict(np.zeros((1, 2)), [])
+
+
+def test_trt_proxy_through_engine():
+    """TRITON_SERVER wires through the graph executor like any
+    prepackaged server."""
+    import asyncio
+
+    from seldon_core_tpu.graph.service import EngineApp
+    from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
+    from seldon_core_tpu.servers.trtserver import TRTServer
+
+    def transport(url, body, timeout):
+        if body is None:
+            return {"inputs": [{"name": "x", "datatype": "FP64", "shape": [-1, 2]}]}
+        req = json.loads(body)
+        rows = np.asarray(req["inputs"][0]["data"]).reshape(req["inputs"][0]["shape"])
+        return {
+            "outputs": [{"name": "y", "datatype": "FP64",
+                         "shape": list(rows.shape), "data": (rows * 3).ravel().tolist()}]
+        }
+
+    spec = default_predictor(
+        PredictorSpec.from_dict({"name": "t", "graph": {"name": "m", "type": "MODEL"}})
+    )
+    app = EngineApp(spec, registry={"m": TRTServer(transport=transport)})
+    out = asyncio.run(app.predict({"data": {"ndarray": [[1.0, 2.0]]}}))
+    assert out["data"]["ndarray"] == [[3.0, 6.0]]
+
+
+# -- SageMaker proxy ---------------------------------------------------------
+
+
+class FakeSMClient:
+    def __init__(self, fn):
+        self.fn = fn
+        self.calls = []
+
+    def invoke_endpoint(self, EndpointName, ContentType, Accept, Body):
+        self.calls.append((EndpointName, ContentType, Body))
+        import io as _io
+
+        return {"Body": _io.BytesIO(self.fn(Body, ContentType))}
+
+
+def test_sagemaker_proxy_json_round_trip():
+    from seldon_core_tpu.servers.sagemakerserver import SageMakerServer
+
+    def fn(body, ctype):
+        arr = np.asarray(json.loads(body)["instances"])
+        return json.dumps({"predictions": (arr * 2).tolist()}).encode()
+
+    client = FakeSMClient(fn)
+    server = SageMakerServer(endpoint_name="ep1", client_factory=lambda: client)
+    out = server.predict(np.asarray([[1.0, 2.0]]), [])
+    np.testing.assert_allclose(out, [[2.0, 4.0]])
+    assert client.calls[0][0] == "ep1"
+
+
+def test_sagemaker_proxy_csv_mode():
+    from seldon_core_tpu.servers.sagemakerserver import SageMakerServer
+
+    def fn(body, ctype):
+        arr = np.loadtxt(__import__("io").StringIO(body.decode()), delimiter=",", ndmin=2)
+        out = __import__("io").StringIO()
+        np.savetxt(out, arr + 1, delimiter=",", fmt="%g")
+        return out.getvalue().encode()
+
+    server = SageMakerServer(
+        endpoint_name="ep2", content_type="text/csv",
+        client_factory=lambda: FakeSMClient(fn),
+    )
+    out = server.predict(np.asarray([[1.0, 2.0], [3.0, 4.0]]), [])
+    np.testing.assert_allclose(out, [[2.0, 3.0], [4.0, 5.0]])
+
+
+def test_sagemaker_requires_endpoint():
+    from seldon_core_tpu.servers.sagemakerserver import SageMakerServer
+
+    with pytest.raises(ValueError, match="endpoint_name"):
+        SageMakerServer()
+
+
+# -- TFServer via injected loader --------------------------------------------
+
+
+def test_tfserver_with_injected_loader(tmp_path):
+    from seldon_core_tpu.servers.tfserver import TFServer
+
+    model_dir = tmp_path / "saved"
+    model_dir.mkdir()
+    (model_dir / "saved_model.pb").write_bytes(b"\x00")
+    seen = {}
+
+    def loader(path, signature):
+        seen["dir"] = path
+        seen["sig"] = signature
+        return lambda arr: arr * 10
+
+    server = TFServer(model_uri=str(model_dir), loader=loader)
+    out = server.predict(np.asarray([[1.0, 2.0]]), [])
+    np.testing.assert_allclose(out, [[10.0, 20.0]])
+    assert seen["sig"] == "serving_default"
+    import os as _os
+
+    assert _os.path.exists(_os.path.join(seen["dir"], "saved_model.pb"))
