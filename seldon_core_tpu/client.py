@@ -20,7 +20,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from .payload import array_to_json_data, json_data_to_array
+from .payload import array_to_json_data, json_data_to_array, jsonable
 
 logger = logging.getLogger(__name__)
 
@@ -85,6 +85,8 @@ class SeldonClient:
         transport: str = "rest",
         payload_type: str = "ndarray",
         timeout_s: float = 30.0,
+        oauth_key: Optional[str] = None,
+        oauth_secret: Optional[str] = None,
     ):
         self.deployment_name = deployment_name
         self.namespace = namespace
@@ -94,6 +96,56 @@ class SeldonClient:
         self.transport = transport
         self.payload_type = payload_type
         self.timeout_s = timeout_s
+        # oauth flow against the gateway's /oauth/token (reference:
+        # seldon_client.py:931-1106 oauth gateway support)
+        self.oauth_key = oauth_key
+        self.oauth_secret = oauth_secret
+        self._token: Optional[str] = None
+
+    def _gateway_token(self, force: bool = False) -> Optional[str]:
+        if not self.oauth_key or not self.gateway_endpoint:
+            return None
+        if self._token is not None and not force:
+            return self._token
+        import base64
+
+        creds = base64.b64encode(
+            f"{self.oauth_key}:{self.oauth_secret or ''}".encode()
+        ).decode()
+        req = urllib.request.Request(
+            f"http://{self.gateway_endpoint}/oauth/token",
+            data=b"{}",
+            headers={"authorization": f"Basic {creds}",
+                     "content-type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            self._token = json.loads(r.read())["access_token"]
+        return self._token
+
+    def _auth_headers(self, headers: Optional[Dict[str, str]],
+                      force: bool = False) -> Optional[Dict[str, str]]:
+        token = self._gateway_token(force=force)
+        if token is None:
+            return headers
+        return {**(headers or {}), "authorization": f"Bearer {token}"}
+
+    def _post_authed(self, url: str, body: Dict[str, Any],
+                     headers: Optional[Dict[str, str]]) -> "SeldonClientResponse":
+        """_post with the oauth flow: token fetch failures honour the
+        never-raise contract, and one 401 retries with a fresh token
+        (tokens expire server-side after TOKEN_TTL_S)."""
+        try:
+            authed = self._auth_headers(headers)
+        except (urllib.error.URLError, OSError, json.JSONDecodeError, KeyError) as e:
+            return SeldonClientResponse(False, body, None, msg=f"oauth token: {e}")
+        out = self._post(url, body, authed)
+        if not out.success and self.oauth_key and "401" in (out.msg or ""):
+            try:
+                authed = self._auth_headers(headers, force=True)
+            except (urllib.error.URLError, OSError, json.JSONDecodeError, KeyError) as e:
+                return SeldonClientResponse(False, body, None, msg=f"oauth token: {e}")
+            out = self._post(url, body, authed)
+        return out
 
     # -- payload construction ----------------------------------------------
 
@@ -116,7 +168,7 @@ class SeldonClient:
               headers: Optional[Dict[str, str]] = None) -> SeldonClientResponse:
         req = urllib.request.Request(
             url,
-            data=json.dumps(body).encode(),
+            data=json.dumps(jsonable(body)).encode(),
             headers={"content-type": "application/json", **(headers or {})},
         )
         try:
@@ -150,7 +202,7 @@ class SeldonClient:
             return self._grpc_external("Predict", self._message(data, names=names, **payload_kwargs))
         body = self._message(data, names=names, **payload_kwargs)
         url = self._external_base() + "/api/v0.1/predictions"
-        return self._post(url, body, headers)
+        return self._post_authed(url, body, headers)
 
     def feedback(self, request: Dict[str, Any], response: Dict[str, Any],
                  reward: float = 0.0, truth=None) -> SeldonClientResponse:
@@ -160,7 +212,7 @@ class SeldonClient:
         if self.transport == "grpc":
             return self._grpc_external("SendFeedback", body)
         url = self._external_base() + "/api/v0.1/feedback"
-        return self._post(url, body)
+        return self._post_authed(url, body, None)
 
     def _grpc_external(self, method: str, body: Dict[str, Any]) -> SeldonClientResponse:
         import grpc

@@ -11,13 +11,24 @@ predictors, header-based routing). One asyncio HTTP front exposes
 and fans each request to one predictor's engine chosen by traffic weight,
 honouring a ``seldon-predictor`` header override and mirroring traffic to
 shadow predictors fire-and-forget.
+
+Auth (reference: the legacy OAuth "apife" gateway the client SDK speaks —
+python/seldon_core/seldon_client.py:931-1106): when key/secret pairs are
+configured (constructor or ``SELDON_OAUTH_KEY``/``SELDON_OAUTH_SECRET``
+env), ``POST /oauth/token`` with HTTP Basic credentials issues a bearer
+token and every /seldon/* route requires ``Authorization: Bearer <tok>``.
+Unconfigured gateways stay open (in-cluster mode).
 """
 
 from __future__ import annotations
 
 import asyncio
+import base64
 import logging
+import os
 import random
+import secrets
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ..http_server import HTTPServer, Request, Response, error_body
@@ -52,14 +63,61 @@ class _Route:
         return h
 
 
+TOKEN_TTL_S = 3600.0
+
+
 class Gateway:
-    def __init__(self, seed: Optional[int] = None):
+    def __init__(self, seed: Optional[int] = None,
+                 oauth: Optional[Dict[str, str]] = None):
         # deployment key -> list of routes
         self._routes: Dict[str, List[_Route]] = {}
         # deployment key -> predictor -> explainer handles (reference:
         # "<deployment>-explainer" service, seldondeployment_explainers.go:160)
         self._explainers: Dict[str, Dict[str, List]] = {}
         self._rng = random.Random(seed)
+        # oauth key -> secret; empty = open gateway
+        if oauth is None and os.environ.get("SELDON_OAUTH_KEY"):
+            oauth = {
+                os.environ["SELDON_OAUTH_KEY"]: os.environ.get("SELDON_OAUTH_SECRET", "")
+            }
+        self._oauth = dict(oauth or {})
+        self._tokens: Dict[str, float] = {}  # token -> expiry monotonic
+
+    # -- auth ---------------------------------------------------------------
+
+    @property
+    def auth_enabled(self) -> bool:
+        return bool(self._oauth)
+
+    def issue_token(self, key: str, secret: str) -> Optional[str]:
+        if key not in self._oauth or not secrets.compare_digest(
+            self._oauth[key], secret
+        ):
+            return None
+        # sweep expired tokens on issuance so the table is bounded by the
+        # number of live tokens, not the total ever issued
+        now = time.monotonic()
+        self._tokens = {t: exp for t, exp in self._tokens.items() if exp > now}
+        token = secrets.token_urlsafe(24)
+        self._tokens[token] = now + TOKEN_TTL_S
+        return token
+
+    def check_token(self, token: str) -> bool:
+        exp = self._tokens.get(token)
+        if exp is None:
+            return False
+        if time.monotonic() > exp:
+            self._tokens.pop(token, None)
+            return False
+        return True
+
+    def _authorized(self, req: Request) -> bool:
+        if not self._oauth:
+            return True
+        header = req.headers.get("authorization", "")
+        if header.lower().startswith("bearer "):
+            return self.check_token(header[7:].strip())
+        return False
 
     # -- route table maintenance (called by the reconciler) -----------------
 
@@ -162,9 +220,11 @@ class Gateway:
         def do_post():
             import urllib.request
 
+            from ..payload import jsonable
+
             req = urllib.request.Request(
                 f"{handle.url}{path}",
-                data=_json.dumps(payload).encode(),
+                data=_json.dumps(jsonable(payload)).encode(),
                 headers={"content-type": "application/json"},
             )
             with urllib.request.urlopen(req, timeout=10.0) as r:
@@ -176,7 +236,33 @@ class Gateway:
         server = HTTPServer("gateway")
         gw = self
 
+        async def token_endpoint(req: Request) -> Response:
+            """POST /oauth/token with HTTP Basic key:secret (the reference
+            client's oauth flow — seldon_client.py:931-1106)."""
+            if not gw.auth_enabled:
+                return Response(error_body(404, "oauth not configured"), 404)
+            header = req.headers.get("authorization", "")
+            key = secret = None
+            if header.lower().startswith("basic "):
+                try:
+                    decoded = base64.b64decode(header[6:]).decode()
+                    key, _, secret = decoded.partition(":")
+                except Exception:  # noqa: BLE001 - malformed basic auth
+                    pass
+            if key is None:
+                body = req.json() or {}
+                key, secret = body.get("key"), body.get("secret")
+            token = gw.issue_token(key or "", secret or "")
+            if token is None:
+                return Response(error_body(401, "bad oauth credentials"), 401)
+            return Response(
+                {"access_token": token, "token_type": "bearer",
+                 "expires_in": int(TOKEN_TTL_S)}
+            )
+
         async def handler(req: Request) -> Response:
+            if not gw._authorized(req):
+                return Response(error_body(401, "missing or invalid bearer token"), 401)
             # /seldon/<ns>/<name>/api/v0.1/predictions
             parts = [p for p in req.path.split("/") if p]
             if len(parts) < 4 or parts[0] != "seldon":
@@ -211,8 +297,11 @@ class Gateway:
             return Response(out)
 
         async def routes(req: Request) -> Response:
+            if not gw._authorized(req):
+                return Response(error_body(401, "missing or invalid bearer token"), 401)
             return Response(gw.route_table())
 
         server.add_prefix_route("/seldon/", handler)
         server.add_route("/routes", routes)
+        server.add_route("/oauth/token", token_endpoint)
         return server

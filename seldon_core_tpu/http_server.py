@@ -179,15 +179,18 @@ class HTTPServer:
             except Exception:
                 pass
 
-    async def start(self, host: str, port: int):
+    async def start(self, host: str, port: int, reuse_port: bool = False):
+        # reuse_port: multiple worker processes share one listening port
+        # (the kernel load-balances accepts — the no-fork multi-worker model)
         self._server = await asyncio.start_server(
-            self._handle_conn, host, port, limit=64 * 1024 * 1024
+            self._handle_conn, host, port, limit=64 * 1024 * 1024,
+            reuse_port=reuse_port or None,
         )
         logger.info("%s listening on %s:%d", self.name, host, port)
         return self._server
 
-    async def serve_forever(self, host: str, port: int):
-        await self.start(host, port)
+    async def serve_forever(self, host: str, port: int, reuse_port: bool = False):
+        await self.start(host, port, reuse_port=reuse_port)
         await self.serve()
 
     async def serve(self):

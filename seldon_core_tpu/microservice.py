@@ -7,9 +7,13 @@ imports the class, instantiates it with typed parameters from the
 (reference: microservice.py:50-87), calls ``load()`` and serves.
 
 TPU deltas vs the reference:
-  * no gunicorn fork workers — forking after TPU runtime init is unsafe;
-    concurrency comes from the asyncio loop + the jit executable's own
-    device parallelism. (reference forks per worker, microservice.py:153-174)
+  * ``--workers N`` runs N SPAWNED worker processes sharing the service
+    ports via SO_REUSEPORT — never a post-init fork (forking after TPU
+    runtime init is unsafe; the reference forked gunicorn workers,
+    microservice.py:153-174). Each worker imports, loads and serves
+    independently; the kernel load-balances accepted connections. Meant
+    for CPU-bound components (sklearn/xgboost) — a TPU component should
+    keep workers=1 and scale via its mesh instead.
   * ``--warmup`` triggers load()+compile before the port opens, so readiness
     flips only once the XLA executable is built.
 """
@@ -77,9 +81,48 @@ def build_user_object(interface_name: str, parameters_json: str | None = None):
     return cls(**params)
 
 
-async def _serve_rest(user_object, host: str, port: int, state: ServerState):
+async def _serve_rest(user_object, host: str, port: int, state: ServerState,
+                      reuse_port: bool = False):
     app = get_rest_microservice(user_object, state)
-    await app.serve_forever(host, port)
+    await app.serve_forever(host, port, reuse_port=reuse_port)
+
+
+def _spawn_workers(n: int, argv: List[str]) -> int:
+    """Parent mode for --workers N: spawn N fresh CLI processes (each with
+    --workers 1 --reuse-port), forward termination, exit with the first
+    non-zero status."""
+    import signal
+    import subprocess
+
+    # strip "--workers N" / "--workers=N" so children run single-worker
+    cleaned: List[str] = []
+    skip = False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a == "--workers":
+            skip = True
+            continue
+        if a.startswith("--workers="):
+            continue
+        cleaned.append(a)
+    cmd = [sys.executable, "-m", "seldon_core_tpu.microservice", *cleaned,
+           "--workers", "1", "--reuse-port"]
+    procs = [subprocess.Popen(cmd) for _ in range(n)]
+    logger.info("spawned %d workers (SO_REUSEPORT)", n)
+
+    def forward(signum, _frame):
+        for p in procs:
+            p.send_signal(signum)
+
+    signal.signal(signal.SIGTERM, forward)
+    signal.signal(signal.SIGINT, forward)
+    code = 0
+    for p in procs:
+        p.wait()
+        code = code or p.returncode
+    return code
 
 
 def main(argv=None) -> None:
@@ -114,11 +157,21 @@ def main(argv=None) -> None:
         type=float,
         default=float(os.environ.get("SELDON_PERSISTENCE_FREQUENCY", 60)),
     )
+    parser.add_argument(
+        "--workers", type=int,
+        default=int(os.environ.get("SELDON_WORKERS", 1)),
+        help="worker processes sharing the ports via SO_REUSEPORT "
+        "(spawned fresh, never forked; keep 1 for TPU components)",
+    )
+    parser.add_argument("--reuse-port", action="store_true",
+                        help=argparse.SUPPRESS)  # set internally on workers
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=args.log_level.upper(),
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    if args.workers > 1:
+        raise SystemExit(_spawn_workers(args.workers, list(argv or sys.argv[1:])))
 
     from .tracing import init_tracer
 
@@ -151,7 +204,10 @@ def main(argv=None) -> None:
 
     if args.api_type in ("REST", "BOTH"):
         try:
-            asyncio.run(_serve_rest(user_object, args.host, args.service_port, state))
+            asyncio.run(
+                _serve_rest(user_object, args.host, args.service_port, state,
+                            reuse_port=args.reuse_port)
+            )
         except KeyboardInterrupt:
             pass
     elif grpc_server is not None:

@@ -206,11 +206,13 @@ def array_to_json_data(
     np_arr = _to_numpy(arr)
     out: JsonDict = {"names": list(names) if names else []}
     if encoding == "raw":
+        # interior representation keeps BYTES (zero-copy all the way to the
+        # proto edge); JSON edges base64 them via jsonable()/_json_default
         np_arr = np.ascontiguousarray(np_arr)
         out["raw"] = {
             "dtype": dtype_name(np_arr.dtype),
             "shape": list(np_arr.shape),
-            "data": base64.b64encode(np_arr.tobytes()).decode("ascii"),
+            "data": np_arr.tobytes(),
         }
     elif encoding == "tensor":
         out["tensor"] = {
@@ -392,17 +394,40 @@ def build_proto_response(
 
 def jsonable(body: JsonDict) -> JsonDict:
     """Return a json.dumps-safe copy: raw tensor bytes (the zero-copy
-    interior representation from proto_to_json) become base64 strings.
-    No-op (same object) when the body carries no bytes."""
-    data = body.get("data") if isinstance(body, dict) else None
+    interior representation) become base64 strings. Recurses through the
+    message shapes that can nest tensors — Feedback's request/response/
+    truth and SeldonMessageList — and is a no-op (same object) when the
+    body carries no bytes."""
+    if not isinstance(body, dict):
+        return body
+    out = None  # copy-on-write: only allocate when something changes
+
+    def put(key, value):
+        nonlocal out
+        if out is None:
+            out = dict(body)
+        out[key] = value
+
+    data = body.get("data")
     raw = data.get("raw") if isinstance(data, dict) else None
     if raw is not None and isinstance(raw.get("data"), (bytes, bytearray, memoryview)):
-        out = dict(body)
-        out["data"] = dict(data)
-        out["data"]["raw"] = dict(raw)
-        out["data"]["raw"]["data"] = base64.b64encode(bytes(raw["data"])).decode("ascii")
-        return out
-    return body
+        new_data = dict(data)
+        new_data["raw"] = dict(raw)
+        new_data["raw"]["data"] = base64.b64encode(bytes(raw["data"])).decode("ascii")
+        put("data", new_data)
+    for key in ("request", "response", "truth"):
+        nested = body.get(key)
+        if isinstance(nested, dict):
+            converted = jsonable(nested)
+            if converted is not nested:
+                put(key, converted)
+    for key in ("seldonMessages", "requests"):
+        nested = body.get(key)
+        if isinstance(nested, list):
+            converted_list = [jsonable(m) for m in nested]
+            if any(c is not m for c, m in zip(converted_list, nested)):
+                put(key, converted_list)
+    return out if out is not None else body
 
 
 def proto_to_json(msg) -> JsonDict:

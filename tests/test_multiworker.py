@@ -1,0 +1,77 @@
+"""Multi-worker microservice: N spawned processes share the REST port via
+SO_REUSEPORT (the no-fork counterpart of the reference's gunicorn workers,
+microservice.py:153-174)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from _net import free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MODEL = """
+import os
+import numpy as np
+
+class PidModel:
+    def predict(self, X, names, meta=None):
+        return np.asarray(X) * 2
+
+    def tags(self):
+        return {"pid": os.getpid()}
+"""
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="SO_REUSEPORT")
+def test_workers_share_port_and_all_serve(tmp_path):
+    (tmp_path / "PidModel.py").write_text(MODEL)
+    port = free_port()
+    env = {
+        **os.environ,
+        "PYTHONPATH": f"{REPO}:{tmp_path}",
+        "JAX_PLATFORMS": "cpu",
+    }
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "seldon_core_tpu.microservice",
+            "PidModel", "REST",
+            "--service-port", str(port), "--workers", "2", "--no-warmup",
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        deadline = time.time() + 30
+        up = False
+        while time.time() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", port), 0.2).close()
+                up = True
+                break
+            except OSError:
+                time.sleep(0.1)
+        assert up, "workers never opened the shared port"
+
+        pids = set()
+        for _ in range(30):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict",
+                data=json.dumps({"data": {"ndarray": [[2.0]]}}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            out = json.loads(urllib.request.urlopen(req, timeout=10).read())
+            assert out["data"]["ndarray"] == [[4.0]]
+            pids.add(out["meta"]["tags"]["pid"])
+        # kernel load-balancing across distinct worker processes
+        assert len(pids) == 2, f"expected 2 worker pids, saw {pids}"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

@@ -125,3 +125,53 @@ def test_to_device_places_on_jax():
     dev = payload.to_device(arr, dtype="bfloat16")
     assert isinstance(dev, jax.Array)
     assert str(dev.dtype) == "bfloat16"
+
+
+def test_raw_response_interior_stays_bytes():
+    """Responses mirror 'raw' requests with BYTES in the interior dict —
+    the base64 tax is paid only at JSON edges (jsonable/_json_default)."""
+    import numpy as np
+
+    from seldon_core_tpu import payload
+
+    arr = np.asarray([[1.0, 2.0]], np.float32)
+    data = payload.array_to_json_data(arr, encoding="raw")
+    assert isinstance(data["raw"]["data"], bytes)
+    # round-trips through the array decoder without b64
+    back = payload.json_data_to_array(data)
+    np.testing.assert_allclose(back, arr)
+    # proto edge takes the bytes fast path
+    msg = payload.json_to_proto({"data": data})
+    assert msg.data.raw.data == arr.tobytes()
+    # JSON edge base64-encodes
+    safe = payload.jsonable({"data": data})
+    import base64 as b64
+
+    assert safe["data"]["raw"]["data"] == b64.b64encode(arr.tobytes()).decode()
+
+
+def test_jsonable_recurses_into_feedback_and_lists():
+    import base64 as b64
+
+    import numpy as np
+
+    from seldon_core_tpu import payload
+
+    arr = np.asarray([[1.0]], np.float32)
+    msg = {"data": payload.array_to_json_data(arr, encoding="raw")}
+    feedback = {"request": msg, "response": msg, "reward": 1.0}
+    safe = payload.jsonable(feedback)
+    expected = b64.b64encode(arr.tobytes()).decode()
+    assert safe["request"]["data"]["raw"]["data"] == expected
+    assert safe["response"]["data"]["raw"]["data"] == expected
+    import json as _json
+
+    _json.dumps(safe)  # fully serializable
+    # SeldonMessageList shape
+    batch = {"seldonMessages": [msg, {"data": {"ndarray": [[1]]}}]}
+    safe2 = payload.jsonable(batch)
+    assert safe2["seldonMessages"][0]["data"]["raw"]["data"] == expected
+    _json.dumps(safe2)
+    # no-bytes bodies return the SAME object (no copy)
+    clean = {"data": {"ndarray": [[1.0]]}}
+    assert payload.jsonable(clean) is clean
