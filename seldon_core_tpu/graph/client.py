@@ -91,7 +91,8 @@ class RestClient(UnitClient):
             pass
         return await asyncio.open_connection(self.host, self.port, limit=64 * 1024 * 1024)
 
-    async def _request(self, path: str, body: bytes) -> Dict[str, Any]:
+    async def _request(self, path: str, body: bytes,
+                       ctype: str = "application/json") -> Dict[str, Any]:
         from ..tracing import get_tracer
 
         reader, writer = await self._connection()
@@ -103,7 +104,7 @@ class RestClient(UnitClient):
             extra = "".join(f"{k}: {v}\r\n" for k, v in trace_headers.items())
             head = (
                 f"POST {path} HTTP/1.1\r\nHost: {self.host}\r\n"
-                f"Content-Type: application/json\r\nContent-Length: {len(body)}\r\n"
+                f"Content-Type: {ctype}\r\nContent-Length: {len(body)}\r\n"
                 f"{extra}\r\n"
             ).encode()
             writer.write(head + body)
@@ -111,18 +112,26 @@ class RestClient(UnitClient):
             status_line = await reader.readline()
             status = int(status_line.split(b" ", 2)[1])
             length = 0
+            resp_ctype = ""
             while True:
                 line = await reader.readline()
                 if line in (b"\r\n", b""):
                     break
                 k, _, v = line.decode("latin-1").partition(":")
-                if k.strip().lower() == "content-length":
+                key = k.strip().lower()
+                if key == "content-length":
                     length = int(v)
+                elif key == "content-type":
+                    resp_ctype = v.strip().split(";")[0]
             payload = await reader.readexactly(length)
             self._pool.put_nowait((reader, writer))
             pooled = True
             if status >= 400:
                 raise UnitCallError(status, payload.decode("utf-8", "replace"))
+            if resp_ctype in ("application/x-protobuf", "application/octet-stream"):
+                from ..payload import proto_to_json
+
+                return proto_to_json(pb.SeldonMessage.FromString(payload))
             return json.loads(payload)
         finally:
             # Anything that prevented pooling (connection error, timeout
@@ -132,14 +141,24 @@ class RestClient(UnitClient):
                 writer.close()
 
     async def call(self, method: str, message: Dict[str, Any]) -> Dict[str, Any]:
-        from ..payload import jsonable
+        from ..payload import has_raw_bytes, json_to_proto, jsonable
 
         path, _ = METHOD_TABLE[method]
-        body = json.dumps(jsonable(message), separators=(",", ":")).encode()
+        if method != "send_feedback" and has_raw_bytes(message):
+            # zero-copy hop: raw tensor bytes go as a binary SeldonMessage
+            # body (the wrapper's application/x-protobuf route) — no
+            # base64, no JSON text on the unit hop
+            body = json_to_proto(message).SerializeToString()
+            ctype = "application/x-protobuf"
+        else:
+            body = json.dumps(jsonable(message), separators=(",", ":")).encode()
+            ctype = "application/json"
         last_err: Optional[Exception] = None
         for attempt in range(RETRIES):
             try:
-                return await asyncio.wait_for(self._request(path, body), self.timeout)
+                return await asyncio.wait_for(
+                    self._request(path, body, ctype), self.timeout
+                )
             except UnitCallError:
                 raise  # application error: do not retry
             except Exception as e:  # connection/timeout: retry

@@ -171,6 +171,14 @@ def main(argv=None) -> None:
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
     if args.workers > 1:
+        if args.persistence:
+            # N workers restoring from and pushing to ONE state file would
+            # clobber each other (last-writer-wins, cross-process tmp race)
+            raise SystemExit(
+                "--workers > 1 cannot be combined with --persistence: "
+                "workers would overwrite each other's snapshots; run one "
+                "worker or give each its own service"
+            )
         raise SystemExit(_spawn_workers(args.workers, list(argv or sys.argv[1:])))
 
     from .tracing import init_tracer

@@ -286,7 +286,7 @@ def bench_resnet50_rest(
 def bench_bert_grpc(
     root: str,
     seconds: float = 8.0,
-    concurrency: int = 16,
+    concurrency: int = 32,
     batch: int = 16,
     seq: int = 128,
     config: Optional[Dict[str, Any]] = None,

@@ -392,6 +392,17 @@ def build_proto_response(
 # ---------------------------------------------------------------------------
 
 
+def has_raw_bytes(message: JsonDict) -> bool:
+    """True when message.data.raw.data carries interior BYTES (the
+    zero-copy representation) — the single predicate shared by the
+    binary-hop/jsonable/proto fast paths."""
+    data = message.get("data") if isinstance(message, dict) else None
+    raw = data.get("raw") if isinstance(data, dict) else None
+    return raw is not None and isinstance(
+        raw.get("data"), (bytes, bytearray, memoryview)
+    )
+
+
 def jsonable(body: JsonDict) -> JsonDict:
     """Return a json.dumps-safe copy: raw tensor bytes (the zero-copy
     interior representation) become base64 strings. Recurses through the
@@ -408,12 +419,11 @@ def jsonable(body: JsonDict) -> JsonDict:
             out = dict(body)
         out[key] = value
 
-    data = body.get("data")
-    raw = data.get("raw") if isinstance(data, dict) else None
-    if raw is not None and isinstance(raw.get("data"), (bytes, bytearray, memoryview)):
+    if has_raw_bytes(body):
+        data = body["data"]
         new_data = dict(data)
-        new_data["raw"] = dict(raw)
-        new_data["raw"]["data"] = base64.b64encode(bytes(raw["data"])).decode("ascii")
+        new_data["raw"] = dict(data["raw"])
+        new_data["raw"]["data"] = base64.b64encode(bytes(data["raw"]["data"])).decode("ascii")
         put("data", new_data)
     for key in ("request", "response", "truth"):
         nested = body.get(key)
@@ -463,12 +473,29 @@ def proto_to_json(msg) -> JsonDict:
 def json_to_proto(body: JsonDict, msg_cls=pb.SeldonMessage):
     from google.protobuf import json_format
 
-    raw = body.get("data", {}).get("raw") if isinstance(body.get("data"), dict) else None
-    if raw is not None and isinstance(raw.get("data"), (bytes, bytearray, memoryview)):
+    # composite messages nest SeldonMessages that may carry interior raw
+    # BYTES: build recursively so every level takes the bytes fast path
+    # (ParseDict on a bytes value would silently base64-"decode" garbage)
+    if msg_cls is pb.Feedback:
+        msg = pb.Feedback()
+        for key, field in (("request", msg.request), ("response", msg.response),
+                           ("truth", msg.truth)):
+            if isinstance(body.get(key), dict):
+                field.CopyFrom(json_to_proto(body[key]))
+        if "reward" in body:
+            msg.reward = float(body["reward"])
+        return msg
+    if msg_cls is pb.SeldonMessageList:
+        msg = pb.SeldonMessageList()
+        for m in body.get("seldonMessages") or body.get("seldon_messages") or []:
+            msg.seldon_messages.append(json_to_proto(m))
+        return msg
+    if msg_cls is pb.SeldonMessage and has_raw_bytes(body):
         # bytes fast path (mirror of proto_to_json's): build the proto
         # directly, ParseDict only sees the remaining JSON-safe fields
+        raw = body["data"]["raw"]
         rest = {k: v for k, v in body.items() if k != "data"}
-        msg = msg_cls()
+        msg = pb.SeldonMessage()
         try:
             json_format.ParseDict(rest, msg)
         except json_format.ParseError as e:
@@ -480,7 +507,9 @@ def json_to_proto(body: JsonDict, msg_cls=pb.SeldonMessage):
         return msg
     msg = msg_cls()
     try:
-        json_format.ParseDict(body, msg)
+        # jsonable() base64-encodes any interior bytes the fast paths above
+        # did not consume, so ParseDict round-trips them correctly
+        json_format.ParseDict(jsonable(body), msg)
     except json_format.ParseError as e:
         raise PayloadError(str(e)) from e
     return msg

@@ -54,11 +54,30 @@ def get_rest_microservice(
         # Hooks are sync (numpy/jax); never run them on the event loop.
         return asyncio.get_running_loop().run_in_executor(pool, fn, *args)
 
-    def endpoint(method_fn, needs_body=True):
+    PROTO_TYPES = ("application/x-protobuf", "application/octet-stream")
+
+    def endpoint(method_fn, needs_body=True, msg_cls=pb.SeldonMessage):
         async def handler(req: Request) -> Response:
             if state.paused:
                 return Response(error_body(503, "paused"), 503)
-            body = req.json()
+            ctype = (req.headers.get("content-type") or "").split(";")[0].strip()
+            binary = ctype in PROTO_TYPES
+            if binary:
+                # binary SeldonMessage body — raw tensors as bytes, the
+                # same zero-copy transport the engine front speaks. Parse
+                # off-loop: multi-MB image batches must not stall other
+                # keep-alive connections
+                from .payload import json_to_proto, proto_to_json
+
+                def _parse(raw_body):
+                    return proto_to_json(msg_cls.FromString(raw_body))
+
+                try:
+                    body = await _sync(_parse, req.body)
+                except Exception as e:  # noqa: BLE001 - malformed proto
+                    return Response(error_body(400, f"bad protobuf body: {e}"), 400)
+            else:
+                body = req.json()
             if body is None and needs_body:
                 return Response(error_body(400, "empty request body"), 400)
             from .tracing import get_tracer
@@ -70,6 +89,14 @@ def get_rest_microservice(
                 headers=req.headers,
             ):
                 out = await _sync(method_fn, user_object, body)
+            if binary:
+                def _serialize(result):
+                    return json_to_proto(result).SerializeToString()
+
+                return Response(
+                    await _sync(_serialize, out),
+                    content_type="application/x-protobuf",
+                )
             return Response(out)
 
         return handler
@@ -80,8 +107,12 @@ def get_rest_microservice(
     app.add_route("/transform-input", endpoint(seldon_methods.transform_input))
     app.add_route("/transform-output", endpoint(seldon_methods.transform_output))
     app.add_route("/route", endpoint(seldon_methods.route))
-    app.add_route("/aggregate", endpoint(seldon_methods.aggregate))
-    app.add_route("/send-feedback", endpoint(seldon_methods.send_feedback))
+    app.add_route(
+        "/aggregate", endpoint(seldon_methods.aggregate, msg_cls=pb.SeldonMessageList)
+    )
+    app.add_route(
+        "/send-feedback", endpoint(seldon_methods.send_feedback, msg_cls=pb.Feedback)
+    )
     app.add_route("/explain", endpoint(seldon_methods.explain))
     app.add_route("/api/v1.0/explain", endpoint(seldon_methods.explain))
 

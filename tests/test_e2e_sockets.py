@@ -141,3 +141,61 @@ def test_engine_rest_server_full_stack(rest_microservice_port):
         body = json.loads(r.read())
     assert body["data"]["ndarray"] == [[6.0]]
     loop.call_soon_threadsafe(loop.stop)
+
+
+def test_engine_rest_unit_hop_goes_binary_for_raw(rest_microservice_port):
+    """A raw-bytes request crosses the engine->microservice REST hop as a
+    binary SeldonMessage (no base64/JSON), and the response mirrors raw."""
+    import base64
+
+    app = engine_for("REST", rest_microservice_port)
+
+    arr = np.asarray([[1.0, 2.0]], np.float32)
+    body = {
+        "data": {
+            "raw": {
+                "dtype": "float32",
+                "shape": [1, 2],
+                "data": arr.tobytes(),  # interior bytes -> binary hop
+            }
+        }
+    }
+
+    async def go():
+        out = await app.predict(body)
+        await app.executor.close()
+        return out
+
+    out = asyncio.run(go())
+    raw = out["data"]["raw"]
+    buf = raw["data"]
+    if isinstance(buf, str):
+        buf = base64.b64decode(buf)
+    vals = np.frombuffer(buf, raw["dtype"]).reshape(tuple(int(s) for s in raw["shape"]))
+    np.testing.assert_allclose(vals, [[2.0, 4.0]])
+
+
+def test_microservice_rest_accepts_binary_protobuf(rest_microservice_port):
+    """Direct binary POST to the wrapped component's /predict."""
+    import urllib.request
+
+    from seldon_core_tpu.proto import prediction_pb2 as pb
+
+    arr = np.asarray([[3.0, 4.0]], np.float32)
+    msg = pb.SeldonMessage(
+        data=pb.DefaultData(
+            raw=pb.RawTensor(dtype="float32", shape=[1, 2], data=arr.tobytes())
+        )
+    ).SerializeToString()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{rest_microservice_port}/predict",
+        data=msg,
+        headers={"Content-Type": "application/x-protobuf"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.headers["Content-Type"].startswith("application/x-protobuf")
+        out = pb.SeldonMessage.FromString(r.read())
+    vals = np.frombuffer(out.data.raw.data, out.data.raw.dtype).reshape(
+        tuple(out.data.raw.shape)
+    )
+    np.testing.assert_allclose(vals, [[6.0, 8.0]])

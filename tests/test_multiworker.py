@@ -60,8 +60,11 @@ def test_workers_share_port_and_all_serve(tmp_path):
                 time.sleep(0.1)
         assert up, "workers never opened the shared port"
 
+        # keep probing until BOTH workers have answered (the second may
+        # still be importing when the first opens the shared port)
         pids = set()
-        for _ in range(30):
+        deadline = time.time() + 30
+        while time.time() < deadline and len(pids) < 2:
             req = urllib.request.Request(
                 f"http://127.0.0.1:{port}/predict",
                 data=json.dumps({"data": {"ndarray": [[2.0]]}}).encode(),

@@ -175,3 +175,24 @@ def test_jsonable_recurses_into_feedback_and_lists():
     # no-bytes bodies return the SAME object (no copy)
     clean = {"data": {"ndarray": [[1.0]]}}
     assert payload.jsonable(clean) is clean
+
+
+def test_json_to_proto_nested_bytes_not_corrupted():
+    """Feedback/SeldonMessageList with interior raw BYTES must round-trip
+    exactly (ParseDict on bytes silently produced b'' before)."""
+    import numpy as np
+
+    from seldon_core_tpu import payload
+    from seldon_core_tpu.proto import prediction_pb2 as pb
+
+    arr = np.asarray([[1.0, 2.0]], np.float32)
+    msg = {"data": payload.array_to_json_data(arr, encoding="raw")}
+    fb = payload.json_to_proto(
+        {"request": msg, "response": msg, "truth": msg, "reward": 0.5}, pb.Feedback
+    )
+    for sub in (fb.request, fb.response, fb.truth):
+        assert sub.data.raw.data == arr.tobytes()
+    assert fb.reward == 0.5
+    lst = payload.json_to_proto({"seldonMessages": [msg, msg]}, pb.SeldonMessageList)
+    assert len(lst.seldon_messages) == 2
+    assert lst.seldon_messages[1].data.raw.data == arr.tobytes()
