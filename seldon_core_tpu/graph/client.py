@@ -150,6 +150,13 @@ class RestClient(UnitClient):
             # base64, no JSON text on the unit hop
             body = json_to_proto(message).SerializeToString()
             ctype = "application/x-protobuf"
+        elif method == "aggregate" and any(
+            has_raw_bytes(m) for m in message.get("seldonMessages", ())
+        ):
+            # combiner hop: the message list serializes via the recursive
+            # SeldonMessageList builder, keeping every tensor binary
+            body = json_to_proto(message, pb.SeldonMessageList).SerializeToString()
+            ctype = "application/x-protobuf"
         else:
             body = json.dumps(jsonable(message), separators=(",", ":")).encode()
             ctype = "application/json"

@@ -123,7 +123,8 @@ class BertClassifier(ServedModel):
             o = o.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
             o = o @ p["wo"].astype(dt) + p["wo_b"].astype(dt)
             x = _layer_norm(x + o, p["ln1_scale"], p["ln1_bias"])
-            f = jax.nn.gelu(x @ p["w1"].astype(dt) + p["w1_b"].astype(dt))
+            # exact (erf) gelu — original BERT and HF checkpoints use it
+            f = jax.nn.gelu(x @ p["w1"].astype(dt) + p["w1_b"].astype(dt), approximate=False)
             f = f @ p["w2"].astype(dt) + p["w2_b"].astype(dt)
             return _layer_norm(x + f, p["ln2_scale"], p["ln2_bias"]), None
 

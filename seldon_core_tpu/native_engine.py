@@ -34,7 +34,12 @@ _lib = None
 def _load():
     global _lib
     if _lib is None:
-        lib = ctypes.CDLL(build())
+        # RTLD_DEEPBIND: the engine must bind ITS libprotobuf symbols even
+        # when torch/tensorflow (which bundle incompatible protobuf
+        # symbols) were imported into this process first — without it the
+        # binary front segfaults whenever torch is loaded
+        mode = ctypes.RTLD_LOCAL | getattr(os, "RTLD_DEEPBIND", 0)
+        lib = ctypes.CDLL(build(), mode=mode)
         lib.sce_start.restype = ctypes.c_void_p
         lib.sce_start.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
         lib.sce_stop.argtypes = [ctypes.c_void_p]

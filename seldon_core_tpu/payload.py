@@ -477,6 +477,11 @@ def json_to_proto(body: JsonDict, msg_cls=pb.SeldonMessage):
     # BYTES: build recursively so every level takes the bytes fast path
     # (ParseDict on a bytes value would silently base64-"decode" garbage)
     if msg_cls is pb.Feedback:
+        unknown = set(body) - {"request", "response", "truth", "reward"}
+        if unknown:
+            # preserve ParseDict's strictness: a typo'd key must 400, not
+            # silently drop the field it was meant to set
+            raise PayloadError(f"unknown Feedback fields {sorted(unknown)}")
         msg = pb.Feedback()
         for key, field in (("request", msg.request), ("response", msg.response),
                            ("truth", msg.truth)):
@@ -486,6 +491,9 @@ def json_to_proto(body: JsonDict, msg_cls=pb.SeldonMessage):
             msg.reward = float(body["reward"])
         return msg
     if msg_cls is pb.SeldonMessageList:
+        unknown = set(body) - {"seldonMessages", "seldon_messages"}
+        if unknown:
+            raise PayloadError(f"unknown SeldonMessageList fields {sorted(unknown)}")
         msg = pb.SeldonMessageList()
         for m in body.get("seldonMessages") or body.get("seldon_messages") or []:
             msg.seldon_messages.append(json_to_proto(m))
