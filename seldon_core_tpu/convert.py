@@ -64,6 +64,12 @@ def convert_hf_bert(model) -> Tuple[Dict[str, Any], Dict[str, Any]]:
     pos_type = getattr(hf_cfg, "position_embedding_type", "absolute")
     if pos_type != "absolute":
         raise ValueError(f"unsupported position_embedding_type {pos_type!r}")
+    ln_eps = float(getattr(hf_cfg, "layer_norm_eps", 1e-12))
+    if abs(ln_eps - 1e-12) > 1e-15:
+        # BertClassifier._layer_norm hardcodes BERT's canonical 1e-12
+        raise ValueError(
+            f"BertClassifier uses layer_norm eps 1e-12; checkpoint uses {ln_eps}"
+        )
     layers = list(bert.encoder.layer)
     emb = bert.embeddings
 
@@ -162,6 +168,9 @@ def convert_hf_llama(model) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         "d_ff": hf_cfg.intermediate_size,
         "max_seq": hf_cfg.max_position_embeddings,
         "rope_theta": float(getattr(hf_cfg, "rope_theta", 10000.0)),
+        # checkpoint families differ (Llama-1/Qwen 1e-6, Llama-2/3 1e-5) —
+        # propagate, don't assume
+        "norm_eps": float(getattr(hf_cfg, "rms_norm_eps", 1e-5)),
     }
 
     def lin_w(linear):
@@ -218,8 +227,18 @@ def convert_hf(name_or_path: str, family: str, out_dir: str) -> str:
     if family not in HF_FAMILIES:
         raise ValueError(f"unknown family {family!r}; supported: {sorted(HF_FAMILIES)}")
     if family == "bert":
-        from transformers import AutoModelForSequenceClassification
+        from transformers import AutoConfig, AutoModelForSequenceClassification
 
+        hf_cfg = AutoConfig.from_pretrained(name_or_path)
+        archs = hf_cfg.architectures or []
+        if not any("ForSequenceClassification" in a for a in archs):
+            # loading such a checkpoint would random-init the classifier
+            # head and serve random logits with only an HF warning
+            raise ValueError(
+                f"checkpoint {name_or_path!r} has no classification head "
+                f"(architectures={archs}); fine-tune one or convert a "
+                "ForSequenceClassification checkpoint"
+            )
         model = AutoModelForSequenceClassification.from_pretrained(name_or_path)
     else:
         from transformers import AutoModelForCausalLM

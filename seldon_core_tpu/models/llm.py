@@ -43,6 +43,7 @@ class LLMConfig:
     n_experts: int = 0
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
+    norm_eps: float = 1e-5
     dtype: str = "bfloat16"
 
     @property
@@ -164,7 +165,7 @@ class DecoderLM(ServedModel):
         cfg = self.cfg
         dt = x.dtype
         B, T, D = x.shape
-        h = _rms_norm(x, p["ln1"].astype(dt))
+        h = _rms_norm(x, p["ln1"].astype(dt), cfg.norm_eps)
         q = h @ p["wq"].astype(dt)  # [B,T,Hl*Dh] (Hl = local heads under tp)
         k = h @ p["wk"].astype(dt)
         v = h @ p["wv"].astype(dt)
@@ -224,7 +225,7 @@ class DecoderLM(ServedModel):
 
         cfg = self.cfg
         dt = x.dtype
-        h = _rms_norm(x, p["ln2"].astype(dt))
+        h = _rms_norm(x, p["ln2"].astype(dt), cfg.norm_eps)
         if cfg.n_experts > 0:
             from ..parallel.moe import moe_ffn
 
@@ -290,7 +291,7 @@ class DecoderLM(ServedModel):
         x = params["embed"][tokens].astype(dt)
         positions = jnp.arange(tokens.shape[1])
         x, _ = self.backbone(params["blocks"], x, positions)
-        x = _rms_norm(x, params["ln_f"].astype(dt))
+        x = _rms_norm(x, params["ln_f"].astype(dt), cfg.norm_eps)
         return (x @ params["unembed"].astype(dt)).astype(jnp.float32)
 
     # ------------------------------------------------------------------
@@ -329,7 +330,7 @@ class DecoderLM(ServedModel):
             return x + ffn_out, new_cache
 
         x, (nk, nv) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
-        x = _rms_norm(x, params["ln_f"].astype(dt))
+        x = _rms_norm(x, params["ln_f"].astype(dt), cfg.norm_eps)
         logits = (x[:, 0] @ params["unembed"].astype(dt)).astype(jnp.float32)
         return logits, {"k": nk, "v": nv}
 
@@ -374,7 +375,7 @@ class DecoderLM(ServedModel):
         positions = jnp.arange(Tp)
 
         def body(x, layer_p):
-            h = _rms_norm(x, layer_p["ln1"].astype(dt))
+            h = _rms_norm(x, layer_p["ln1"].astype(dt), cfg.norm_eps)
             q = h @ layer_p["wq"].astype(dt)
             k = h @ layer_p["wk"].astype(dt)
             v = h @ layer_p["wv"].astype(dt)
@@ -403,7 +404,7 @@ class DecoderLM(ServedModel):
             return x + ffn_out, (k_cache, v_cache)
 
         x, (ck, cv) = lax.scan(body, x, params["blocks"])
-        x = _rms_norm(x, params["ln_f"].astype(dt))
+        x = _rms_norm(x, params["ln_f"].astype(dt), cfg.norm_eps)
         if last_index is None:
             x_last = x[:, -1]
         else:
@@ -460,7 +461,7 @@ class DecoderLM(ServedModel):
         inputs = tokens[:, :-1].astype(jnp.int32)
         x = params["embed"][inputs].astype(dt)
         x, aux = self.backbone(params["blocks"], x, jnp.arange(inputs.shape[1]))
-        x = _rms_norm(x, params["ln_f"].astype(dt))
+        x = _rms_norm(x, params["ln_f"].astype(dt), cfg.norm_eps)
         logits = (x @ params["unembed"].astype(dt)).astype(jnp.float32)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, tokens[:, 1:])
         return ce.mean() + cfg.aux_loss_weight * aux

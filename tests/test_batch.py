@@ -1,0 +1,126 @@
+"""Streaming batch scorer (the Kafka-streaming stand-in): ordered JSONL
+output, client-side row fusing, failure records, live engine target."""
+
+import asyncio
+import io
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.batch import BatchScorer, fuse_rows, read_records
+from seldon_core_tpu.graph.service import EngineApp
+from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
+
+from _net import free_port
+
+
+@pytest.fixture
+def engine_port():
+    spec = default_predictor(
+        PredictorSpec.from_dict(
+            {"name": "b", "graph": {"name": "m", "implementation": "SIMPLE_MODEL"}}
+        )
+    )
+    app = EngineApp(spec)
+    port = free_port()
+    loop = asyncio.new_event_loop()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(app.rest_app().serve_forever("127.0.0.1", port))
+
+    threading.Thread(target=run, daemon=True).start()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.2).close()
+            break
+        except OSError:
+            time.sleep(0.02)
+    yield port
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def test_read_records_jsonl_and_csv():
+    jl = io.StringIO('{"data":{"ndarray":[[1,2]]}}\n[3,4]\n\n')
+    recs = list(read_records(jl, "jsonl"))
+    assert recs[0]["data"]["ndarray"] == [[1, 2]]
+    assert recs[1]["data"]["ndarray"] == [[3, 4]]
+    cs = io.StringIO("1.5,2.5\n3.5,4.5\n")
+    recs = list(read_records(cs, "csv"))
+    assert recs[1]["data"]["ndarray"] == [[3.5, 4.5]]
+
+
+def test_fuse_rows_batches_and_passthrough():
+    recs = [
+        {"data": {"ndarray": [[1]]}},
+        {"data": {"ndarray": [[2]]}},
+        {"data": {"ndarray": [[3]]}},
+        {"strData": "x"},  # not fusable
+        {"data": {"ndarray": [[4]]}},
+    ]
+    fused = list(fuse_rows(iter(recs), batch_rows=2))
+    assert fused[0] == {"message": {"data": {"ndarray": [[1], [2]]}}, "count": 2}
+    assert fused[1] == {"message": {"data": {"ndarray": [[3]]}}, "count": 1}
+    assert fused[2]["message"] == {"strData": "x"}
+    assert fused[3] == {"message": {"data": {"ndarray": [[4]]}}, "count": 1}
+
+
+def run_batch(port, lines, **kw):
+    batch_rows = kw.pop("batch_rows", 1)
+    scorer = BatchScorer(f"http://127.0.0.1:{port}", **kw)
+    out = io.StringIO()
+    stats = asyncio.run(
+        scorer.run(
+            fuse_rows(read_records(io.StringIO(lines), "jsonl"), batch_rows),
+            out,
+        )
+    )
+    return stats, [json.loads(l) for l in out.getvalue().splitlines()]
+
+
+def test_batch_scoring_ordered_output(engine_port):
+    lines = "\n".join(f"[{i}.0, 1.0]" for i in range(25))
+    stats, results = run_batch(engine_port, lines, concurrency=8)
+    assert stats["requests"] == 25 and stats["failures"] == 0
+    assert [r["index"] for r in results] == list(range(25))
+    for r in results:
+        assert r["response"]["data"]["ndarray"] == [[0.9, 0.05, 0.05]]
+
+
+def test_batch_scoring_with_row_fusing(engine_port):
+    lines = "\n".join(f"[{i}.0]" for i in range(10))
+    stats, results = run_batch(engine_port, lines, concurrency=4, batch_rows=4)
+    assert stats["rows"] == 10
+    assert stats["requests"] == 3  # 4+4+2 fused
+    # one output line PER INPUT RECORD, in order, each with its own row
+    assert [r["index"] for r in results] == list(range(10))
+    for r in results:
+        assert r["response"]["data"]["ndarray"] == [[0.9, 0.05, 0.05]]
+
+
+def test_fuse_rows_respects_names_boundaries():
+    recs = [
+        {"data": {"names": ["a"], "ndarray": [[1]]}},
+        {"data": {"names": ["a"], "ndarray": [[2]]}},
+        {"data": {"names": ["b"], "ndarray": [[3]]}},
+    ]
+    fused = list(fuse_rows(iter(recs), batch_rows=4))
+    assert fused[0]["message"]["data"] == {"ndarray": [[1], [2]], "names": ["a"]}
+    assert fused[1]["message"]["data"] == {"ndarray": [[3]], "names": ["b"]}
+
+
+def test_batch_scoring_records_failures():
+    scorer = BatchScorer("http://127.0.0.1:1", concurrency=2, timeout_s=0.3)
+    out = io.StringIO()
+    stats = asyncio.run(
+        scorer.run(fuse_rows(read_records(io.StringIO("[1.0]\n[2.0]"), "jsonl"), 1), out)
+    )
+    assert stats["failures"] == 2
+    results = [json.loads(l) for l in out.getvalue().splitlines()]
+    assert all("error" in r for r in results)
+    assert [r["index"] for r in results] == [0, 1]
