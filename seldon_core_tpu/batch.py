@@ -35,18 +35,31 @@ from typing import Any, Dict, Iterable, Iterator, List, Optional, TextIO
 logger = logging.getLogger(__name__)
 
 
+PARSE_ERROR = "_parse_error"
+
+
 def read_records(stream: TextIO, fmt: str) -> Iterator[Dict[str, Any]]:
-    """Yield SeldonMessage-shaped dicts from a JSONL or CSV stream."""
+    """Yield SeldonMessage-shaped dicts from a JSONL or CSV stream. A
+    malformed line yields a {PARSE_ERROR: ...} marker instead of aborting
+    the whole run — per-record failure is the module's contract."""
     if fmt == "csv":
         for row in csv.reader(stream):
-            if row:
+            if not row:
+                continue
+            try:
                 yield {"data": {"ndarray": [[float(x) for x in row]]}}
+            except ValueError as e:
+                yield {PARSE_ERROR: f"bad csv row {row!r}: {e}"}
         return
     for line in stream:
         line = line.strip()
         if not line:
             continue
-        rec = json.loads(line)
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            yield {PARSE_ERROR: f"bad json line: {e}"}
+            continue
         if isinstance(rec, list):
             rec = {"data": {"ndarray": [rec]}}
         yield rec
@@ -77,7 +90,8 @@ def fuse_rows(records: Iterable[Dict[str, Any]], batch_rows: int) -> Iterator[Di
         data = rec.get("data") or {}
         names = data.get("names") or None
         fusable = (
-            set(rec.keys()) <= {"data"}
+            PARSE_ERROR not in rec
+            and set(rec.keys()) <= {"data"}
             and set(data.keys()) <= {"ndarray", "names"}
             and isinstance(data.get("ndarray"), list)
             and len(data["ndarray"]) == 1
@@ -108,16 +122,39 @@ class BatchScorer:
         binary: bool = False,
         timeout_s: float = 60.0,
     ):
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+        from urllib.parse import urlparse
+
         self.target = target.rstrip("/")
         self.path = path
         self.concurrency = max(1, int(concurrency))
         self.binary = binary
         self.timeout_s = timeout_s
         self.stats = {"requests": 0, "rows": 0, "failures": 0}
+        parsed = urlparse(self.target if "//" in self.target else f"http://{self.target}")
+        self._host = parsed.hostname
+        self._port = parsed.port or (443 if parsed.scheme == "https" else 80)
+        self._tls = parsed.scheme == "https"
+        # own pool sized to the requested concurrency (the loop's default
+        # executor is cpu+4 threads — it would silently cap parallelism),
+        # with one KEEP-ALIVE http connection per worker thread
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.concurrency, thread_name_prefix="batch-score"
+        )
+        self._local = threading.local()
+
+    def _connection(self):
+        import http.client
+
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            cls = http.client.HTTPSConnection if self._tls else http.client.HTTPConnection
+            conn = cls(self._host, self._port, timeout=self.timeout_s)
+            self._local.conn = conn
+        return conn
 
     async def _post(self, message: Dict[str, Any]) -> Dict[str, Any]:
-        import urllib.request
-
         from .payload import json_to_proto, jsonable, proto_to_json
         from .proto import prediction_pb2 as pb
 
@@ -127,16 +164,25 @@ class BatchScorer:
         else:
             body = json.dumps(jsonable(message)).encode()
             headers = {"Content-Type": "application/json"}
-        req = urllib.request.Request(self.target + self.path, data=body, headers=headers)
 
         def send():
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
-                payload = r.read()
-                if (r.headers.get("Content-Type") or "").startswith("application/x-protobuf"):
-                    return jsonable(proto_to_json(pb.SeldonMessage.FromString(payload)))
-                return json.loads(payload)
+            conn = self._connection()
+            try:
+                conn.request("POST", self.path, body, headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+            except Exception:
+                # a broken keep-alive connection must not poison the thread
+                conn.close()
+                self._local.conn = None
+                raise
+            if resp.status >= 400:
+                raise RuntimeError(f"HTTP {resp.status}: {payload[:200]!r}")
+            if (resp.headers.get("Content-Type") or "").startswith("application/x-protobuf"):
+                return jsonable(proto_to_json(pb.SeldonMessage.FromString(payload)))
+            return json.loads(payload)
 
-        return await asyncio.get_running_loop().run_in_executor(None, send)
+        return await asyncio.get_running_loop().run_in_executor(self._pool, send)
 
     @staticmethod
     def _split_records(first_record: int, count: int, out: Dict[str, Any]) -> List[Dict]:
@@ -171,18 +217,23 @@ class BatchScorer:
         async def score(req_idx: int, first_record: int, item: Dict[str, Any]):
             nonlocal next_write
             count = item["count"]
+            parse_err = item["message"].get(PARSE_ERROR)
             async with sem:
-                try:
-                    out = await self._post(item["message"])
-                    records = self._split_records(first_record, count, out)
-                    self.stats["rows"] += count
-                except Exception as e:  # noqa: BLE001 - record, don't die
-                    records = [
-                        {"index": first_record + i, "error": f"{type(e).__name__}: {e}"}
-                        for i in range(count)
-                    ]
+                if parse_err is not None:
+                    records = [{"index": first_record, "error": parse_err}]
                     self.stats["failures"] += 1
-                self.stats["requests"] += 1
+                else:
+                    try:
+                        out = await self._post(item["message"])
+                        records = self._split_records(first_record, count, out)
+                        self.stats["rows"] += count
+                    except Exception as e:  # noqa: BLE001 - record, don't die
+                        records = [
+                            {"index": first_record + i, "error": f"{type(e).__name__}: {e}"}
+                            for i in range(count)
+                        ]
+                        self.stats["failures"] += 1
+                    self.stats["requests"] += 1
             async with write_lock:
                 results[req_idx] = records
                 while next_write in results:
@@ -190,9 +241,25 @@ class BatchScorer:
                         out_stream.write(json.dumps(rec) + "\n")
                     next_write += 1
 
+        # pull the (possibly blocking: stdin, slow producers) iterator on a
+        # reader thread so in-flight requests proceed WHILE records stream in
+        loop = asyncio.get_running_loop()
+        it = iter(requests)
+        _END = object()
+
+        def pull():
+            try:
+                return next(it)
+            except StopIteration:
+                return _END
+
         tasks = []
         record_base = 0
-        for req_idx, item in enumerate(requests):
+        req_idx = 0
+        while True:
+            item = await loop.run_in_executor(None, pull)
+            if item is _END:
+                break
             # backpressure: do not materialise the whole dataset as tasks
             while len(tasks) >= self.concurrency * 4:
                 done, pending = await asyncio.wait(
@@ -203,6 +270,7 @@ class BatchScorer:
                 asyncio.ensure_future(score(req_idx, record_base, item))
             )
             record_base += item["count"]
+            req_idx += 1
         if tasks:
             await asyncio.gather(*tasks)
         out_stream.flush()

@@ -128,7 +128,12 @@ def _spawn_workers(n: int, argv: List[str]) -> int:
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser("seldon-tpu-microservice")
     parser.add_argument("interface_name", help="module.Class of the user component")
-    parser.add_argument("api_type", nargs="?", default="BOTH", choices=["REST", "GRPC", "BOTH"])
+    # FBS: the reference's third (zero-copy flatbuffers) transport
+    # (reference: microservice.py:186). Our zero-copy transport is binary
+    # protobuf ON the REST port (application/x-protobuf bodies), so FBS
+    # maps to REST — same port serves both encodings by content type.
+    parser.add_argument("api_type", nargs="?", default="BOTH",
+                        choices=["REST", "GRPC", "BOTH", "FBS"])
     parser.add_argument("--service-port", type=int, default=DEFAULT_PORT)
     parser.add_argument("--grpc-port", type=int, default=DEFAULT_GRPC_PORT)
     parser.add_argument("--host", default="0.0.0.0")
@@ -210,7 +215,7 @@ def main(argv=None) -> None:
         grpc_server.start()
         logger.info("gRPC listening on %s:%d", args.host, args.grpc_port)
 
-    if args.api_type in ("REST", "BOTH"):
+    if args.api_type in ("REST", "BOTH", "FBS"):
         try:
             asyncio.run(
                 _serve_rest(user_object, args.host, args.service_port, state,

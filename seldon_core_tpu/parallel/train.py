@@ -159,7 +159,7 @@ def make_train_step(
 
         from ..models.llm import _rms_norm
 
-        y = _rms_norm(y, params["ln_f"].astype(dt))
+        y = _rms_norm(y, params["ln_f"].astype(dt), cfg.norm_eps)
         logits = (y @ params["unembed"].astype(dt)).astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
         ce = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

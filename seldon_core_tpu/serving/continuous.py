@@ -337,19 +337,14 @@ class ContinuousBatcher:
                 active_dev = jnp.asarray(active)
                 temps_dev = jnp.asarray(temps)
                 # one fused burst of k steps = ONE device call + ONE host
-                # sync; k never overshoots the tightest remaining budget so
-                # requests still stop at exactly max_new_tokens (a pending
-                # prefill-first consumes one unit of that budget)
-                min_remaining = min(
-                    s.request.max_new_tokens
-                    - len(s.emitted)
-                    - (1 if s.first_pending else 0)
-                    for s in self._active.values()
-                )
-                k = max(1, min(self.steps_per_poll, min_remaining))
-                # power-of-two bucket: at most log2(steps_per_poll)+1
-                # compiled burst variants
-                while k & (k - 1):
+                # sync. k is FIXED at steps_per_poll (one compiled variant):
+                # lanes that hit max_new_tokens or eos mid-burst simply have
+                # their overshoot tokens dropped by the append loop below —
+                # clamping k to the tightest remaining budget (the previous
+                # design) made staggered requests force tiny bursts on every
+                # lane, paying the sync RTT per token near each completion
+                k = max(1, self.steps_per_poll)
+                while k & (k - 1):  # pow2 guard for odd configs
                     k &= k - 1
                 toks, self._cur_tok, self._pos, self._cache, self._keys = (
                     self._burst_fn(

@@ -124,3 +124,56 @@ def test_batch_scoring_records_failures():
     results = [json.loads(l) for l in out.getvalue().splitlines()]
     assert all("error" in r for r in results)
     assert [r["index"] for r in results] == [0, 1]
+
+
+def test_parse_errors_recorded_not_fatal(engine_port):
+    lines = '[1.0]\n{"broken json\n[2.0]'
+    stats, results = run_batch(engine_port, lines, concurrency=2)
+    assert stats["failures"] == 1
+    assert len(results) == 3
+    assert "error" in results[1] and "bad json" in results[1]["error"]
+    assert results[0]["response"] and results[2]["response"]
+    assert [r["index"] for r in results] == [0, 1, 2]
+
+
+def test_streaming_input_pipelines_before_eof(engine_port):
+    """Records arriving slowly still get scored while the stream is open
+    (the reader thread must not starve the request tasks)."""
+    import queue as q
+
+    feed: "q.Queue" = q.Queue()
+    scored = []
+
+    class SlowStream:
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            item = feed.get()
+            if item is None:
+                raise StopIteration
+            return item
+
+    def records():
+        for rec in SlowStream():
+            yield {"data": {"ndarray": [rec]}}
+
+    out = io.StringIO()
+    scorer = BatchScorer(f"http://127.0.0.1:{engine_port}", concurrency=2)
+
+    async def go():
+        task = asyncio.ensure_future(
+            scorer.run(fuse_rows(records(), 1), out)
+        )
+        feed.put([1.0])
+        # the first record must be scored while the stream is still open
+        deadline = asyncio.get_running_loop().time() + 10
+        while not out.getvalue() and asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(0.05)
+        assert out.getvalue(), "no result written while stream open"
+        feed.put([2.0])
+        feed.put(None)
+        return await task
+
+    stats = asyncio.run(go())
+    assert stats["requests"] == 2 and stats["failures"] == 0
