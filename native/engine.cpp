@@ -372,6 +372,11 @@ struct RequestCtx {
   std::mt19937* rng = nullptr;
   std::map<std::string, UpstreamConn>* upstreams = nullptr;
   std::string error;  // non-empty => fail request
+  // inbound request arrived as binary protobuf: REMOTE unit hops forward
+  // binary protobuf too (no JSON text/base64 on any hop; values re-encode
+  // as float64 through the engine's numeric model — a dtype-preserving
+  // bytes passthrough would need a raw node in the internal value type)
+  bool binary = false;
 };
 
 struct Engine {
@@ -617,10 +622,28 @@ static bool read_http_response(int fd, std::string& body, int& status, const Dea
   }
 }
 
+// forward decls: binary-front conversions (defined with the proto front below)
+static void result_to_proto(const json::Value& result, const std::string& reply_enc,
+                            seldontpu::SeldonMessage& m);
+static bool proto_to_value(const seldontpu::SeldonMessage& m, json::Value& out,
+                           std::string& reply_enc, std::string& err);
+
 static json::Value remote_call(RequestCtx& ctx, const Unit& u, const char* path, const json::Value& msg) {
   std::string key = u.host + ":" + std::to_string(u.port);
   UpstreamConn& conn = (*ctx.upstreams)[key];
-  std::string body = json::serialize(msg);
+  // binary inbound -> binary upstream (except /aggregate: the list shape
+  // keeps JSON); the wrapper mirrors the encoding on its response
+  const bool bin_hop = ctx.binary && strcmp(path, "/aggregate") != 0;
+  std::string body;
+  const char* ctype = "application/json";
+  if (bin_hop) {
+    seldontpu::SeldonMessage pbmsg;
+    result_to_proto(msg, "raw", pbmsg);
+    pbmsg.SerializeToString(&body);
+    ctype = "application/x-protobuf";
+  } else {
+    body = json::serialize(msg);
+  }
   char head[256];
   // one deadline for the WHOLE hop (connect + 3 retries + reads) so a dead
   // or trickling upstream can't stack per-attempt timeouts into a 30s+
@@ -639,8 +662,8 @@ static json::Value remote_call(RequestCtx& ctx, const Unit& u, const char* path,
     else set_io_timeouts(conn.fd, rem);
     if (conn.fd < 0) continue;
     int n = snprintf(head, sizeof head,
-                     "POST %s HTTP/1.1\r\nHost: %s\r\nContent-Type: application/json\r\nContent-Length: %zu\r\n\r\n",
-                     path, u.host.c_str(), body.size());
+                     "POST %s HTTP/1.1\r\nHost: %s\r\nContent-Type: %s\r\nContent-Length: %zu\r\n\r\n",
+                     path, u.host.c_str(), ctype, body.size());
     std::string req(head, n);
     req += body;
     if (write(conn.fd, req.data(), req.size()) != (ssize_t)req.size()) { close(conn.fd); conn.fd = -1; continue; }
@@ -648,6 +671,17 @@ static json::Value remote_call(RequestCtx& ctx, const Unit& u, const char* path,
     int status = 0;
     if (!read_http_response(conn.fd, resp_body, status, deadline)) { close(conn.fd); conn.fd = -1; continue; }
     if (status >= 400) { ctx.error = "unit " + u.name + " returned " + std::to_string(status); return {}; }
+    if (bin_hop) {
+      seldontpu::SeldonMessage resp;
+      std::string enc, err;
+      json::Value out;
+      if (!resp.ParseFromArray(resp_body.data(), int(resp_body.size())) ||
+          !proto_to_value(resp, out, enc, err)) {
+        ctx.error = "unit " + u.name + " returned invalid protobuf: " + err;
+        return {};
+      }
+      return out;
+    }
     json::Parser p(resp_body);
     json::Value out = p.parse();
     if (!p.ok) { ctx.error = "unit " + u.name + " returned invalid JSON"; return {}; }
@@ -966,6 +1000,31 @@ static bool proto_to_value(const seldontpu::SeldonMessage& m, json::Value& out,
       for (auto& kv : m.meta().tags()) tags.set(kv.first, pbvalue_to_value(kv.second));
       meta.set("tags", std::move(tags));
     }
+    if (!m.meta().request_path().empty()) {
+      json::Value rp = json::Value::object();
+      for (auto& kv : m.meta().request_path()) rp.set(kv.first, json::Value::string(kv.second));
+      meta.set("requestPath", std::move(rp));
+    }
+    if (!m.meta().routing().empty()) {
+      json::Value ro = json::Value::object();
+      for (auto& kv : m.meta().routing()) ro.set(kv.first, json::Value::number(kv.second));
+      meta.set("routing", std::move(ro));
+    }
+    if (m.meta().metrics_size() > 0) {
+      // custom metrics from remote units must survive the binary hop
+      // (absorb_meta forwards them into the response Meta)
+      json::Value ms = json::Value::array();
+      for (auto& metric : m.meta().metrics()) {
+        json::Value one = json::Value::object();
+        one.set("key", json::Value::string(metric.key()));
+        one.set("type", json::Value::string(
+            metric.type() == seldontpu::Metric::GAUGE ? "GAUGE"
+            : metric.type() == seldontpu::Metric::TIMER ? "TIMER" : "COUNTER"));
+        one.set("value", json::Value::number(metric.value()));
+        ms.arr->push_back(std::move(one));
+      }
+      meta.set("metrics", std::move(ms));
+    }
     out.set("meta", std::move(meta));
   }
   switch (m.data_oneof_case()) {
@@ -1094,6 +1153,12 @@ static void result_to_proto(const json::Value& result, const std::string& reply_
     if (names->type == json::Value::Arr)
       for (auto& n : *names->arr)
         if (n.type == json::Value::Str) pd->add_names(n.str);
+  // flat (rank-1) ndarrays must stay rank-1 on the wire: a model behind a
+  // binary client must see the same input shape a JSON client produces
+  bool flat = false;
+  if (const json::Value* nd = data->find("ndarray"))
+    if (nd->type == json::Value::Arr && !nd->arr->empty())
+      flat = (*nd->arr)[0].type == json::Value::Num;
   std::vector<std::vector<double>> rows;
   if (!result_rows(*data, rows)) {
     // non-numeric payload (e.g. string labels from a remote unit): carry
@@ -1108,7 +1173,7 @@ static void result_to_proto(const json::Value& result, const std::string& reply_
   if (reply_enc == "raw") {
     auto* raw = pd->mutable_raw();
     raw->set_dtype("float64");
-    raw->add_shape(int(rows.size()));
+    if (!flat) raw->add_shape(int(rows.size()));
     raw->add_shape(rows.empty() ? 0 : int(rows[0].size()));
     std::string bytes;
     for (auto& row : rows)
@@ -1122,7 +1187,7 @@ static void result_to_proto(const json::Value& result, const std::string& reply_
     }
   } else {  // tensor (default)
     auto* t = pd->mutable_tensor();
-    t->add_shape(int(rows.size()));
+    if (!flat) t->add_shape(int(rows.size()));
     t->add_shape(rows.empty() ? 0 : int(rows[0].size()));
     for (auto& row : rows)
       for (double x : row) t->add_values(x);
@@ -1311,6 +1376,7 @@ static bool process_buffer(Engine& eng, Conn& c, std::mt19937& rng,
         ctx.engine = &eng;
         ctx.rng = &rng;
         ctx.upstreams = &upstreams;
+        ctx.binary = binary;
         handle_predictions(eng, ctx, body, c.out, binary);
       }
     } else if (path == "/ping") {
