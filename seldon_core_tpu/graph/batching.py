@@ -202,7 +202,15 @@ class MicroBatchingClient(UnitClient):
                         "seldon_engine_microbatch_padded_rows", float(padded_rows - rows)
                     )
             names = (batch[0][1].get("data") or {}).get("names", [])
-            enc = "raw" if fused.dtype.itemsize <= 4 and fused.dtype.kind == "f" else "ndarray"
+            # raw keeps bytes end-to-end on the fused hop for every numeric
+            # dtype, bf16/fp8 included (kind 'V') — ndarray would round-trip
+            # through Python lists (and upcast the extended dtypes)
+            enc = (
+                "raw"
+                if fused.dtype.kind in "fiub"
+                or payload_mod.is_extended_dtype(fused.dtype)
+                else "ndarray"
+            )
             fused_msg = {"data": payload_mod.array_to_json_data(fused, names, enc)}
             meta = batch[0][1].get("meta")
             if meta:
@@ -224,7 +232,17 @@ class MicroBatchingClient(UnitClient):
                 piece = out[offset : offset + n]
                 offset += n
                 resp_i = dict(response)
-                resp_i["data"] = payload_mod.array_to_json_data(piece, out_names, out_enc)
+                # each caller gets its piece back in ITS request encoding
+                # (a JSON ndarray client must not see raw bytes just because
+                # the fused hop ran binary)
+                req_data = message.get("data") or {}
+                enc_i = payload_mod.effective_encoding(
+                    piece,
+                    next(
+                        (k for k in payload_mod.TENSOR_KEYS if k in req_data), out_enc
+                    ),
+                )
+                resp_i["data"] = payload_mod.array_to_json_data(piece, out_names, enc_i)
                 if not fut.done():
                     fut.set_result(resp_i)
         except Exception as e:  # noqa: BLE001 - fail every waiter

@@ -64,6 +64,23 @@ def dtype_from_name(name: str) -> np.dtype:
         raise PayloadError(f"unknown dtype {name!r}") from e
 
 
+def is_extended_dtype(dtype: Any) -> bool:
+    """True for the ml_dtypes types (bfloat16/fp8) that can't ride
+    'tensor'/'ndarray' JSON without a silent upcast."""
+    return np.dtype(dtype).name in _EXTENDED_DTYPES
+
+
+def effective_encoding(arr: ArrayLike, requested: Optional[str]) -> str:
+    """Wire encoding to actually use for ``arr``: honours ``requested``
+    except that bfloat16/fp8 can't ride 'tensor'/'ndarray' JSON without a
+    silent upcast — those are forced to 'raw'. The single place this rule
+    lives; response builders and the micro-batch split all use it."""
+    enc = requested or "ndarray"
+    if np.dtype(_to_numpy(arr).dtype).name in _EXTENDED_DTYPES and enc != "raw":
+        enc = "raw"
+    return enc
+
+
 def dtype_name(dtype) -> str:
     return np.dtype(dtype).name
 
@@ -344,12 +361,9 @@ def build_json_response(
         out["jsonData"] = None
     elif isinstance(result, (list, tuple)) or _is_arraylike(result):
         arr = result if _is_arraylike(result) else np.asarray(result)
-        # bfloat16/f8 can't ride 'tensor'/'ndarray' JSON without upcast; keep
-        # raw for those, else honour the requester's encoding.
-        enc = datadef_type or "ndarray"
-        if np.dtype(_to_numpy(arr).dtype).name in _EXTENDED_DTYPES and enc != "raw":
-            enc = "raw"
-        out["data"] = array_to_json_data(arr, names, enc)
+        out["data"] = array_to_json_data(
+            arr, names, effective_encoding(arr, datadef_type)
+        )
     elif isinstance(result, bytes):
         out["binData"] = base64.b64encode(result).decode("ascii")
     elif isinstance(result, str):
@@ -374,9 +388,7 @@ def build_proto_response(
         msg.json_data = "null"
     elif isinstance(result, (list, tuple)) or _is_arraylike(result):
         arr = result if _is_arraylike(result) else np.asarray(result)
-        enc = datadef_type or "raw"
-        if np.dtype(_to_numpy(arr).dtype).name in _EXTENDED_DTYPES and enc != "raw":
-            enc = "raw"
+        enc = effective_encoding(arr, datadef_type or "raw")
         msg.data.CopyFrom(array_to_proto_data(arr, names, enc))
     elif isinstance(result, bytes):
         msg.bin_data = result

@@ -180,3 +180,70 @@ def test_micro_batching_padding_capped_at_max_batch():
     outs = asyncio.run(small())
     assert outs[1]["data"]["ndarray"] == [[6.0]]
     assert 4 in model.calls  # 3 rows padded to bucket 4
+
+
+class Bf16BatchModel(SeldonComponent):
+    """Model whose outputs are bfloat16 (JAXComponent's default compute
+    dtype) — the fused split must force raw encoding for extended dtypes
+    even when the caller sent JSON ndarray."""
+
+    def predict(self, X, names, meta=None):
+        import ml_dtypes
+
+        return (np.asarray(X) * 2).astype(ml_dtypes.bfloat16)
+
+
+def test_micro_batching_bf16_output_splits_as_raw():
+    model = Bf16BatchModel()
+    spec = default_predictor(
+        PredictorSpec.from_dict({"name": "d", "graph": {"name": "m", "type": "MODEL"}})
+    )
+    app = EngineApp(
+        spec,
+        registry={"m": model},
+        metrics=MetricsRegistry(),
+        batching={"m": {"max_batch": 8, "timeout_ms": 20.0}},
+    )
+
+    async def fire():
+        reqs = [
+            app.predict({"data": {"ndarray": [[float(i), 1.0]]}}) for i in range(4)
+        ]
+        return await asyncio.gather(*reqs)
+
+    outs = asyncio.run(fire())
+    from seldon_core_tpu import payload
+
+    for i, out in enumerate(outs):
+        # bf16 can't ride ndarray JSON: the split re-encode must fall back
+        # to raw (same rule as payload.build_response)
+        assert "raw" in out["data"], out["data"].keys()
+        arr = payload.json_data_to_array(out["data"])
+        np.testing.assert_allclose(
+            np.asarray(arr, dtype=np.float32), [[2.0 * i, 2.0]]
+        )
+
+
+def test_micro_batching_int_requests_mirror_requester_encoding():
+    """Int token batches fuse over raw bytes internally, but each JSON
+    ndarray caller still gets ndarray back."""
+    model = CountingBatchModel()
+    spec = default_predictor(
+        PredictorSpec.from_dict({"name": "d", "graph": {"name": "m", "type": "MODEL"}})
+    )
+    app = EngineApp(
+        spec,
+        registry={"m": model},
+        metrics=MetricsRegistry(),
+        batching={"m": {"max_batch": 8, "timeout_ms": 20.0}},
+    )
+
+    async def fire():
+        reqs = [app.predict({"data": {"ndarray": [[i, i + 1]]}}) for i in range(4)]
+        return await asyncio.gather(*reqs)
+
+    outs = asyncio.run(fire())
+    assert len(model.calls) < 4  # fused
+    for i, out in enumerate(outs):
+        assert "ndarray" in out["data"], out["data"].keys()
+        np.testing.assert_allclose(out["data"]["ndarray"], [[2 * i, 2 * (i + 1)]])
