@@ -411,7 +411,7 @@ def bench_bert_grpc(
 def bench_generate(
     root: str,
     seconds: float = 8.0,
-    concurrency: int = 16,
+    concurrency: int = 32,
     prompt_len: int = 32,
     max_new_tokens: int = 32,
     slots: int = 16,

@@ -156,7 +156,10 @@ class DecoderLM(ServedModel):
     # forward building blocks (axis-parametrised: None => single chip)
     # ------------------------------------------------------------------
 
-    def _attention(self, p, x, positions, *, tp_axis=None, sp_axis=None, kv_cache=None):
+    def _attention(
+        self, p, x, positions, *, tp_axis=None, sp_axis=None, kv_cache=None,
+        attn_len=None,
+    ):
         import jax.numpy as jnp
         from jax import lax
 
@@ -194,20 +197,38 @@ class DecoderLM(ServedModel):
                 cv = lax.dynamic_update_slice(cv, v, (0, 0, cache_pos, 0))
             k, v = ck, cv
             new_cache = (ck, cv)
+            if attn_len is not None and attn_len < k.shape[2]:
+                # decode is cache-bandwidth-bound: read only the prefix the
+                # scheduler proved can hold keys (a STATIC bucket >= every
+                # lane's position + 1, so one executable per bucket). The
+                # full cache is still written above — only the read narrows.
+                k = lax.slice_in_dim(k, 0, attn_len, axis=2)
+                v = lax.slice_in_dim(v, 0, attn_len, axis=2)
         if KVl < Hl:  # GQA: repeat kv groups
             rep = Hl // KVl
             k = jnp.repeat(k, rep, axis=1)
             v = jnp.repeat(v, rep, axis=1)
         if kv_cache is not None:
-            # decode attention: q [B,H,1,Dh] over full cache with position mask
-            Tc = k.shape[2]
-            s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
-            s = s / np.sqrt(cfg.head_dim)
-            mask = jnp.arange(Tc)[None, None, None, :] <= positions[:, None, None, None]
-            s = jnp.where(mask, s, -1e30)
+            # decode attention: q [B,H,1,Dh] over full cache with position
+            # mask. Scores run on the bf16 cache directly with f32
+            # ACCUMULATION (preferred_element_type) — casting the cache to
+            # f32 first would double the HBM read and materialise a full
+            # f32 copy per step, which dominates decode time at long cache
+            # lengths (the decode step is cache-bandwidth-bound).
             import jax
 
-            o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v.astype(jnp.float32)).astype(dt)
+            Tc = k.shape[2]
+            s = lax.dot_general(
+                q, k, (((3,), (3,)), ((0, 1), (0, 1))),
+                preferred_element_type=jnp.float32,
+            ) / np.sqrt(cfg.head_dim)
+            mask = jnp.arange(Tc)[None, None, None, :] <= positions[:, None, None, None]
+            s = jnp.where(mask, s, -1e30)
+            w = jax.nn.softmax(s, -1).astype(dt)
+            o = lax.dot_general(
+                w, v, (((3,), (2,)), ((0, 1), (0, 1))),
+                preferred_element_type=jnp.float32,
+            ).astype(dt)
         elif sp_axis is not None:
             o = ring_attention(q, k, v, sp_axis, causal=True)
         else:
@@ -308,31 +329,52 @@ class DecoderLM(ServedModel):
         dt = jnp.dtype(cfg.dtype)
         return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
-    def _decode(self, params, cache, tokens, positions, cache_pos):
-        """Shared decode-step pipeline: embed -> scan blocks with KV-cache
-        attention -> final norm -> unembed. ``positions`` is [B] int32;
-        ``cache_pos`` is a scalar (aligned batch) or [B] (ragged batch) —
-        ``_attention`` branches on its rank for the K/V write + mask."""
+    def _embed_tokens(self, params, tokens):
         import jax.numpy as jnp
-        from jax import lax
+
+        dt = jnp.dtype(self.cfg.dtype)
+        return params["embed"][tokens.astype(jnp.int32)].astype(dt)
+
+    def _decode_layer(self, layer_p, x, positions, ck, cv, cache_pos, attn_len):
+        """One decoder layer with KV-cache attention: returns the residual
+        stream and this layer's updated cache. Shared by the stacked-scan
+        decode (_decode) and the unstacked list decode."""
+        attn_out, (nk, nv) = self._attention(
+            layer_p, x, positions, kv_cache=(ck, cv, cache_pos),
+            attn_len=attn_len,
+        )
+        x = x + attn_out
+        ffn_out, _ = self._ffn(layer_p, x)
+        return x + ffn_out, nk, nv
+
+    def _decode_head(self, params, x):
+        """Final norm + unembed of the last-position residual stream."""
+        import jax.numpy as jnp
 
         cfg = self.cfg
         dt = jnp.dtype(cfg.dtype)
-        x = params["embed"][tokens.astype(jnp.int32)].astype(dt)  # [B,1,D]
+        x = _rms_norm(x, params["ln_f"].astype(dt), cfg.norm_eps)
+        return (x[:, 0] @ params["unembed"].astype(dt)).astype(jnp.float32)
+
+    def _decode(self, params, cache, tokens, positions, cache_pos, attn_len=None):
+        """Shared decode-step pipeline: embed -> scan blocks with KV-cache
+        attention -> final norm -> unembed. ``positions`` is [B] int32;
+        ``cache_pos`` is a scalar (aligned batch) or [B] (ragged batch) —
+        ``_attention`` branches on its rank for the K/V write + mask.
+        ``attn_len`` (static int, optional) bounds the cache READ length."""
+        from jax import lax
+
+        x = self._embed_tokens(params, tokens)  # [B,1,D]
 
         def body(x, inputs):
             layer_p, ck, cv = inputs
-            attn_out, new_cache = self._attention(
-                layer_p, x, positions, kv_cache=(ck, cv, cache_pos)
+            x, nk, nv = self._decode_layer(
+                layer_p, x, positions, ck, cv, cache_pos, attn_len
             )
-            x = x + attn_out
-            ffn_out, _ = self._ffn(layer_p, x)
-            return x + ffn_out, new_cache
+            return x, (nk, nv)
 
         x, (nk, nv) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
-        x = _rms_norm(x, params["ln_f"].astype(dt), cfg.norm_eps)
-        logits = (x[:, 0] @ params["unembed"].astype(dt)).astype(jnp.float32)
-        return logits, {"k": nk, "v": nv}
+        return self._decode_head(params, x), {"k": nk, "v": nv}
 
     def decode_step(self, params, cache, tokens, pos):
         """One decode step: tokens [B, 1], pos scalar int. Returns
@@ -342,18 +384,52 @@ class DecoderLM(ServedModel):
         positions = jnp.full((tokens.shape[0],), pos, jnp.int32)
         return self._decode(params, cache, tokens, positions, pos)
 
-    def decode_step_ragged(self, params, cache, tokens, pos):
+    def decode_step_ragged(self, params, cache, tokens, pos, attn_len=None):
         """One decode step over a RAGGED batch: tokens [B, 1], pos [B]
         int32 — every row sits at its own position (continuous batching:
         requests admitted mid-flight decode side-by-side with older ones).
         K/V land via a per-row scatter; attention masks each row to its
         own prefix. Static shapes throughout, so one XLA executable serves
         every mix of in-flight requests. Returns (logits [B, V], cache).
+
+        ``attn_len`` (static int): upper bound on every row's position + 1;
+        the attention read stops there (decode is cache-bandwidth-bound,
+        so a tight bucket ~halves step time mid-generation).
         """
         import jax.numpy as jnp
 
         pos = pos.astype(jnp.int32)
-        return self._decode(params, cache, tokens, pos, pos)
+        return self._decode(params, cache, tokens, pos, pos, attn_len=attn_len)
+
+    def decode_step_ragged_list(self, params, ks, vs, tokens, pos, attn_len=None):
+        """Ragged decode step over an UNSTACKED cache: ``ks``/``vs`` are
+        per-layer lists of [B, KV, T, Dh] arrays. Returns
+        ``(logits [B, V], new_ks, new_vs)``.
+
+        Why a second layout: the stacked [L, ...] cache flowing through the
+        layer scan as xs/ys makes XLA rewrite the whole cache every step —
+        decode cost then scales with TOTAL cache bytes, not the attended
+        prefix (measured ~2.5x step-time on a v5e). With per-layer arrays
+        carried through the caller's step loop, the only cache write is the
+        one-position scatter, in place. The continuous batcher
+        (serving/continuous.py) keeps its persistent cache in this layout.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        pos = pos.astype(jnp.int32)
+        x = self._embed_tokens(params, tokens)  # [B,1,D]
+        blocks = params["blocks"]
+        nks: list = []
+        nvs: list = []
+        for l in range(len(ks)):
+            layer_p = jax.tree_util.tree_map(lambda a, l=l: a[l], blocks)
+            x, nk, nv = self._decode_layer(
+                layer_p, x, pos, ks[l], vs[l], pos, attn_len
+            )
+            nks.append(nk)
+            nvs.append(nv)
+        return self._decode_head(params, x), nks, nvs
 
     def prefill(self, params, prompt, max_seq: int, last_index=None):
         """Batched prefill: ONE forward over the whole prompt, K/V for all
@@ -391,9 +467,12 @@ class DecoderLM(ServedModel):
                 rep = Hl // KVl
                 kr = jnp.repeat(k, rep, axis=1)
                 vr = jnp.repeat(v, rep, axis=1)
-            from ..parallel.ring import full_attention
+            # flash (pallas) on TPU for MXU-tileable prompt lengths; XLA
+            # einsum fallback elsewhere. Prefill is inference-only, so the
+            # kernel needs no VJP (training keeps parallel/ring.py paths).
+            from ..ops import attention as prefill_attention
 
-            o = full_attention(q, kr, vr, causal=True)
+            o = prefill_attention(q, kr, vr, causal=True)
             o = o.transpose(0, 2, 1, 3).reshape(B, Tp, Hl * cfg.head_dim)
             x = x + o @ layer_p["wo"].astype(dt)
             ffn_out, _ = self._ffn(layer_p, x)

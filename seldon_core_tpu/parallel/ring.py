@@ -94,13 +94,16 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
     return out.astype(q.dtype)
 
 
-def full_attention(q, k, v, causal: bool = True):
-    """Single-chip reference attention (same signature minus the ring)."""
+def full_attention(q, k, v, causal: bool = True, kv_len=None):
+    """Single-chip reference attention (same signature minus the ring).
+    ``kv_len`` (scalar, optional) masks key positions >= kv_len."""
     scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    t_q, t_k = q.shape[2], k.shape[2]
     if causal:
-        t_q, t_k = q.shape[2], k.shape[2]
         mask = jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]
         s = jnp.where(mask[None, None], s, -1e30)
+    if kv_len is not None:
+        s = jnp.where(jnp.arange(t_k)[None, None, None, :] < kv_len, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -11,6 +11,9 @@ Model URI layout: same ``jax_config.json`` as jaxserver with
     slots            decode lanes (default 8)
     max_seq          cache length override
     shard_cache_seq  shard the KV cache length over the mesh's `seq` axis
+    steps_per_poll   decode steps fused into one device burst (default 8)
+    pipeline_depth   bursts in flight before the host reads the oldest
+                     (default 3; 1 = synchronous)
 
 Request (jsonData)::
 
@@ -45,6 +48,7 @@ class GenerateServer(SeldonComponent):
         max_seq: Optional[int] = None,
         shard_cache_seq: bool = False,
         steps_per_poll: int = 8,
+        pipeline_depth: int = 3,
         **kwargs,
     ):
         self.model_uri = model_uri
@@ -55,6 +59,7 @@ class GenerateServer(SeldonComponent):
             shard_cache_seq, str
         ) else shard_cache_seq.lower() == "true"
         self._steps_per_poll = int(steps_per_poll)
+        self._pipeline_depth = int(pipeline_depth)
         self._extra = kwargs
         self.batcher = None
         self._model = None
@@ -78,6 +83,7 @@ class GenerateServer(SeldonComponent):
             mesh=self._mesh,
             shard_cache_seq=self._shard_cache_seq,
             steps_per_poll=self._steps_per_poll,
+            pipeline_depth=self._pipeline_depth,
         )
         self.batcher.start()
         logger.info(

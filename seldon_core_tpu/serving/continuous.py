@@ -19,6 +19,21 @@ calls"). This scheduler closes that gap the TPU way:
   device call (``lax.scan`` over the fused step), so the host syncs once
   per burst — not once per token. Dispatch/sync latency is the decode
   bottleneck off-device; this amortises it k-fold.
+* Bursts are **software-pipelined** (``pipeline_depth``): the scheduler
+  dispatches burst N+1 (and starts its device→host token copy with
+  ``copy_to_host_async``) before reading burst N's tokens, so the device
+  never idles waiting on the host sync round-trip. Decode state lives on
+  device across bursts, so correctness only needs the host to *observe*
+  tokens late: each dispatch snapshots which request occupied each lane,
+  and tokens from a burst are credited strictly to that snapshot (a lane
+  that finished mid-pipeline just decodes a few ignored tokens before the
+  host notices and re-admits).
+* The KV cache is held as per-layer arrays and updated IN PLACE: only the
+  one-position scatter touches HBM per step (a stacked cache threaded
+  through the layer scan made XLA rewrite every byte of it every step).
+  The attention READ is bounded by a static bucket covering the deepest
+  lane's position (host-tracked, no sync) — decode cost follows the live
+  prefix, not the allocated cache.
 * With a mesh, params/cache shard over the ``model`` axis (KV heads) and
   optionally the ``seq`` axis (cache length) — long prompts span ICI.
 
@@ -77,6 +92,7 @@ class ContinuousBatcher:
         shard_cache_seq: bool = False,
         prefill_buckets: Sequence[int] = (32, 128, 512),
         steps_per_poll: int = 8,
+        pipeline_depth: int = 3,
     ):
         import jax
         import jax.numpy as jnp
@@ -87,12 +103,26 @@ class ContinuousBatcher:
         self.max_seq = int(max_seq or model.cfg.max_seq)
         self.mesh = mesh
         self.steps_per_poll = int(steps_per_poll)
+        # how many bursts may be in flight before the host reads the oldest
+        # one's tokens; 1 = fully synchronous (dispatch, read, dispatch ...)
+        self.pipeline_depth = max(1, int(pipeline_depth))
         self.prefill_buckets = tuple(
             sorted(b for b in prefill_buckets if b <= self.max_seq)
         ) or (self.max_seq,)
 
         self._queue: "queue.Queue[GenRequest]" = queue.Queue()
         self._active: Dict[int, _Slot] = {}
+        # device copies of the lane masks; re-uploaded only when lane
+        # membership changes (every host->device transfer pays the
+        # dispatch-latency tax, so the steady-state loop must not upload
+        # anything per burst)
+        self._masks_dirty = True
+        self._active_dev = None
+        self._temps_dev = None
+        # host mirror of each lane's device position (prompt length at
+        # admit, +k per dispatched burst) — lets the scheduler pick the
+        # attention-read bucket WITHOUT a device sync
+        self._pos_host: Dict[int, int] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._thread_lock = threading.Lock()
@@ -100,6 +130,12 @@ class ContinuousBatcher:
         self.stats = {"admitted": 0, "finished": 0, "steps": 0, "tokens": 0}
 
         # -- device state ----------------------------------------------------
+        # The persistent KV cache lives UNSTACKED: per-layer [S, KV, T, Dh]
+        # arrays. A stacked [L, ...] cache threaded through the layer scan
+        # as xs/ys makes XLA rewrite every layer's cache every step (cost
+        # scales with total cache bytes); per-layer arrays carried through
+        # the burst scan update in place — only the one-position scatter
+        # touches HBM (see DecoderLM.decode_step_ragged_list).
         cache_sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -111,13 +147,20 @@ class ContinuousBatcher:
                 if shard_cache_seq and "seq" in mesh.axis_names and mesh.shape["seq"] > 1
                 else None
             )
-            # cache [L, S, KV, T, Dh]: KV heads over `model` (tp), cache
-            # length over `seq` (long context spans ICI)
-            cache_sharding = NamedSharding(mesh, P(None, None, model_ax, seq_ax, None))
+            # per-layer cache [S, KV, T, Dh]: KV heads over `model` (tp),
+            # cache length over `seq` (long context spans ICI)
+            cache_sharding = NamedSharding(mesh, P(None, model_ax, seq_ax, None))
         self.params = params
-        cache = model.init_cache(self.slots, self.max_seq)
+        stacked = model.init_cache(self.slots, self.max_seq)
+        n_layers = stacked["k"].shape[0]
+        cache = {
+            "k": [stacked["k"][l] for l in range(n_layers)],
+            "v": [stacked["v"][l] for l in range(n_layers)],
+        }
         if cache_sharding is not None:
-            cache = jax.device_put(cache, {"k": cache_sharding, "v": cache_sharding})
+            cache = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, cache_sharding), cache
+            )
         self._cache = cache
         self._cur_tok = jnp.zeros((self.slots,), jnp.int32)
         self._pos = jnp.zeros((self.slots,), jnp.int32)
@@ -128,8 +171,10 @@ class ContinuousBatcher:
 
         # -- executables -----------------------------------------------------
 
-        def fused_step(params, cache, cur_tok, pos, active, temps, keys):
-            logits, cache = model.decode_step_ragged(params, cache, cur_tok[:, None], pos)
+        def fused_step(params, ks, vs, cur_tok, pos, active, temps, keys, attn_len):
+            logits, ks, vs = model.decode_step_ragged_list(
+                params, ks, vs, cur_tok[:, None], pos, attn_len=attn_len
+            )
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             split = jax.vmap(jax.random.split)(keys)  # [S, 2, key]
             keys, subs = split[:, 0], split[:, 1]
@@ -139,12 +184,19 @@ class ContinuousBatcher:
             nxt = jnp.where(temps > 0, sampled, greedy)
             nxt = jnp.where(active, nxt, 0)
             pos = jnp.where(active, pos + 1, pos)
-            return nxt, pos, cache, keys
+            return nxt, pos, ks, vs, keys
 
         def insert(cache, cache_one, slot, first_tok, first_pos, lane_key, cur_tok, pos, keys):
+            # cache_one is the prefill's stacked [L, 1, KV, Tb, Dh] slab;
+            # each layer's slice lands in that layer's cache at `slot`
             new = {
-                k: lax.dynamic_update_slice(cache[k], cache_one[k], (0, slot, 0, 0, 0))
-                for k in ("k", "v")
+                name: [
+                    lax.dynamic_update_slice(
+                        layer, cache_one[name][l], (slot, 0, 0, 0)
+                    )
+                    for l, layer in enumerate(cache[name])
+                ]
+                for name in ("k", "v")
             }
             cur_tok = cur_tok.at[slot].set(first_tok)
             pos = pos.at[slot].set(first_pos)
@@ -152,8 +204,11 @@ class ContinuousBatcher:
             return new, cur_tok, pos, keys
 
         def prefill_one(params, prompt, last_index, seed, temp):
+            # cache_one spans only the prompt bucket — decode writes extend
+            # it in place, so inserting a full max_seq slab per admission
+            # would just copy zeros over HBM
             logits, cache_one = model.prefill(
-                params, prompt, self.max_seq, last_index=last_index
+                params, prompt, prompt.shape[1], last_index=last_index
             )
             key = jax.random.PRNGKey(seed)
             key, sub = jax.random.split(key)
@@ -164,26 +219,31 @@ class ContinuousBatcher:
             first = jnp.where(temp > 0, sampled, greedy)
             return first, cache_one, key
 
-        def fused_burst(params, cache, cur_tok, pos, active, temps, keys, k):
+        def fused_burst(params, cache, cur_tok, pos, active, temps, keys, k, attn_len):
             """k fused decode steps as one executable; returns [k, slots]
-            tokens so the host syncs once per burst."""
+            tokens so the host syncs once per burst. ``attn_len`` (static)
+            bounds the cache read — the scheduler picks a bucket >= every
+            lane's end-of-burst position, so one executable exists per
+            (k, bucket) pair and the read narrows to live prefix."""
 
             def body(carry, _):
-                cache, cur_tok, pos, keys = carry
-                nxt, pos, cache, keys = fused_step(
-                    params, cache, cur_tok, pos, active, temps, keys
+                ks, vs, cur_tok, pos, keys = carry
+                nxt, pos, ks, vs, keys = fused_step(
+                    params, ks, vs, cur_tok, pos, active, temps, keys, attn_len
                 )
-                return (cache, nxt, pos, keys), nxt
+                return (ks, vs, nxt, pos, keys), nxt
 
-            (cache, cur_tok_out, pos, keys), toks = lax.scan(
-                body, (cache, cur_tok, pos, keys), None, length=k
+            (ks, vs, cur_tok_out, pos, keys), toks = lax.scan(
+                body, (cache["k"], cache["v"], cur_tok, pos, keys), None, length=k
             )
             # row 0 = the tokens the burst STARTED from (deferred prefill
             # firsts ride home with the burst's one sync)
             toks = jnp.concatenate([cur_tok[None, :], toks], axis=0)
-            return toks, cur_tok_out, pos, cache, keys
+            return toks, cur_tok_out, pos, {"k": ks, "v": vs}, keys
 
-        self._burst_fn = jax.jit(fused_burst, donate_argnums=(1,), static_argnums=(7,))
+        self._burst_fn = jax.jit(
+            fused_burst, donate_argnums=(1,), static_argnums=(7, 8)
+        )
         self._insert_fn = jax.jit(insert, donate_argnums=(0,))
         self._prefill_fn = jax.jit(prefill_one)
 
@@ -282,11 +342,15 @@ class ContinuousBatcher:
         # no host read here: prefill + insert stay fully async; the first
         # token reaches the host with the next burst's sync
         self._active[slot] = _Slot(request=req)
+        self._pos_host[slot] = n
+        self._masks_dirty = True
         self.stats["admitted"] += 1
 
     def _finish(self, slot: int) -> None:
         # a trailing eos token is kept in the output, like HF generate
         s = self._active.pop(slot)
+        self._pos_host.pop(slot, None)
+        self._masks_dirty = True
         if not s.request.future.done():
             s.request.future.set_result(s.request.tokens + s.emitted)
         self.stats["finished"] += 1
@@ -300,11 +364,36 @@ class ContinuousBatcher:
             ):
                 self._finish(slot)
 
+    def _process_burst(self, toks_dev, snapshot) -> None:
+        """Credit one burst's tokens to the requests that occupied each lane
+        AT DISPATCH TIME. A lane whose request already finished (and was
+        possibly re-admitted) mid-pipeline is skipped via identity check —
+        its rows are overshoot decode, dropped by design."""
+        host_toks = np.asarray(toks_dev)  # the burst's one host sync
+        for slot, (s, start) in snapshot.items():
+            if self._active.get(slot) is not s:
+                continue
+            req = s.request
+            for t in host_toks[start:, slot]:
+                s.emitted.append(int(t))
+                self.stats["tokens"] += 1
+                if len(s.emitted) >= req.max_new_tokens or (
+                    req.eos_id is not None and int(t) == req.eos_id
+                ):
+                    # tokens decoded past eos in this burst are dropped
+                    # here; the lane is reclaimed by _check_done
+                    break
+        self._check_done()
+
     def _loop(self) -> None:
+        import collections
+
         import jax.numpy as jnp
 
         self._started.set()
         temps = np.zeros((self.slots,), np.float32)
+        # in-flight bursts, oldest first: (device tokens, lane snapshot)
+        pending: "collections.deque" = collections.deque()
         try:
             while not self._stop.is_set():
                 # admit as many queued requests as there are free slots
@@ -320,55 +409,72 @@ class ContinuousBatcher:
                         logger.exception("admit failed")
                         if not req.future.done():
                             req.future.set_exception(e)
-                if not self._active:
+                if not self._active and not pending:
                     try:
                         req = self._queue.get(timeout=0.05)
                     except queue.Empty:
                         continue
                     self._queue.put(req)
                     continue
-                for i in range(self.slots):
-                    temps[i] = (
-                        self._active[i].request.temperature if i in self._active else 0.0
+                if self._active:
+                    if self._masks_dirty:
+                        for i in range(self.slots):
+                            temps[i] = (
+                                self._active[i].request.temperature
+                                if i in self._active
+                                else 0.0
+                            )
+                        active = np.zeros((self.slots,), bool)
+                        for i in self._active:
+                            active[i] = True
+                        self._active_dev = jnp.asarray(active)
+                        self._temps_dev = jnp.asarray(temps)
+                        self._masks_dirty = False
+                    active_dev = self._active_dev
+                    temps_dev = self._temps_dev
+                    # one fused burst of k steps = ONE device call + ONE host
+                    # sync. k is FIXED at steps_per_poll (one compiled variant):
+                    # lanes that hit max_new_tokens or eos mid-burst simply have
+                    # their overshoot tokens dropped by _process_burst —
+                    # clamping k to the tightest remaining budget (the previous
+                    # design) made staggered requests force tiny bursts on every
+                    # lane, paying the sync RTT per token near each completion
+                    k = max(1, self.steps_per_poll)
+                    while k & (k - 1):  # pow2 guard for odd configs
+                        k &= k - 1
+                    # attention-read bucket: the smallest 128-multiple that
+                    # covers every active lane's end-of-burst position
+                    # (host-tracked, no sync). One executable per bucket.
+                    hi = max(self._pos_host[i] for i in self._active) + k
+                    attn_len = min(self.max_seq, -(-hi // 128) * 128)
+                    # snapshot BEFORE dispatch: tokens of this burst belong to
+                    # these occupants, whatever the host learns later
+                    snapshot = {}
+                    for slot, s in self._active.items():
+                        snapshot[slot] = (s, 0 if s.first_pending else 1)
+                        s.first_pending = False
+                        self._pos_host[slot] += k
+                    toks, self._cur_tok, self._pos, self._cache, self._keys = (
+                        self._burst_fn(
+                            self.params, self._cache, self._cur_tok, self._pos,
+                            active_dev, temps_dev, self._keys, k, attn_len,
+                        )
                     )
-                active = np.zeros((self.slots,), bool)
-                for i in self._active:
-                    active[i] = True
-                active_dev = jnp.asarray(active)
-                temps_dev = jnp.asarray(temps)
-                # one fused burst of k steps = ONE device call + ONE host
-                # sync. k is FIXED at steps_per_poll (one compiled variant):
-                # lanes that hit max_new_tokens or eos mid-burst simply have
-                # their overshoot tokens dropped by the append loop below —
-                # clamping k to the tightest remaining budget (the previous
-                # design) made staggered requests force tiny bursts on every
-                # lane, paying the sync RTT per token near each completion
-                k = max(1, self.steps_per_poll)
-                while k & (k - 1):  # pow2 guard for odd configs
-                    k &= k - 1
-                toks, self._cur_tok, self._pos, self._cache, self._keys = (
-                    self._burst_fn(
-                        self.params, self._cache, self._cur_tok, self._pos,
-                        active_dev, temps_dev, self._keys, k,
-                    )
-                )
-                self.stats["steps"] += k
-                # [k+1, slots]; row 0 = burst-start tokens — the one sync
-                host_toks = np.asarray(toks)
-                for slot, s in self._active.items():
-                    req = s.request
-                    start = 0 if s.first_pending else 1
-                    s.first_pending = False
-                    for t in host_toks[start:, slot]:
-                        s.emitted.append(int(t))
-                        self.stats["tokens"] += 1
-                        if len(s.emitted) >= req.max_new_tokens or (
-                            req.eos_id is not None and int(t) == req.eos_id
-                        ):
-                            # tokens decoded past eos in this burst are
-                            # dropped here; the lane is reclaimed below
-                            break
-                self._check_done()
+                    self.stats["steps"] += k
+                    # start the device->host token copy NOW; by the time the
+                    # host reads this burst (pipeline_depth dispatches later)
+                    # the transfer has usually landed and asarray is free
+                    try:
+                        toks.copy_to_host_async()
+                    except AttributeError:  # non-jax array (test doubles)
+                        pass
+                    pending.append((toks, snapshot))
+                # read the oldest burst once the pipeline is full — or drain
+                # fully when there is nothing left to dispatch
+                while pending and (
+                    len(pending) >= self.pipeline_depth or not self._active
+                ):
+                    self._process_burst(*pending.popleft())
         except Exception:  # noqa: BLE001 - surface scheduler death to callers
             logger.exception("continuous batcher loop died")
             # poison the batcher: the donated cache buffers are gone, a

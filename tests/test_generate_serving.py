@@ -164,6 +164,59 @@ def test_seed_reproducible_across_cotenants(model_and_params):
         b.close()
 
 
+def test_pipeline_depths_equivalent(model_and_params):
+    """Software-pipelined bursts (depth>1) must emit exactly the tokens of
+    the synchronous scheduler (depth=1) under heavy churn: more requests
+    than slots, staggered submission, early EOS, mixed lengths."""
+    import time
+
+    model, params = model_and_params
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, 256, n).tolist() for n in (3, 9, 5, 14, 4, 6, 11, 2)]
+    kws = [
+        dict(max_new_tokens=m, eos_id=e)
+        for m, e in ((7, None), (3, None), (12, None), (5, None),
+                     (9, None), (2, None), (6, None), (10, None))
+    ]
+    results = {}
+    for depth in (1, 4):
+        b = ContinuousBatcher(
+            model, params, slots=3, max_seq=64, prefill_buckets=(8, 16),
+            steps_per_poll=2, pipeline_depth=depth,
+        )
+        try:
+            futures = []
+            for i, (p, kw) in enumerate(zip(prompts, kws)):
+                futures.append(b.submit(p, **kw))
+                if i % 3 == 2:
+                    time.sleep(0.05)  # stagger admissions mid-decode
+            results[depth] = [f.result(timeout=120) for f in futures]
+            assert b.stats["finished"] == len(prompts)
+        finally:
+            b.close()
+    assert results[1] == results[4]
+
+
+def test_eos_equivalent_across_depths(model_and_params):
+    """EOS mid-pipeline: the lane keeps decoding until the host notices —
+    the OUTPUT must still stop exactly at eos."""
+    model, params = model_and_params
+    outs = {}
+    for depth in (1, 3):
+        b = ContinuousBatcher(
+            model, params, slots=2, max_seq=64, prefill_buckets=(8,),
+            steps_per_poll=2, pipeline_depth=depth,
+        )
+        try:
+            prompt = [3, 17, 42]
+            full = b.generate(prompt, max_new_tokens=20)
+            eos = full[len(prompt) + 3]
+            outs[depth] = b.generate(prompt, max_new_tokens=20, eos_id=eos)
+        finally:
+            b.close()
+    assert outs[1] == outs[3]
+
+
 def test_submit_after_close_raises(model_and_params):
     model, params = model_and_params
     b = ContinuousBatcher(model, params, slots=2, max_seq=64, prefill_buckets=(8,))
@@ -204,10 +257,8 @@ def test_mesh_sharded_cache(model_and_params):
         )[0].tolist()
         got = b.generate(prompt, max_new_tokens=8)
         assert got == expected
-        # cache really is sharded over the mesh
-        shard_axes = {
-            s.sharding.spec for s in [b._cache["k"]]
-        }
+        # cache really is sharded over the mesh (per-layer entries)
+        shard_axes = {layer.sharding.spec for layer in b._cache["k"]}
         assert any(ax is not None for spec in shard_axes for ax in spec)
     finally:
         b.close()
@@ -300,7 +351,7 @@ def test_long_prompt_spans_seq_shards(model_and_params):
         got = b.generate(prompt, max_new_tokens=12)
         assert got == expected
         # cache shards over BOTH the model (KV heads) and seq (length) axes
-        spec = b._cache["k"].sharding.spec
+        spec = b._cache["k"][0].sharding.spec
         assert "model" in spec and "seq" in spec
     finally:
         b.close()
