@@ -310,8 +310,15 @@ class JAXComponent(SeldonComponent):
     def predict(self, X, names, meta=None):
         if self._apply is None:
             self.load()
-        import jax
-
         if isinstance(X, np.ndarray):
             X = self._to_dev(X)
-        return jax.block_until_ready(self._apply(self.params, X))
+        out = self._apply(self.params, X)
+        # start the device->host copy NOW instead of blocking: XLA dispatch
+        # is async, so the transfer overlaps response bookkeeping and the
+        # serializer's np.asarray finds it (mostly) landed. Errors surface
+        # there too — same failure path, one less device sync.
+        try:
+            out.copy_to_host_async()
+        except AttributeError:  # non-jax outputs (user models returning np)
+            pass
+        return out
