@@ -33,6 +33,8 @@ def test_flops_analytics_sane():
 
 def test_model_tier_tiny_end_to_end():
     results = modelbench.run_model_tier(seconds=1.5, tiny=True)
+    # llm_generate_long is chip-only (same harness as llm_generate; the
+    # tiny tier proves the harness once)
     for key in ("resnet50_rest", "bert_grpc", "llm_generate"):
         stats = results[key]
         assert stats["requests"] > 0, key
