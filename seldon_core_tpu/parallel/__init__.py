@@ -16,5 +16,10 @@ Axes (by convention):
   expert parallelism rides the combined (data, seq) axes via all_to_all.
 """
 
-from .mesh import factor_devices, make_mesh  # noqa: F401
+from .mesh import (  # noqa: F401
+    factor_devices,
+    initialize_distributed,
+    make_hybrid_mesh,
+    make_mesh,
+)
 from .ring import ring_attention  # noqa: F401

@@ -1,7 +1,18 @@
-"""Mesh construction helpers."""
+"""Mesh construction helpers — single-chip through multi-host.
+
+Multi-host model (SURVEY §5 "distributed communication backend"): the
+reference's NCCL/MPI analogue is the JAX runtime itself — every host
+runs the same program, ``initialize_distributed()`` wires the hosts into
+one runtime (GCE metadata autodetect on TPU pods, explicit
+coordinator/process env elsewhere), and ``jax.devices()`` then spans the
+pod. Collectives ride ICI inside a slice and DCN between slices; the
+mesh-building helpers put DCN-crossing axes (data, stage) on the outer
+dimensions so tp/sp traffic never leaves a slice
+(``make_hybrid_mesh``)."""
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Optional, Sequence
 
 
@@ -45,3 +56,93 @@ def make_mesh(shape: Dict[str, int], devices=None):
         raise ValueError(f"mesh {shape} needs {total} devices, have {len(devices)}")
     arr = np.asarray(devices[:total]).reshape(tuple(shape.values()))
     return jax.sharding.Mesh(arr, tuple(shape.keys()))
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join this host into a multi-host JAX runtime.
+
+    On TPU pods ``jax.distributed.initialize()`` autodetects everything
+    from the metadata server; elsewhere pass the coordinator explicitly
+    or set ``SELDON_TPU_COORDINATOR`` / ``SELDON_TPU_NUM_PROCESSES`` /
+    ``SELDON_TPU_PROCESS_ID``. Idempotent: returns False when the
+    runtime is already initialized or when running single-process with
+    no coordinator configured (the common dev/test case).
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "SELDON_TPU_COORDINATOR"
+    )
+    if num_processes is None and "SELDON_TPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["SELDON_TPU_NUM_PROCESSES"])
+    if process_id is None and "SELDON_TPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["SELDON_TPU_PROCESS_ID"])
+    # decide the pod case from env alone — touching jax.default_backend()
+    # here would initialize the XLA backends, after which
+    # jax.distributed.initialize() refuses to run at all
+    on_tpu_pod = bool(
+        os.environ.get("TPU_WORKER_HOSTNAMES")
+        or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+    )
+    if coordinator_address is None and not on_tpu_pod:
+        return False
+    if getattr(jax.distributed, "is_initialized", lambda: False)():
+        return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return True
+    except RuntimeError as e:  # raced another initializer
+        msg = str(e).lower()
+        if "already" in msg or "only be called once" in msg:
+            return False
+        raise
+
+
+def make_hybrid_mesh(
+    ici_shape: Dict[str, int],
+    dcn_shape: Optional[Dict[str, int]] = None,
+    devices=None,
+):
+    """Mesh spanning slices/hosts: ``dcn_shape`` axes (typically data
+    and/or stage — gradient/activation hops that tolerate DCN latency)
+    partition BETWEEN slices, ``ici_shape`` axes (model/seq — latency-
+    critical tp/sp collectives) partition WITHIN a slice.
+
+    Falls back to a flat :func:`make_mesh` when there is a single slice
+    (or no slice topology, e.g. the CPU test mesh) — same axis names, so
+    callers never branch.
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    dcn_shape = dict(dcn_shape or {})
+    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    dcn_total = 1
+    for s in dcn_shape.values():
+        dcn_total *= s
+    if n_slices <= 1 or dcn_total <= 1:
+        merged = {**dcn_shape, **ici_shape}
+        for ax, size in dcn_shape.items():
+            if ax in ici_shape:
+                merged[ax] = ici_shape[ax] * size
+        return make_mesh(merged, devices=devices)
+    from jax.experimental import mesh_utils
+
+    axis_names = list(dcn_shape.keys()) + [
+        ax for ax in ici_shape if ax not in dcn_shape
+    ]
+    per_slice = [ici_shape.get(ax, 1) for ax in axis_names]
+    across = [dcn_shape.get(ax, 1) for ax in axis_names]
+    arr = mesh_utils.create_hybrid_device_mesh(
+        per_slice, across, devices=devices
+    )
+    return jax.sharding.Mesh(arr, tuple(axis_names))

@@ -217,6 +217,29 @@ def test_eos_equivalent_across_depths(model_and_params):
     assert outs[1] == outs[3]
 
 
+def test_moe_model_through_batcher(model_and_params):
+    """A mixture-of-experts DecoderLM decodes through the scheduler's
+    list-cache path identically to the model's own generate()."""
+    import jax.numpy as jnp
+
+    model = DecoderLM(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=2, n_kv_heads=2,
+        d_ff=64, max_seq=64, n_experts=4, dtype="float32",
+    )
+    params = model.init_params(0)
+    b = ContinuousBatcher(
+        model, params, slots=2, max_seq=64, prefill_buckets=(8,), steps_per_poll=4
+    )
+    try:
+        got = b.generate([3, 5, 7], max_new_tokens=6)
+        exp = np.asarray(
+            model.generate(params, jnp.asarray([[3, 5, 7]], jnp.int32), 6)
+        )[0].tolist()
+        assert got == exp
+    finally:
+        b.close()
+
+
 def test_submit_after_close_raises(model_and_params):
     model, params = model_and_params
     b = ContinuousBatcher(model, params, slots=2, max_seq=64, prefill_buckets=(8,))

@@ -26,6 +26,29 @@ def test_make_mesh_8_devices():
     assert mesh.shape == {"data": 2, "seq": 2, "model": 2}
 
 
+def test_hybrid_mesh_single_slice_fallback():
+    """On a single slice (the CPU mesh) the hybrid mesh degrades to a flat
+    mesh with merged axis sizes — callers never branch on topology."""
+    from seldon_core_tpu.parallel import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh({"model": 2, "seq": 2}, {"data": 2})
+    assert mesh.shape == {"data": 2, "model": 2, "seq": 2}
+    # a dcn axis that also exists in ici merges multiplicatively
+    mesh2 = make_hybrid_mesh({"data": 2, "model": 2}, {"data": 2})
+    assert mesh2.shape == {"data": 4, "model": 2}
+    # shardings built on the hybrid mesh work end-to-end
+    x = jnp.arange(16.0).reshape(8, 2)
+    s = jax.device_put(x, NamedSharding(mesh, P(("data", "seq"), None)))
+    assert np.allclose(np.asarray(jnp.sum(s, 0)), np.asarray(x.sum(0)))
+
+
+def test_initialize_distributed_noop_single_process():
+    """Without a coordinator (dev/test), initialize is a clean no-op."""
+    from seldon_core_tpu.parallel import initialize_distributed
+
+    assert initialize_distributed() is False
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_matches_full(causal):
     """Ring attention over seq=4 ring == single-chip attention."""
