@@ -83,10 +83,14 @@ def initialize_distributed(
         process_id = int(os.environ["SELDON_TPU_PROCESS_ID"])
     # decide the pod case from env alone — touching jax.default_backend()
     # here would initialize the XLA backends, after which
-    # jax.distributed.initialize() refuses to run at all
-    on_tpu_pod = bool(
-        os.environ.get("TPU_WORKER_HOSTNAMES")
-        or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+    # jax.distributed.initialize() refuses to run at all. A single-entry
+    # TPU_WORKER_HOSTNAMES (e.g. "localhost" on a one-host slice) is not
+    # a pod.
+    workers = [
+        w for w in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if w
+    ]
+    on_tpu_pod = len(workers) > 1 or bool(
+        os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
     )
     if coordinator_address is None and not on_tpu_pod:
         return False
@@ -99,9 +103,16 @@ def initialize_distributed(
             process_id=process_id,
         )
         return True
-    except RuntimeError as e:  # raced another initializer
+    except RuntimeError as e:
         msg = str(e).lower()
-        if "already" in msg or "only be called once" in msg:
+        # raced another initializer, or the XLA backends were already up
+        # (too late to join this process into a pod — a best-effort no-op,
+        # matching the documented idempotent contract)
+        if (
+            "already" in msg
+            or "only be called once" in msg
+            or "must be called before" in msg
+        ):
             return False
         raise
 
