@@ -201,8 +201,7 @@ def closed_loop(
         t.join(timeout=seconds + 120.0)
     elapsed = time.perf_counter() - t_start
 
-    lat = np.sort(np.asarray(latencies, dtype=np.float64))
-    n = len(lat)
+    n = len(latencies)
     if n == 0:
         raise RuntimeError(
             f"benchmark produced no completed requests ({errors[0]} errors)"
@@ -216,9 +215,7 @@ def closed_loop(
         "requests": n,
         "req_per_s": round(n / elapsed, 2),
         "rows_per_s": round(rows_total[0] / elapsed, 2),
-        "p50_ms": round(float(lat[n // 2]) * 1e3, 3),
-        "p99_ms": round(float(lat[min(n - 1, int(n * 0.99))]) * 1e3, 3),
-        "mean_ms": round(float(lat.mean()) * 1e3, 3),
+        **_lat_summary(latencies),
         "concurrency": concurrency,
         "seconds": round(elapsed, 2),
     }
@@ -228,6 +225,17 @@ def _mfu(rows_per_s: float, flops_per_row: Optional[float], peak: Optional[float
     if not flops_per_row or not peak:
         return None
     return round(100.0 * rows_per_s * flops_per_row / peak, 2)
+
+
+def _lat_summary(latencies: List[float]) -> Dict[str, float]:
+    """p50/p99/mean (ms) with one percentile convention for every bench."""
+    lat = np.sort(np.asarray(latencies, dtype=np.float64))
+    n = len(lat)
+    return {
+        "p50_ms": round(float(lat[n // 2]) * 1e3, 3),
+        "p99_ms": round(float(lat[min(n - 1, int(n * 0.99))]) * 1e3, 3),
+        "mean_ms": round(float(lat.mean()) * 1e3, 3),
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -329,6 +337,77 @@ def bench_resnet50_rest(
         }
     )
     return stats
+
+
+def bench_resnet50_device(
+    root: str,
+    seconds: float = 8.0,
+    batch: int = 128,
+    image_size: int = 224,
+    depth: int = 4,
+    peak: Optional[float] = None,
+    config: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """ResNet-50 forwards with device-resident input: the model/XLA tier
+    WITHOUT transport. Published next to resnet50_rest so the wire cost
+    is visible — on hosts where the chip sits behind a slow link (or any
+    deployment moving raw uint8 images), rest throughput is input-
+    bandwidth-bound while this number shows what the serving runtime
+    sustains once tensors are in HBM."""
+    import collections
+
+    import jax
+
+    from .servers.jaxserver import JAXServer
+
+    model_dir = write_model_dir(
+        root, "resnet50", {"image_size": image_size, **(config or {})}
+    )
+    component = JAXServer(model_uri=model_dir)
+    component.load()
+    img = np.random.RandomState(0).randint(
+        0, 256, (batch, image_size, image_size, 3), dtype=np.uint8
+    )
+    x_dev = jax.device_put(img)
+    apply, params = component._apply, component.params
+    np.asarray(apply(params, x_dev))  # warm + land
+    pending: "collections.deque" = collections.deque()
+    lat: List[float] = []
+    n_batches = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        t1 = time.perf_counter()
+        out = apply(params, x_dev)
+        try:
+            out.copy_to_host_async()
+        except AttributeError:
+            pass
+        pending.append((out, t1))
+        if len(pending) >= depth:
+            o, ts = pending.popleft()
+            np.asarray(o)
+            lat.append(time.perf_counter() - ts)
+            n_batches += 1
+    while pending:
+        o, ts = pending.popleft()
+        np.asarray(o)
+        lat.append(time.perf_counter() - ts)
+        n_batches += 1
+    elapsed = time.perf_counter() - t0
+    rows_per_s = n_batches * batch / elapsed
+    model = component._model
+    return {
+        "model": "resnet50",
+        "transport": "none (device-resident input, pipelined forwards)",
+        "batch": batch,
+        "image_size": image_size,
+        "pipeline_depth": depth,
+        "batches": n_batches,
+        "rows_per_s": round(rows_per_s, 2),
+        **_lat_summary(lat),
+        "seconds": round(elapsed, 2),
+        "mfu_pct": _mfu(rows_per_s, model.flops_per_row(), peak),
+    }
 
 
 def bench_bert_grpc(
@@ -509,6 +588,9 @@ def run_model_tier(
                 root, seconds=seconds, concurrency=2, batch=2, image_size=64,
                 max_batch=4, peak=peak
             )
+            results["resnet50_device"] = bench_resnet50_device(
+                root, seconds=seconds, batch=2, image_size=64, depth=2, peak=peak
+            )
             results["bert_grpc"] = bench_bert_grpc(
                 root,
                 seconds=seconds,
@@ -546,6 +628,9 @@ def run_model_tier(
             best = max(runs, key=lambda r: r["rows_per_s"])
             best["best_of"] = len(runs)
             results["resnet50_rest"] = best
+            results["resnet50_device"] = bench_resnet50_device(
+                root, seconds=seconds, peak=peak
+            )
             results["bert_grpc"] = bench_bert_grpc(root, seconds=seconds, peak=peak)
             results["llm_generate"] = bench_generate(
                 root,

@@ -105,14 +105,23 @@ def initialize_distributed(
         return True
     except RuntimeError as e:
         msg = str(e).lower()
-        # raced another initializer, or the XLA backends were already up
-        # (too late to join this process into a pod — a best-effort no-op,
-        # matching the documented idempotent contract)
-        if (
-            "already" in msg
-            or "only be called once" in msg
-            or "must be called before" in msg
-        ):
+        if "already" in msg or "only be called once" in msg:
+            # raced another initializer — the documented idempotent no-op
+            return False
+        if "must be called before" in msg:
+            # distributed init was WANTED (coordinator/pod detected) but
+            # something touched the XLA backends first: this host now runs
+            # single-process and cross-host collectives will never form.
+            # Loud warning instead of raise — serving a slice beats
+            # crashing, but the operator must see it.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "initialize_distributed: too late — XLA backends already "
+                "initialized before the multi-host join (%s). This process "
+                "continues SINGLE-HOST; call initialize_distributed() "
+                "before any jax API use to form the pod.", e,
+            )
             return False
         raise
 

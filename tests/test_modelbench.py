@@ -42,6 +42,8 @@ def test_model_tier_tiny_end_to_end():
         assert stats["p50_ms"] > 0, key
         assert stats["p99_ms"] >= stats["p50_ms"], key
     assert results["llm_generate"]["tokens_per_s"] > 0
+    assert results["resnet50_device"]["rows_per_s"] > 0
+    assert "none" in results["resnet50_device"]["transport"]
     # CPU has no published peak -> MFU is None there; on TPU it's a number
     mfu = results["resnet50_rest"]["mfu_pct"]
     assert mfu is None or 0 < mfu < 100

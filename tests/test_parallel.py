@@ -42,11 +42,33 @@ def test_hybrid_mesh_single_slice_fallback():
     assert np.allclose(np.asarray(jnp.sum(s, 0)), np.asarray(x.sum(0)))
 
 
-def test_initialize_distributed_noop_single_process():
+def test_initialize_distributed_noop_single_process(monkeypatch):
     """Without a coordinator (dev/test), initialize is a clean no-op."""
     from seldon_core_tpu.parallel import initialize_distributed
 
+    monkeypatch.delenv("SELDON_TPU_COORDINATOR", raising=False)
+    monkeypatch.delenv("MEGASCALE_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
     assert initialize_distributed() is False
+    # a single-entry worker list (one-host slice) is not a pod either
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    assert initialize_distributed() is False
+
+
+def test_initialize_distributed_pod_detected_but_late(monkeypatch, caplog):
+    """A multi-entry worker list means a pod: init is attempted, and when
+    the XLA backends are already up (this test process) it degrades to
+    single-host with a loud warning rather than raising."""
+    import logging
+
+    from seldon_core_tpu.parallel import initialize_distributed
+
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host-0,host-1")
+    monkeypatch.setenv("SELDON_TPU_NUM_PROCESSES", "2")
+    monkeypatch.setenv("SELDON_TPU_PROCESS_ID", "0")
+    with caplog.at_level(logging.WARNING, logger="seldon_core_tpu.parallel.mesh"):
+        assert initialize_distributed(coordinator_address="127.0.0.1:1") is False
+    assert any("SINGLE-HOST" in r.message for r in caplog.records)
 
 
 @pytest.mark.parametrize("causal", [True, False])
