@@ -18,6 +18,7 @@ here a runtime starts the same logical components on the TPU host:
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
 import socket
@@ -37,6 +38,21 @@ def free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+DRAIN_ANNOTATION = "seldon.io/drain-seconds"
+DEFAULT_DRAIN_S = 10.0
+
+
+def _drain_seconds(spec: "ComponentSpec") -> float:
+    """Rolling-update drain budget from the predictor's annotations
+    (``seldon.io/drain-seconds``, default 10 — the reference's preStop
+    sleep made configurable)."""
+    ann = (spec.engine_spec or {}).get("annotations") or {}
+    try:
+        return float(ann.get(DRAIN_ANNOTATION, DEFAULT_DRAIN_S))
+    except (TypeError, ValueError):
+        return DEFAULT_DRAIN_S
 
 
 @dataclass
@@ -110,6 +126,17 @@ class _InProcessHandle(ComponentHandle):
             return False
 
     async def stop(self) -> None:
+        # graceful drain before teardown (reference preStop idiom:
+        # `curl /pause; sleep 10` — seldondeployment_engine.go:173-177;
+        # here pause ALWAYS rejects new work first, then the wait is
+        # exact on the in-flight gauge, bounded by seldon.io/drain-seconds)
+        if self.app is not None:
+            self.app.paused = True
+            drain_s = _drain_seconds(self.spec)
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + drain_s
+            while getattr(self.app, "inflight", 0) > 0 and loop.time() < deadline:
+                await asyncio.sleep(0.02)
         if self._grpc_server is not None:
             await self._grpc_server.stop(grace=0.1)
         tasks = list(self._tasks)
@@ -241,14 +268,30 @@ class _SubprocessHandle(ComponentHandle):
 
     async def stop(self) -> None:
         # graceful drain first (reference preStop: curl /pause; sleep —
-        # operator/controllers/seldondeployment_engine.go:173-177)
-        def drain():
+        # operator/controllers/seldondeployment_engine.go:173-177): pause
+        # rejects new work, then poll /inflight until live requests hit
+        # zero (exact, not a fixed sleep), bounded by seldon.io/drain-seconds
+        loop = asyncio.get_running_loop()
+
+        def pause():
             try:
                 urllib.request.urlopen(f"{self.url}/pause", timeout=0.5).read()
             except Exception:
                 pass
 
-        await asyncio.get_running_loop().run_in_executor(None, drain)
+        def inflight() -> int:
+            try:
+                with urllib.request.urlopen(f"{self.url}/inflight", timeout=0.5) as r:
+                    return int(json.loads(r.read()).get("inflight", 0))
+            except Exception:
+                return 0  # probe gone -> nothing left to drain
+
+        await loop.run_in_executor(None, pause)
+        deadline = loop.time() + _drain_seconds(self.spec)
+        while loop.time() < deadline:
+            if await loop.run_in_executor(None, inflight) <= 0:
+                break
+            await asyncio.sleep(0.1)
         self.proc.terminate()
         try:
             await asyncio.get_running_loop().run_in_executor(None, self.proc.wait, 5)

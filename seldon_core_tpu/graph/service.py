@@ -97,6 +97,11 @@ class EngineApp:
         self.request_logger = request_logger or RequestLogger()
         self.paused = False
         self.graph_ready = True
+        # in-flight request gauge: rolling updates pause the engine then
+        # wait for this to hit zero before tearing the graph down
+        # (reference's preStop `curl /pause; sleep 10` drain idiom,
+        # seldondeployment_engine.go:173-177 — here the wait is exact)
+        self.inflight = 0
         self._ready_task: Optional[asyncio.Task] = None
 
     # -- core entrypoints (shared by REST and gRPC fronts) ------------------
@@ -107,6 +112,7 @@ class EngineApp:
 
         t0 = time.perf_counter()
         labels = {"deployment": self.spec.name}
+        self.inflight += 1
         try:
             with get_tracer().span(
                 "predictions", tags={"deployment": self.spec.name}, headers=headers
@@ -116,6 +122,7 @@ class EngineApp:
             self.metrics.counter_inc("seldon_api_engine_server_errors", labels)
             raise
         finally:
+            self.inflight -= 1
             self.metrics.observe(
                 "seldon_api_engine_server_requests_seconds", time.perf_counter() - t0, labels
             )
@@ -125,13 +132,17 @@ class EngineApp:
         return out
 
     async def send_feedback(self, feedback: Dict[str, Any]) -> Dict[str, Any]:
-        out = await self.executor.send_feedback(feedback)
-        self.metrics.counter_inc(
-            "seldon_api_engine_server_feedback_reward",
-            {"deployment": self.spec.name},
-            float(feedback.get("reward", 0.0)),
-        )
-        return out
+        self.inflight += 1
+        try:
+            out = await self.executor.send_feedback(feedback)
+            self.metrics.counter_inc(
+                "seldon_api_engine_server_feedback_reward",
+                {"deployment": self.spec.name},
+                float(feedback.get("reward", 0.0)),
+            )
+            return out
+        finally:
+            self.inflight -= 1
 
     # -- readiness loop -----------------------------------------------------
 
@@ -182,10 +193,17 @@ class EngineApp:
             return Response(out)
 
         async def feedback(req: Request) -> Response:
+            if self.paused:
+                return Response(error_body(503, "paused"), 503)
             body = req.json()
             if body is None:
                 return Response(error_body(400, "empty request body"), 400)
             return Response(await self.send_feedback(body))
+
+        async def inflight(req: Request) -> Response:
+            # drain probe: a runtime replacing this engine polls here after
+            # /pause until live work hits zero (exact preStop drain)
+            return Response({"inflight": self.inflight, "paused": self.paused})
 
         async def ready(req: Request) -> Response:
             if self.paused or not self.graph_ready:
@@ -224,6 +242,7 @@ class EngineApp:
         app.add_route("/ping", ping)
         app.add_route("/pause", pause)
         app.add_route("/unpause", unpause)
+        app.add_route("/inflight", inflight)
         app.add_route("/metrics", prometheus)
         app.add_route("/prometheus", prometheus)
         app.add_route("/traces", traces)
@@ -246,6 +265,8 @@ class EngineApp:
         app = self
 
         async def predict_rpc(request: pb.SeldonMessage, context):
+            if app.paused:
+                await context.abort(grpc.StatusCode.UNAVAILABLE, "paused")
             try:
                 out = await app.predict(proto_to_json(request))
                 return json_to_proto(out)
@@ -253,6 +274,8 @@ class EngineApp:
                 await context.abort(grpc.StatusCode.INTERNAL, e.info)
 
         async def feedback_rpc(request: pb.Feedback, context):
+            if app.paused:
+                await context.abort(grpc.StatusCode.UNAVAILABLE, "paused")
             out = await app.send_feedback(proto_to_json(request))
             return json_to_proto(out)
 

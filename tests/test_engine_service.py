@@ -1,6 +1,7 @@
 """Engine app tests: REST/gRPC fronts, micro-batching, metrics, logging."""
 
 import asyncio
+import json
 
 import numpy as np
 
@@ -58,8 +59,20 @@ def test_pause_unpause(rest_client):
     client = rest_client(app.rest_app())
     assert client.call("/pause", None)[0] == 200
     assert client.call("/api/v0.1/predictions", {"data": {"ndarray": [[1]]}})[0] == 503
+    # feedback is gated too — a paused engine accepts NO new work, so the
+    # rolling-update drain converges
+    assert client.call("/api/v0.1/feedback", {"reward": 1.0})[0] == 503
     assert client.call("/unpause", None)[0] == 200
     assert client.call("/api/v0.1/predictions", {"data": {"ndarray": [[1]]}})[0] == 200
+
+
+def test_inflight_probe(rest_client):
+    app = make_app()
+    client = rest_client(app.rest_app())
+    req = __import__("seldon_core_tpu.http_server", fromlist=["Request"]).Request
+    resp = asyncio.run(app.rest_app()._dispatch(req("GET", "/inflight", "", {}, b"")))
+    body = json.loads(resp.body)
+    assert body == {"inflight": 0, "paused": False}
 
 
 class CountingBatchModel(SeldonComponent):

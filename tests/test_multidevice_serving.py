@@ -120,6 +120,153 @@ def test_reconcile_places_engine_on_mesh_and_serves(model_dir):
     asyncio.run(go())
 
 
+def test_canary_predictors_on_disjoint_blocks(model_dir):
+    """SURVEY §7 hard part (c): two predictors of one deployment co-
+    scheduled on DISJOINT device blocks of the same slice — a weighted
+    canary where main and canary each own half the chips, both genuinely
+    sharded, with the gateway splitting traffic between them."""
+
+    async def go():
+        store = ResourceStore()
+        placement = TpuPlacement(devices=jax.devices())
+        gw = Gateway()
+        ctl = DeploymentController(
+            store,
+            runtime=InProcessRuntime(open_ports=False),
+            placement=placement,
+            gateway=gw,
+        )
+        dep = SeldonDeployment.from_dict(
+            {
+                "name": "canarydep",
+                "predictors": [
+                    {
+                        "name": "main",
+                        "traffic": 80,
+                        "tpuMesh": {"model": 4},
+                        "graph": {
+                            "name": "m",
+                            "implementation": "JAX_SERVER",
+                            "modelUri": model_dir,
+                        },
+                    },
+                    {
+                        "name": "canary",
+                        "traffic": 20,
+                        "tpuMesh": {"model": 4},
+                        "graph": {
+                            "name": "m",
+                            "implementation": "JAX_SERVER",
+                            "modelUri": model_dir,
+                        },
+                    },
+                ],
+            }
+        )
+        store.apply(dep)
+        status = await ctl.reconcile(dep.clone())
+        assert status.state == STATE_AVAILABLE
+        assert placement.capacity()["used"] == 8
+
+        engines = [
+            handle for handle, _ in ctl.components.values()
+            if handle.spec.kind == "engine"
+        ]
+        assert len(engines) == 2
+        meshes = [e.app.executor._mesh for e in engines]
+        assert all(m is not None and dict(m.shape) == {"model": 4} for m in meshes)
+        blocks = [frozenset(d.id for d in m.devices.flat) for m in meshes]
+        assert blocks[0].isdisjoint(blocks[1]), "predictor blocks overlap"
+
+        # both predictors answer through their own sharded engines
+        tokens = np.arange(1, 17, dtype=np.int32).reshape(2, 8)
+        for e in engines:
+            out = await e.app.predict({"data": {"ndarray": tokens.tolist()}})
+            logits = np.asarray(out["data"]["ndarray"], dtype=np.float64)
+            assert logits.shape == (2, BERT_TINY["num_classes"])
+            assert np.isfinite(logits).all()
+
+        # the gateway's weighted routing sees both predictors
+        routes = {r.predictor: r.weight for r in gw._routes[dep.key]}
+        assert routes == {"main": 80, "canary": 20}
+        for name in ("main", "canary"):
+            primary, _shadows = gw.select(dep.key, header_predictor=name)
+            assert primary is not None, name
+
+        await ctl.delete(dep)
+        assert placement.capacity()["used"] == 0
+
+    asyncio.run(go())
+
+
+def test_rolling_update_drains_inflight_requests(model_dir):
+    """In-flight predictions survive a rolling update: the replaced
+    engine pauses, waits for its live requests, and only then tears the
+    graph down (the reference's preStop `/pause; sleep 10` idiom made
+    exact on the in-flight gauge)."""
+
+    async def go():
+        store = ResourceStore()
+        ctl = DeploymentController(store, runtime=InProcessRuntime(open_ports=False))
+
+        def dep_with(generation_marker):
+            return SeldonDeployment.from_dict(
+                {
+                    "name": "draindep",
+                    "predictors": [
+                        {
+                            "name": "p0",
+                            "annotations": {"marker": generation_marker},
+                            "graph": {
+                                "name": "m",
+                                "implementation": "JAX_SERVER",
+                                "modelUri": model_dir,
+                            },
+                        }
+                    ],
+                }
+            )
+
+        dep, _ = store.apply(dep_with("v1"))
+        await ctl.reconcile(dep.clone())
+        old_engine = next(
+            h for h, _ in ctl.components.values() if h.spec.kind == "engine"
+        )
+        app = old_engine.app
+
+        # a slow in-flight request: stall the executor under the engine
+        tokens = np.arange(1, 17, dtype=np.int32).reshape(2, 8)
+        real_predict = app.executor.predict
+
+        async def slow_predict(message):
+            await asyncio.sleep(0.5)
+            return await real_predict(message)
+
+        app.executor.predict = slow_predict
+        inflight = asyncio.create_task(
+            app.predict({"data": {"ndarray": tokens.tolist()}})
+        )
+        await asyncio.sleep(0.1)
+        assert app.inflight == 1
+
+        # rolling update while the request is mid-flight
+        changed, _ = store.apply(dep_with("v2"))
+        await ctl.reconcile(changed.clone())
+
+        out = await inflight  # drained, not cancelled
+        logits = np.asarray(out["data"]["ndarray"], dtype=np.float64)
+        assert logits.shape == (2, BERT_TINY["num_classes"])
+        assert app.paused  # old engine was paused for the drain
+        new_engine = next(
+            h for h, _ in ctl.components.values() if h.spec.kind == "engine"
+        )
+        assert new_engine is not old_engine
+
+        await ctl.delete(changed)
+
+    asyncio.run(go())
+
+
 def test_generate_server_sharded_through_engine(tmp_path):
     """generate() serving with the KV cache sharded over the engine's
     mesh (model axis for KV heads) — BASELINE config 5 at mesh scale."""
