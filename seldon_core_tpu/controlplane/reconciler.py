@@ -66,6 +66,11 @@ class DeploymentController:
         # component-name -> (handle, spec_hash of owning deployment)
         self.components: Dict[str, Tuple[ComponentHandle, str]] = {}
         self._reconciling: Dict[str, asyncio.Lock] = {}
+        # autoscaler state: (dep.key, predictor) -> consecutive ticks that
+        # wanted a scale-DOWN (stabilization window, like the k8s HPA's)
+        self.autoscale_period_s = 5.0
+        self.scale_down_ticks = 3
+        self._scale_down_streak: Dict[Tuple[str, str], int] = {}
 
     # -- desired state ------------------------------------------------------
 
@@ -93,11 +98,18 @@ class DeploymentController:
     def _component_hash(dep: SeldonDeployment) -> str:
         """Spec hash extended with annotations: annotation flips (e.g.
         separate-engine) must produce new component names so running
-        engines are replaced, not half-updated."""
+        engines are replaced, not half-updated.
+
+        Replica COUNTS are excluded: a scale event (autoscaler or manual
+        `replicas` bump) must add/remove replica components without
+        renaming — and so recreating — the survivors (the reference's HPA
+        scales the Deployment without a pod-template change)."""
         import hashlib
         import json as _json
 
-        blob = dep.spec_hash() + _json.dumps(dep.annotations, sort_keys=True)
+        blob = dep.spec_hash(include_replicas=False) + _json.dumps(
+            dep.annotations, sort_keys=True
+        )
         return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
     async def desired_components(self, dep: SeldonDeployment) -> List[ComponentSpec]:
@@ -440,18 +452,109 @@ class DeploymentController:
             await handle.stop()
         if self.gateway is not None:
             self.gateway.drop_routes(dep.key)
+        # a re-created deployment must start a FRESH scale-down window
+        for key in [k for k in self._scale_down_streak if k[0] == dep.key]:
+            del self._scale_down_streak[key]
 
     # -- watch loop ---------------------------------------------------------
 
+    # -- autoscaler ---------------------------------------------------------
+
+    async def autoscale_once(self) -> Dict[str, int]:
+        """One HPA evaluation pass (reference: createHpas
+        seldondeployment_controller.go:805 + the k8s HPA control loop; the
+        TPU-native metric is in-flight concurrency per engine replica,
+        summed from the engines' /inflight gauges).
+
+        desired = ceil(total_inflight / targetConcurrency), clamped to
+        [minReplicas, maxReplicas]. Scale-up applies immediately;
+        scale-down waits ``scale_down_ticks`` consecutive low passes
+        (stabilization, so a burst lull doesn't thrash replicas). Returns
+        {"<dep.key>/<predictor>": new_replicas} for every change applied.
+        """
+        changes: Dict[str, int] = {}
+        for dep in self.store.list():
+            try:
+                changes.update(await self._autoscale_deployment(dep))
+            except Exception:  # noqa: BLE001 - one malformed hpaSpec must
+                # not stop autoscaling every other deployment
+                logger.exception("autoscale %s failed", dep.key)
+        return changes
+
+    async def _autoscale_deployment(self, dep) -> Dict[str, int]:
+        import math
+
+        new_replicas: Dict[str, int] = {}
+        for pspec in dep.predictors:
+            hpa = pspec.hpa_spec
+            if not hpa:
+                continue
+            lo = int(hpa.get("minReplicas", 1))
+            hi = int(hpa.get("maxReplicas", lo))
+            target = float(hpa.get("targetConcurrency", 1))
+            if not math.isfinite(target) or target <= 0:
+                raise ValueError(f"{pspec.name}: bad targetConcurrency {target}")
+            handles = [
+                handle
+                for handle, _ in self.components.values()
+                if handle.spec.deployment == dep.key
+                and handle.spec.predictor == pspec.name
+                and handle.spec.routable
+            ]
+            # probes run concurrently: with SubprocessRuntime each is an
+            # HTTP call with a 0.5s timeout, and the controller loop must
+            # not stall on M x N sequential probes
+            loads = await asyncio.gather(*(h.load() for h in handles))
+            known = [v for v in loads if v is not None]
+            if not known:
+                continue
+            total = sum(known)
+            desired = min(hi, max(lo, math.ceil(total / target)))
+            current = max(1, pspec.replicas)
+            streak_key = (dep.key, pspec.name)
+            if desired > current:
+                self._scale_down_streak.pop(streak_key, None)
+                new_replicas[pspec.name] = desired
+            elif desired < current:
+                streak = self._scale_down_streak.get(streak_key, 0) + 1
+                self._scale_down_streak[streak_key] = streak
+                if streak >= self.scale_down_ticks:
+                    self._scale_down_streak.pop(streak_key, None)
+                    new_replicas[pspec.name] = desired
+            else:
+                self._scale_down_streak.pop(streak_key, None)
+        if not new_replicas:
+            return {}
+        updated = dep.clone()
+        for pspec in updated.predictors:
+            if pspec.name in new_replicas:
+                pspec.replicas = new_replicas[pspec.name]
+        self.store.apply(updated)  # generation bump -> reconcile
+        changes = {}
+        for name, n in new_replicas.items():
+            changes[f"{dep.key}/{name}"] = n
+            logger.info("autoscale %s/%s -> %d replicas", dep.key, name, n)
+        return changes
+
     async def run(self, stop_event: Optional[asyncio.Event] = None) -> None:
         """Consume store events forever (controller-runtime manager parity,
-        reference: operator/main.go:49-93)."""
+        reference: operator/main.go:49-93). The autoscaler evaluates every
+        ``autoscale_period_s`` between events."""
         q = self.store.watch()
         # reconcile pre-existing resources (controller restart)
         for dep in self.store.list():
             await self.reconcile(dep.clone())
+        loop = asyncio.get_running_loop()
+        next_autoscale = loop.time() + self.autoscale_period_s
         try:
             while stop_event is None or not stop_event.is_set():
+                if loop.time() >= next_autoscale:
+                    next_autoscale = loop.time() + self.autoscale_period_s
+                    try:
+                        await self.autoscale_once()
+                    except Exception:  # noqa: BLE001 - probe hiccups must
+                        # not kill the manager loop
+                        logger.exception("autoscale pass failed")
                 try:
                     event, dep = await asyncio.wait_for(q.get(), timeout=0.2)
                 except asyncio.TimeoutError:

@@ -133,14 +133,21 @@ class SeldonDeployment:
             "status": self.status.to_dict(),
         }
 
-    def spec_hash(self) -> str:
+    def spec_hash(self, include_replicas: bool = True) -> str:
         """Stable digest of the spec (not metadata/status) used by the
         reconciler's change diff, like the operator's JSON-equality check
-        (reference: seldondeployment_controller.go:842-853 jsonEquals)."""
+        (reference: seldondeployment_controller.go:842-853 jsonEquals).
+
+        ``include_replicas=False`` gives the component-naming variant: a
+        scale event must not rename (and so recreate) surviving replica
+        components, only add/remove."""
         import hashlib
 
+        preds = [p.to_dict() for p in self.predictors]
+        if not include_replicas:
+            preds = [{**p, "replicas": None} for p in preds]
         blob = json.dumps(
-            {"protocol": self.protocol, "predictors": [p.to_dict() for p in self.predictors]},
+            {"protocol": self.protocol, "predictors": preds},
             sort_keys=True,
         )
         return hashlib.sha256(blob.encode()).hexdigest()[:16]

@@ -25,6 +25,7 @@ import socket
 import subprocess
 import sys
 import time
+import urllib.error
 import urllib.request
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -98,6 +99,11 @@ class ComponentHandle:
     async def stop(self) -> None:  # pragma: no cover - overridden
         raise NotImplementedError
 
+    async def load(self) -> Optional[float]:
+        """In-flight request concurrency (autoscaler signal); None when
+        this component kind has no load probe."""
+        return None
+
 
 class _InProcessHandle(ComponentHandle):
     def __init__(
@@ -124,6 +130,11 @@ class _InProcessHandle(ComponentHandle):
             return bool(out)
         except Exception:
             return False
+
+    async def load(self) -> Optional[float]:
+        if self.app is None:
+            return None
+        return float(getattr(self.app, "inflight", 0))
 
     async def stop(self) -> None:
         # graceful drain before teardown (reference preStop idiom:
@@ -266,6 +277,30 @@ class _SubprocessHandle(ComponentHandle):
 
         return await asyncio.get_running_loop().run_in_executor(None, probe)
 
+    def _probe_inflight(self) -> Optional[float]:
+        """GET /inflight. Returns the gauge, 0.0 when the process is GONE
+        (connection refused / dead proc — nothing left to drain), or None
+        when the state is UNKNOWN (timeout, slow event loop): callers must
+        keep waiting on None, not treat it as drained — probes time out
+        exactly when the engine is busiest."""
+        if self.proc.poll() is not None:
+            return 0.0
+        try:
+            with urllib.request.urlopen(f"{self.url}/inflight", timeout=0.5) as r:
+                return float(json.loads(r.read()).get("inflight", 0))
+        except urllib.error.URLError as e:
+            if isinstance(getattr(e, "reason", None), ConnectionRefusedError):
+                return 0.0
+            return None
+        except Exception:
+            return None
+
+    async def load(self) -> Optional[float]:
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, self._probe_inflight
+        )
+        return None if out is None else out
+
     async def stop(self) -> None:
         # graceful drain first (reference preStop: curl /pause; sleep —
         # operator/controllers/seldondeployment_engine.go:173-177): pause
@@ -279,17 +314,11 @@ class _SubprocessHandle(ComponentHandle):
             except Exception:
                 pass
 
-        def inflight() -> int:
-            try:
-                with urllib.request.urlopen(f"{self.url}/inflight", timeout=0.5) as r:
-                    return int(json.loads(r.read()).get("inflight", 0))
-            except Exception:
-                return 0  # probe gone -> nothing left to drain
-
         await loop.run_in_executor(None, pause)
         deadline = loop.time() + _drain_seconds(self.spec)
         while loop.time() < deadline:
-            if await loop.run_in_executor(None, inflight) <= 0:
+            n = await loop.run_in_executor(None, self._probe_inflight)
+            if n is not None and n <= 0:
                 break
             await asyncio.sleep(0.1)
         self.proc.terminate()

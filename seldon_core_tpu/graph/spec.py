@@ -162,6 +162,11 @@ class PredictorSpec:
     annotations: Dict[str, str] = field(default_factory=dict)
     # TPU placement: mesh shape this predictor wants, e.g. {"data": 1, "model": 8}
     tpu_mesh: Optional[Dict[str, int]] = None
+    # autoscaling (reference CRD HpaSpec, seldon_deployment.proto /
+    # seldondeployment_types.go + createHpas controller.go:805): the
+    # TPU-native metric is in-flight concurrency per replica —
+    # {"minReplicas": 1, "maxReplicas": 4, "targetConcurrency": 8}
+    hpa_spec: Optional[Dict[str, Any]] = None
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "PredictorSpec":
@@ -175,6 +180,7 @@ class PredictorSpec:
             labels=d.get("labels", {}),
             annotations=d.get("annotations", {}),
             tpu_mesh=d.get("tpuMesh") or d.get("tpu_mesh"),
+            hpa_spec=d.get("hpaSpec") or d.get("hpa_spec"),
         )
 
     def to_dict(self) -> Dict[str, Any]:
@@ -186,6 +192,7 @@ class PredictorSpec:
             "labels": self.labels,
             "annotations": self.annotations,
             **({"tpuMesh": self.tpu_mesh} if self.tpu_mesh else {}),
+            **({"hpaSpec": self.hpa_spec} if self.hpa_spec else {}),
         }
 
     @staticmethod
@@ -253,6 +260,23 @@ def validate_predictor(spec: PredictorSpec) -> None:
             raise GraphSpecError(f"combiner {unit.name} has no children")
         if unit.type == UnitType.ROUTER and not unit.children:
             raise GraphSpecError(f"router {unit.name} has no children")
+    if spec.hpa_spec is not None:
+        hpa = spec.hpa_spec
+        lo = int(hpa.get("minReplicas", 1))
+        hi = int(hpa.get("maxReplicas", lo))
+        target = float(hpa.get("targetConcurrency", 0))
+        if lo < 1 or hi < lo:
+            raise GraphSpecError(
+                f"{spec.name}: hpaSpec needs 1 <= minReplicas <= maxReplicas, "
+                f"got {lo}..{hi}"
+            )
+        import math as _math
+
+        if not _math.isfinite(target) or target <= 0:
+            raise GraphSpecError(
+                f"{spec.name}: hpaSpec.targetConcurrency must be a finite "
+                f"number > 0, got {target}"
+            )
 
 
 def validate_deployment(predictors: List[PredictorSpec]) -> None:

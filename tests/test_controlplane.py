@@ -529,3 +529,96 @@ def test_reconcile_with_placement_insufficient_devices():
         assert "devices" in status.description
 
     run(go())
+
+
+# -- autoscaler (reference HPA: createHpas controller.go:805) ----------------
+
+
+def hpa_dep(name="hdep", lo=1, hi=4, target=4.0, replicas=1):
+    dep = simple_dep(name=name, replicas=replicas)
+    dep.predictors[0].hpa_spec = {
+        "minReplicas": lo, "maxReplicas": hi, "targetConcurrency": target,
+    }
+    return dep
+
+
+def _engines(ctl, key="default/hdep"):
+    return [
+        h for h, _ in ctl.components.values()
+        if h.spec.kind == "engine" and h.spec.deployment == key
+    ]
+
+
+def test_hpa_spec_validation():
+    from seldon_core_tpu.graph.spec import GraphSpecError, validate_deployment
+
+    dep = hpa_dep(lo=0)
+    with pytest.raises(GraphSpecError, match="minReplicas"):
+        validate_deployment(dep.predictors)
+    dep = hpa_dep(lo=3, hi=1)
+    with pytest.raises(GraphSpecError, match="minReplicas"):
+        validate_deployment(dep.predictors)
+    dep = hpa_dep(target=0)
+    with pytest.raises(GraphSpecError, match="targetConcurrency"):
+        validate_deployment(dep.predictors)
+    validate_deployment(hpa_dep().predictors)  # sane spec passes
+
+
+def test_autoscale_up_down_with_stabilization():
+    async def go():
+        store = ResourceStore()
+        ctl = DeploymentController(store, runtime=InProcessRuntime(open_ports=False))
+        dep, _ = store.apply(hpa_dep())
+        await ctl.reconcile(dep.clone())
+        assert len(_engines(ctl)) == 1
+
+        # load 9 on one replica, target 4 -> desired ceil(9/4)=3, immediate
+        _engines(ctl)[0].app.inflight = 9
+        changes = await ctl.autoscale_once()
+        assert changes == {"default/hdep/p0": 3}
+        await ctl.reconcile(store.get("hdep").clone())
+        engines = _engines(ctl)
+        assert len(engines) == 3
+
+        # idle now -> desired 1, but scale-down needs 3 consecutive passes
+        for e in engines:
+            e.app.inflight = 0
+        assert await ctl.autoscale_once() == {}
+        assert await ctl.autoscale_once() == {}
+        changes = await ctl.autoscale_once()
+        assert changes == {"default/hdep/p0": 1}
+        await ctl.reconcile(store.get("hdep").clone())
+        assert len(_engines(ctl)) == 1
+
+        # a load spike mid-streak resets the stabilization window
+        _engines(ctl)[0].app.inflight = 40  # ceil(40/4)=10 -> clamp max 4
+        changes = await ctl.autoscale_once()
+        assert changes == {"default/hdep/p0": 4}
+        await ctl.shutdown()
+
+    run(go())
+
+
+def test_autoscale_scale_event_keeps_existing_replicas():
+    """Scaling must ADD replica components, not replace the running ones
+    (the reference HPA scales the Deployment without a pod-template
+    change): surviving component names — and handles — are unchanged."""
+
+    async def go():
+        store = ResourceStore()
+        ctl = DeploymentController(store, runtime=InProcessRuntime(open_ports=False))
+        dep, _ = store.apply(hpa_dep())
+        await ctl.reconcile(dep.clone())
+        before = {
+            name: handle for name, (handle, _) in ctl.components.items()
+        }
+        _engines(ctl)[0].app.inflight = 9
+        await ctl.autoscale_once()
+        await ctl.reconcile(store.get("hdep").clone())
+        after = dict(ctl.components)
+        for name, handle in before.items():
+            assert name in after, "existing replica was renamed by the scale"
+            assert after[name][0] is handle, "existing replica was recreated"
+        await ctl.shutdown()
+
+    run(go())
