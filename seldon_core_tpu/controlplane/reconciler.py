@@ -484,16 +484,25 @@ class DeploymentController:
     async def _autoscale_deployment(self, dep) -> Dict[str, int]:
         import math
 
+        from ..graph.spec import parse_hpa_spec
+
         new_replicas: Dict[str, int] = {}
         for pspec in dep.predictors:
             hpa = pspec.hpa_spec
             if not hpa:
                 continue
-            lo = int(hpa.get("minReplicas", 1))
-            hi = int(hpa.get("maxReplicas", lo))
-            target = float(hpa.get("targetConcurrency", 1))
-            if not math.isfinite(target) or target <= 0:
-                raise ValueError(f"{pspec.name}: bad targetConcurrency {target}")
+            lo, hi, target = parse_hpa_spec(hpa, who=f"{dep.key}/{pspec.name}")
+            current = max(1, pspec.replicas)
+            if self.placement is not None and pspec.tpu_mesh:
+                # never scale past the chips that exist: desired beyond the
+                # free device blocks would just flip the deployment FAILED
+                # while the old replicas keep serving (k8s HPA's
+                # unschedulable-pods analogue, caught before, not after)
+                per_replica = 1
+                for v in pspec.tpu_mesh.values():
+                    per_replica *= int(v)
+                placeable = current + self.placement.capacity()["free"] // per_replica
+                hi = min(hi, max(lo, placeable))
             handles = [
                 handle
                 for handle, _ in self.components.values()
@@ -510,7 +519,6 @@ class DeploymentController:
                 continue
             total = sum(known)
             desired = min(hi, max(lo, math.ceil(total / target)))
-            current = max(1, pspec.replicas)
             streak_key = (dep.key, pspec.name)
             if desired > current:
                 self._scale_down_streak.pop(streak_key, None)

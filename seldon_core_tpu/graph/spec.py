@@ -246,6 +246,31 @@ def default_predictor(spec: PredictorSpec, separate_pods: bool = False) -> Predi
     return spec
 
 
+def parse_hpa_spec(hpa: Dict[str, Any], who: str = "?") -> "tuple[int, int, float]":
+    """Parse + validate an hpaSpec into (minReplicas, maxReplicas,
+    targetConcurrency). The ONE parser shared by admission validation and
+    the autoscaler, so defaults can't drift. Raises GraphSpecError on any
+    malformed field."""
+    import math as _math
+
+    try:
+        lo = int(hpa.get("minReplicas", 1))
+        hi = int(hpa.get("maxReplicas", lo))
+        target = float(hpa.get("targetConcurrency", 0))
+    except (TypeError, ValueError) as e:
+        raise GraphSpecError(f"{who}: malformed hpaSpec field: {e}") from e
+    if lo < 1 or hi < lo:
+        raise GraphSpecError(
+            f"{who}: hpaSpec needs 1 <= minReplicas <= maxReplicas, got {lo}..{hi}"
+        )
+    if not _math.isfinite(target) or target <= 0:
+        raise GraphSpecError(
+            f"{who}: hpaSpec.targetConcurrency must be a finite number > 0, "
+            f"got {target}"
+        )
+    return lo, hi, target
+
+
 def validate_predictor(spec: PredictorSpec) -> None:
     """Reference checks: seldondeployment_webhook.go:388-411."""
     names = [u.name for u in spec.graph.walk()]
@@ -261,22 +286,7 @@ def validate_predictor(spec: PredictorSpec) -> None:
         if unit.type == UnitType.ROUTER and not unit.children:
             raise GraphSpecError(f"router {unit.name} has no children")
     if spec.hpa_spec is not None:
-        hpa = spec.hpa_spec
-        lo = int(hpa.get("minReplicas", 1))
-        hi = int(hpa.get("maxReplicas", lo))
-        target = float(hpa.get("targetConcurrency", 0))
-        if lo < 1 or hi < lo:
-            raise GraphSpecError(
-                f"{spec.name}: hpaSpec needs 1 <= minReplicas <= maxReplicas, "
-                f"got {lo}..{hi}"
-            )
-        import math as _math
-
-        if not _math.isfinite(target) or target <= 0:
-            raise GraphSpecError(
-                f"{spec.name}: hpaSpec.targetConcurrency must be a finite "
-                f"number > 0, got {target}"
-            )
+        parse_hpa_spec(spec.hpa_spec, who=spec.name)
 
 
 def validate_deployment(predictors: List[PredictorSpec]) -> None:

@@ -431,6 +431,85 @@ class DecoderLM(ServedModel):
             nvs.append(nv)
         return self._decode_head(params, x), nks, nvs
 
+    def decode_chunk_ragged_list(self, params, ks, vs, tokens, pos, attn_len=None):
+        """Decode a WINDOW of tokens per lane in ONE forward over the
+        unstacked cache: ``tokens`` [B, W], ``pos`` [B] start positions —
+        row b's token j sits at position pos[b]+j. Returns
+        ``(logits [B, W, V], new_ks, new_vs)`` where logits[:, j] is the
+        next-token distribution AFTER consuming tokens[:, j].
+
+        This is the speculative-decoding verify step (γ drafted tokens +
+        the entry token are scored in one target forward instead of γ+1
+        sequential steps) and doubles as chunked decode for any
+        multi-token advance. K/V for all W positions are scattered into
+        the cache first; the mask ``key_pos <= pos+j`` then covers both
+        the prefix and in-window causality. Positions beyond a row's
+        accepted prefix simply get overwritten by later writes and are
+        never read (mask), so rejected drafts need no rollback.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        pos = pos.astype(jnp.int32)
+        B, W = tokens.shape
+        positions = pos[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]  # [B,W]
+        x = self._embed_tokens(params, tokens)  # [B,W,D]
+        blocks = params["blocks"]
+        nks: list = []
+        nvs: list = []
+        rows = jnp.arange(B)[:, None]
+        for l in range(len(ks)):
+            p = jax.tree_util.tree_map(lambda a, l=l: a[l], blocks)
+            h = _rms_norm(x, p["ln1"].astype(dt), cfg.norm_eps)
+            q = h @ p["wq"].astype(dt)
+            k = h @ p["wk"].astype(dt)
+            v = h @ p["wv"].astype(dt)
+            Hl = q.shape[-1] // cfg.head_dim
+            KVl = k.shape[-1] // cfg.head_dim
+            q = q.reshape(B, W, Hl, cfg.head_dim).transpose(0, 2, 1, 3)
+            k = k.reshape(B, W, KVl, cfg.head_dim).transpose(0, 2, 1, 3)
+            v = v.reshape(B, W, KVl, cfg.head_dim).transpose(0, 2, 1, 3)
+            q = _rope(q, positions, cfg.rope_theta)
+            k = _rope(k, positions, cfg.rope_theta)
+            # per-row scatter of the whole window: ck[b,:,pos[b]+j,:] = k[b,:,j,:]
+            ck = ks[l].at[rows, :, positions, :].set(k.transpose(0, 2, 1, 3))
+            cv = vs[l].at[rows, :, positions, :].set(v.transpose(0, 2, 1, 3))
+            nks.append(ck)
+            nvs.append(cv)
+            kc, vc = ck, cv
+            if attn_len is not None and attn_len < kc.shape[2]:
+                kc = lax.slice_in_dim(kc, 0, attn_len, axis=2)
+                vc = lax.slice_in_dim(vc, 0, attn_len, axis=2)
+            if KVl < Hl:
+                rep = Hl // KVl
+                kc = jnp.repeat(kc, rep, axis=1)
+                vc = jnp.repeat(vc, rep, axis=1)
+            Ta = kc.shape[2]
+            s = lax.dot_general(
+                q, kc, (((3,), (3,)), ((0, 1), (0, 1))),
+                preferred_element_type=jnp.float32,
+            ) / np.sqrt(cfg.head_dim)  # [B,H,W,Ta]
+            mask = (
+                jnp.arange(Ta, dtype=jnp.int32)[None, None, None, :]
+                <= positions[:, None, :, None]
+            )
+            s = jnp.where(mask, s, -1e30)
+            w_attn = jax.nn.softmax(s, -1).astype(dt)
+            o = lax.dot_general(
+                w_attn, vc, (((3,), (2,)), ((0, 1), (0, 1))),
+                preferred_element_type=jnp.float32,
+            ).astype(dt)  # [B,H,W,Dh]
+            o = o.transpose(0, 2, 1, 3).reshape(B, W, Hl * cfg.head_dim)
+            x = x + o @ p["wo"].astype(dt)
+            ffn_out, _ = self._ffn(p, x)
+            x = x + ffn_out
+        x = _rms_norm(x, params["ln_f"].astype(dt), cfg.norm_eps)
+        logits = (x @ params["unembed"].astype(dt)).astype(jnp.float32)
+        return logits, nks, nvs
+
     def prefill(self, params, prompt, max_seq: int, last_index=None):
         """Batched prefill: ONE forward over the whole prompt, K/V for all
         positions computed in parallel and written into a fresh cache of

@@ -14,6 +14,12 @@ Model URI layout: same ``jax_config.json`` as jaxserver with
     steps_per_poll   decode steps fused into one device burst (default 8)
     pipeline_depth   bursts in flight before the host reads the oldest
                      (default 3; 1 = synchronous)
+    speculate_tokens greedy-exact speculative decoding: draft this many
+                     tokens per round, verify with one target forward
+                     (0 = off). Needs a draft:
+    draft_layers     early-exit self-draft — the first N layers of the
+                     SERVED model propose (no second checkpoint)
+    draft_uri        separate draft model dir (same vocab)
 
 Request (jsonData)::
 
@@ -49,6 +55,9 @@ class GenerateServer(SeldonComponent):
         shard_cache_seq: bool = False,
         steps_per_poll: int = 8,
         pipeline_depth: int = 3,
+        speculate_tokens: int = 0,
+        draft_layers: int = 0,
+        draft_uri: Optional[str] = None,
         **kwargs,
     ):
         self.model_uri = model_uri
@@ -60,6 +69,9 @@ class GenerateServer(SeldonComponent):
         ) else shard_cache_seq.lower() == "true"
         self._steps_per_poll = int(steps_per_poll)
         self._pipeline_depth = int(pipeline_depth)
+        self._speculate_tokens = int(speculate_tokens)
+        self._draft_layers = int(draft_layers)
+        self._draft_uri = draft_uri
         self._extra = kwargs
         self.batcher = None
         self._model = None
@@ -75,6 +87,41 @@ class GenerateServer(SeldonComponent):
                 f"model family {getattr(self._model, '__class__', None)} "
                 "does not support generate(); use family 'llm'"
             )
+        draft_model = None
+        draft_params = None
+        if self._speculate_tokens > 0:
+            if self._draft_uri:
+                dserver = JAXServer(self._draft_uri)
+                _apply, draft_params = dserver.build()
+                draft_model = dserver._model
+            elif self._draft_layers > 0:
+                if self._draft_layers >= self._model.cfg.n_layers:
+                    raise ValueError(
+                        f"draft_layers ({self._draft_layers}) must be < the "
+                        f"served model's n_layers ({self._model.cfg.n_layers})"
+                    )
+                # early-exit self-draft: the first N layers of the served
+                # model (shared embed/head/norm, blocks sliced) — no second
+                # checkpoint, and the proposals improve with the model
+                import dataclasses as _dc
+
+                import jax
+
+                cfg = _dc.asdict(self._model.cfg)
+                cfg["n_layers"] = self._draft_layers
+                from ..models.llm import DecoderLM
+
+                draft_model = DecoderLM(**cfg)
+                draft_params = {
+                    **params,
+                    "blocks": jax.tree_util.tree_map(
+                        lambda a: a[: self._draft_layers], params["blocks"]
+                    ),
+                }
+            else:
+                raise ValueError(
+                    "speculate_tokens needs draft_layers or draft_uri"
+                )
         self.batcher = ContinuousBatcher(
             self._model,
             params,
@@ -84,6 +131,9 @@ class GenerateServer(SeldonComponent):
             shard_cache_seq=self._shard_cache_seq,
             steps_per_poll=self._steps_per_poll,
             pipeline_depth=self._pipeline_depth,
+            draft_model=draft_model,
+            draft_params=draft_params,
+            speculate_tokens=self._speculate_tokens,
         )
         self.batcher.start()
         logger.info(

@@ -93,6 +93,9 @@ class ContinuousBatcher:
         prefill_buckets: Sequence[int] = (32, 128, 512),
         steps_per_poll: int = 8,
         pipeline_depth: int = 3,
+        draft_model=None,
+        draft_params=None,
+        speculate_tokens: int = 4,
     ):
         import jax
         import jax.numpy as jnp
@@ -106,6 +109,13 @@ class ContinuousBatcher:
         # how many bursts may be in flight before the host reads the oldest
         # one's tokens; 1 = fully synchronous (dispatch, read, dispatch ...)
         self.pipeline_depth = max(1, int(pipeline_depth))
+        # speculative decoding (greedy-exact): a cheap draft proposes
+        # `speculate_tokens` tokens per round and ONE target chunk forward
+        # verifies them — the OUTPUT is exactly the target model's greedy
+        # decode no matter how bad the draft is (acceptance only sets how
+        # many target forwards each token costs)
+        self.draft_model = draft_model
+        self.speculate_tokens = int(speculate_tokens) if draft_model is not None else 0
         self.prefill_buckets = tuple(
             sorted(b for b in prefill_buckets if b <= self.max_seq)
         ) or (self.max_seq,)
@@ -162,6 +172,24 @@ class ContinuousBatcher:
                 lambda a: jax.device_put(a, cache_sharding), cache
             )
         self._cache = cache
+        self._draft_params = None
+        self._draft_cache = None
+        if self.speculate_tokens > 0:
+            dp = draft_params
+            if mesh is not None:
+                dp = jax.device_put(dp, draft_model.param_sharding(mesh, dp))
+            self._draft_params = dp
+            dstacked = draft_model.init_cache(self.slots, self.max_seq)
+            dl = dstacked["k"].shape[0]
+            dcache = {
+                "k": [dstacked["k"][l] for l in range(dl)],
+                "v": [dstacked["v"][l] for l in range(dl)],
+            }
+            if cache_sharding is not None:
+                dcache = jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, cache_sharding), dcache
+                )
+            self._draft_cache = dcache
         self._cur_tok = jnp.zeros((self.slots,), jnp.int32)
         self._pos = jnp.zeros((self.slots,), jnp.int32)
         # per-lane PRNG streams: each request's sampling is seeded by ITS
@@ -247,6 +275,101 @@ class ContinuousBatcher:
         self._insert_fn = jax.jit(insert, donate_argnums=(0,))
         self._prefill_fn = jax.jit(prefill_one)
 
+        # -- speculative executables (greedy-exact; see class docstring) ----
+        self._spec_burst_fn = None
+        self._draft_prefill_fn = None
+        self._draft_insert_fn = None
+        if self.speculate_tokens > 0:
+            gamma = self.speculate_tokens
+            draft = draft_model
+
+            def spec_round(params, dparams, ks, vs, dks, dvs, cur_tok, pos, active, attn_len):
+                """One speculation round: draft gamma greedy tokens, verify
+                with ONE target chunk forward, emit the accepted prefix + the
+                target's correction token. Returns per-lane emitted tokens
+                [S, gamma+1] (zero-padded) and counts [S]."""
+                dtok, dpos = cur_tok, pos
+                drafts = []
+                for _ in range(gamma):
+                    dlogits, dks, dvs = draft.decode_step_ragged_list(
+                        dparams, dks, dvs, dtok[:, None], dpos, attn_len=attn_len
+                    )
+                    dtok = jnp.where(
+                        active, jnp.argmax(dlogits, -1).astype(jnp.int32), 0
+                    )
+                    drafts.append(dtok)
+                    dpos = jnp.where(active, dpos + 1, dpos)
+                drafts_arr = jnp.stack(drafts, axis=1)  # [S, gamma]
+                window = jnp.concatenate([cur_tok[:, None], drafts_arr], axis=1)
+                tlogits, ks, vs = model.decode_chunk_ragged_list(
+                    params, ks, vs, window, pos, attn_len=attn_len
+                )
+                t = jnp.argmax(tlogits, -1).astype(jnp.int32)  # [S, gamma+1]
+                match = (drafts_arr == t[:, :gamma]).astype(jnp.int32)
+                accepted = jnp.cumprod(match, axis=1).sum(axis=1)  # [S]
+                cols = jnp.arange(gamma + 1, dtype=jnp.int32)[None, :]
+                correction = jnp.take_along_axis(t, accepted[:, None], axis=1)[:, 0]
+                drafts_padded = jnp.concatenate(
+                    [drafts_arr, jnp.zeros((self.slots, 1), jnp.int32)], axis=1
+                )
+                out = jnp.where(cols < accepted[:, None], drafts_padded, 0)
+                out = jnp.where(cols == accepted[:, None], correction[:, None], out)
+                count = jnp.where(active, accepted + 1, 0)
+                out = jnp.where(active[:, None], out, 0)
+                cur_tok = jnp.where(active, correction, cur_tok)
+                pos = jnp.where(active, pos + accepted + 1, pos)
+                return ks, vs, dks, dvs, cur_tok, pos, out, count
+
+            def spec_burst(params, dparams, caches, cur_tok, pos, active, k, attn_len):
+                """k speculation rounds as one executable. Returns
+                (start_tok [S], toks [k, S, gamma+1], counts [k, S], ...)."""
+
+                def body(carry, _):
+                    ks, vs, dks, dvs, cur_tok, pos = carry
+                    ks, vs, dks, dvs, cur_tok, pos, out, count = spec_round(
+                        params, dparams, ks, vs, dks, dvs, cur_tok, pos,
+                        active, attn_len,
+                    )
+                    return (ks, vs, dks, dvs, cur_tok, pos), (out, count)
+
+                start_tok = cur_tok
+                (ks, vs, dks, dvs, cur_tok, pos), (toks, counts) = lax.scan(
+                    body,
+                    (caches["k"], caches["v"], caches["dk"], caches["dv"],
+                     cur_tok, pos),
+                    None,
+                    length=k,
+                )
+                new_caches = {"k": ks, "v": vs, "dk": dks, "dv": dvs}
+                return start_tok, toks, counts, cur_tok, pos, new_caches
+
+            self._spec_burst_fn = jax.jit(
+                spec_burst, donate_argnums=(2,), static_argnums=(6, 7)
+            )
+
+            def draft_prefill(dparams, prompt, last_index):
+                # the draft only needs its K/V prefix; its own next-token
+                # guess is irrelevant (the first emitted token comes from
+                # the TARGET prefill, and round drafting restarts from it)
+                _logits, cache_one = draft.prefill(
+                    dparams, prompt, prompt.shape[1], last_index=last_index
+                )
+                return cache_one
+
+            def draft_insert(dcache, cache_one, slot):
+                return {
+                    name: [
+                        lax.dynamic_update_slice(
+                            layer, cache_one[src][l], (slot, 0, 0, 0)
+                        )
+                        for l, layer in enumerate(dcache[name])
+                    ]
+                    for name, src in (("k", "k"), ("v", "v"))
+                }
+
+            self._draft_prefill_fn = jax.jit(draft_prefill)
+            self._draft_insert_fn = jax.jit(draft_insert, donate_argnums=(0,))
+
     # -- public api ----------------------------------------------------------
 
     def submit(
@@ -263,6 +386,11 @@ class ContinuousBatcher:
             raise ValueError("empty prompt")
         if len(tokens) >= self.max_seq:
             raise ValueError(f"prompt of {len(tokens)} exceeds max_seq {self.max_seq}")
+        if self.speculate_tokens > 0 and float(temperature) > 0.0:
+            raise ValueError(
+                "speculative decoding is greedy-exact; temperature sampling "
+                "needs a non-speculative batcher (speculate_tokens=0)"
+            )
         budget = self.max_seq - len(tokens)
         req = GenRequest(
             tokens=list(map(int, tokens)),
@@ -339,6 +467,16 @@ class ContinuousBatcher:
             self._cache, cache_one, slot, first[0], n, lane_key,
             self._cur_tok, self._pos, self._keys,
         )
+        if self.speculate_tokens > 0:
+            # the draft needs the prompt's K/V prefix too so its proposals
+            # attend over the real context
+            dcache_one = self._draft_prefill_fn(
+                self._draft_params, jnp.asarray(prompt),
+                jnp.asarray([n - 1], jnp.int32),
+            )
+            self._draft_cache = self._draft_insert_fn(
+                self._draft_cache, dcache_one, slot
+            )
         # no host read here: prefill + insert stay fully async; the first
         # token reaches the host with the next burst's sync
         self._active[slot] = _Slot(request=req)
@@ -364,6 +502,19 @@ class ContinuousBatcher:
             ):
                 self._finish(slot)
 
+    def _credit(self, s: _Slot, tokens) -> bool:
+        """Append tokens to a request; True once it is done (budget/eos —
+        the caller drops the rest of the burst's tokens for this lane)."""
+        req = s.request
+        for t in tokens:
+            s.emitted.append(int(t))
+            self.stats["tokens"] += 1
+            if len(s.emitted) >= req.max_new_tokens or (
+                req.eos_id is not None and int(t) == req.eos_id
+            ):
+                return True
+        return False
+
     def _process_burst(self, toks_dev, snapshot) -> None:
         """Credit one burst's tokens to the requests that occupied each lane
         AT DISPATCH TIME. A lane whose request already finished (and was
@@ -373,16 +524,30 @@ class ContinuousBatcher:
         for slot, (s, start) in snapshot.items():
             if self._active.get(slot) is not s:
                 continue
-            req = s.request
-            for t in host_toks[start:, slot]:
-                s.emitted.append(int(t))
-                self.stats["tokens"] += 1
-                if len(s.emitted) >= req.max_new_tokens or (
-                    req.eos_id is not None and int(t) == req.eos_id
-                ):
-                    # tokens decoded past eos in this burst are dropped
-                    # here; the lane is reclaimed by _check_done
+            self._credit(s, host_toks[start:, slot])
+        self._check_done()
+
+    def _process_spec_burst(self, start_tok_dev, toks_dev, counts_dev, snapshot, k) -> None:
+        """Spec-mode crediting: per round, a lane emitted counts[r, slot]
+        tokens (accepted drafts + the target's correction). Also tightens
+        the host position bound from worst-case (k*(gamma+1)) to actual."""
+        start_tok = np.asarray(start_tok_dev)
+        host_toks = np.asarray(toks_dev)  # [k, S, gamma+1]
+        counts = np.asarray(counts_dev)  # [k, S]
+        worst = k * (self.speculate_tokens + 1)
+        for slot, (s, start) in snapshot.items():
+            if self._active.get(slot) is not s:
+                continue
+            actual = int(counts[:, slot].sum())
+            if slot in self._pos_host:
+                self._pos_host[slot] -= worst - actual
+            done = False
+            if start == 0:
+                done = self._credit(s, [int(start_tok[slot])])
+            for r in range(k):
+                if done:
                     break
+                done = self._credit(s, host_toks[r, slot, : int(counts[r, slot])])
         self._check_done()
 
     def _loop(self) -> None:
@@ -442,10 +607,13 @@ class ContinuousBatcher:
                     k = max(1, self.steps_per_poll)
                     while k & (k - 1):  # pow2 guard for odd configs
                         k &= k - 1
+                    # per-burst worst-case position advance (spec rounds can
+                    # emit up to gamma+1 tokens each)
+                    adv = k * (self.speculate_tokens + 1 if self._spec_burst_fn else 1)
                     # attention-read bucket: the smallest 128-multiple that
                     # covers every active lane's end-of-burst position
                     # (host-tracked, no sync). One executable per bucket.
-                    hi = max(self._pos_host[i] for i in self._active) + k
+                    hi = max(self._pos_host[i] for i in self._active) + adv
                     attn_len = min(self.max_seq, -(-hi // 128) * 128)
                     # snapshot BEFORE dispatch: tokens of this burst belong to
                     # these occupants, whatever the host learns later
@@ -453,28 +621,54 @@ class ContinuousBatcher:
                     for slot, s in self._active.items():
                         snapshot[slot] = (s, 0 if s.first_pending else 1)
                         s.first_pending = False
-                        self._pos_host[slot] += k
-                    toks, self._cur_tok, self._pos, self._cache, self._keys = (
-                        self._burst_fn(
-                            self.params, self._cache, self._cur_tok, self._pos,
-                            active_dev, temps_dev, self._keys, k, attn_len,
+                        self._pos_host[slot] += adv
+                    if self._spec_burst_fn is not None:
+                        caches = {
+                            "k": self._cache["k"], "v": self._cache["v"],
+                            "dk": self._draft_cache["k"],
+                            "dv": self._draft_cache["v"],
+                        }
+                        start_tok, toks, counts, self._cur_tok, self._pos, nc = (
+                            self._spec_burst_fn(
+                                self.params, self._draft_params, caches,
+                                self._cur_tok, self._pos, active_dev, k, attn_len,
+                            )
                         )
-                    )
-                    self.stats["steps"] += k
-                    # start the device->host token copy NOW; by the time the
-                    # host reads this burst (pipeline_depth dispatches later)
-                    # the transfer has usually landed and asarray is free
-                    try:
-                        toks.copy_to_host_async()
-                    except AttributeError:  # non-jax array (test doubles)
-                        pass
-                    pending.append((toks, snapshot))
+                        self._cache = {"k": nc["k"], "v": nc["v"]}
+                        self._draft_cache = {"k": nc["dk"], "v": nc["dv"]}
+                        self.stats["steps"] += k
+                        for t in (start_tok, toks, counts):
+                            try:
+                                t.copy_to_host_async()
+                            except AttributeError:
+                                pass
+                        pending.append(("spec", (start_tok, toks, counts, snapshot, k)))
+                    else:
+                        toks, self._cur_tok, self._pos, self._cache, self._keys = (
+                            self._burst_fn(
+                                self.params, self._cache, self._cur_tok, self._pos,
+                                active_dev, temps_dev, self._keys, k, attn_len,
+                            )
+                        )
+                        self.stats["steps"] += k
+                        # start the device->host token copy NOW; by the time
+                        # the host reads this burst (pipeline_depth dispatches
+                        # later) the transfer has usually landed
+                        try:
+                            toks.copy_to_host_async()
+                        except AttributeError:  # non-jax array (test doubles)
+                            pass
+                        pending.append(("plain", (toks, snapshot)))
                 # read the oldest burst once the pipeline is full — or drain
                 # fully when there is nothing left to dispatch
                 while pending and (
                     len(pending) >= self.pipeline_depth or not self._active
                 ):
-                    self._process_burst(*pending.popleft())
+                    mode, payload = pending.popleft()
+                    if mode == "spec":
+                        self._process_spec_burst(*payload)
+                    else:
+                        self._process_burst(*payload)
         except Exception:  # noqa: BLE001 - surface scheduler death to callers
             logger.exception("continuous batcher loop died")
             # poison the batcher: the donated cache buffers are gone, a

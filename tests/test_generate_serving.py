@@ -217,6 +217,108 @@ def test_eos_equivalent_across_depths(model_and_params):
     assert outs[1] == outs[3]
 
 
+def test_speculative_exact_with_bad_draft(model_and_params):
+    """Greedy-exact speculation: even a DRAFT THAT SHARES NOTHING with the
+    target (different depth/width, different seed — near-zero acceptance)
+    must produce exactly the target's own greedy output, for every request
+    in a churning batch. The draft only sets the compute cost."""
+    model, params = model_and_params
+    draft = DecoderLM(
+        vocab_size=CFG["vocab_size"], d_model=16, n_layers=1, n_heads=2,
+        n_kv_heads=1, d_ff=32, max_seq=64, dtype="float32",
+    )
+    dparams = draft.init_params(99)
+    import jax.numpy as jnp
+
+    b = ContinuousBatcher(
+        model, params, slots=3, max_seq=64, prefill_buckets=(8, 16),
+        steps_per_poll=2, pipeline_depth=3,
+        draft_model=draft, draft_params=dparams, speculate_tokens=3,
+    )
+    try:
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(0, 256, n).tolist() for n in (3, 9, 5, 12, 4)]
+        futures = [b.submit(p, max_new_tokens=m) for p, m in zip(prompts, (7, 4, 10, 3, 8))]
+        results = [f.result(timeout=120) for f in futures]
+        for p, m, got in zip(prompts, (7, 4, 10, 3, 8), results):
+            exp = np.asarray(
+                model.generate(params, jnp.asarray([p], jnp.int32), m)
+            )[0].tolist()
+            assert got == exp
+    finally:
+        b.close()
+
+
+def test_speculative_self_draft_and_eos(model_and_params):
+    """Draft == target: every proposal accepted (the acceptance fast path)
+    and eos still stops the output exactly where plain decode does."""
+    model, params = model_and_params
+    import jax.numpy as jnp
+
+    b = ContinuousBatcher(
+        model, params, slots=2, max_seq=64, prefill_buckets=(8,),
+        steps_per_poll=2, draft_model=model, draft_params=params,
+        speculate_tokens=4,
+    )
+    try:
+        prompt = [3, 17, 42]
+        full = b.generate(prompt, max_new_tokens=20)
+        exp = np.asarray(
+            model.generate(params, jnp.asarray([prompt], jnp.int32), 20)
+        )[0].tolist()
+        assert full == exp
+        eos = full[len(prompt) + 3]
+        stopped = b.generate(prompt, max_new_tokens=20, eos_id=eos)
+        assert stopped == full[: len(prompt) + 4]
+        # full self-acceptance: far fewer target rounds than tokens
+        assert b.stats["tokens"] > b.stats["steps"]
+    finally:
+        b.close()
+
+
+def test_speculative_rejects_temperature(model_and_params):
+    model, params = model_and_params
+    b = ContinuousBatcher(
+        model, params, slots=2, max_seq=64, prefill_buckets=(8,),
+        draft_model=model, draft_params=params, speculate_tokens=2,
+    )
+    try:
+        with pytest.raises(ValueError, match="greedy-exact"):
+            b.submit([1, 2, 3], temperature=0.8)
+    finally:
+        b.close()
+
+
+def test_generateserver_self_draft_speculation(tmp_path):
+    """GenerateServer speculation config surface: draft_layers builds an
+    early-exit self-draft and the served output equals the plain server's."""
+    import json
+
+    from seldon_core_tpu.servers.generateserver import GenerateServer
+
+    d = tmp_path / "llm"
+    d.mkdir()
+    (d / "jax_config.json").write_text(
+        json.dumps({"family": "llm", "config": CFG})
+    )
+    plain = GenerateServer(model_uri=str(d), slots=2, steps_per_poll=2)
+    spec = GenerateServer(
+        model_uri=str(d), slots=2, steps_per_poll=2,
+        speculate_tokens=3, draft_layers=1,
+    )
+    try:
+        body = {"prompt_tokens": [[5, 17, 42]], "max_new_tokens": 8}
+        out_plain = plain.predict(dict(body), [])
+        out_spec = spec.predict(dict(body), [])
+        assert out_plain["tokens"] == out_spec["tokens"]
+        assert spec.batcher.speculate_tokens == 3
+    finally:
+        if plain.batcher:
+            plain.batcher.close()
+        if spec.batcher:
+            spec.batcher.close()
+
+
 def test_moe_model_through_batcher(model_and_params):
     """A mixture-of-experts DecoderLM decodes through the scheduler's
     list-cache path identically to the model's own generate()."""
