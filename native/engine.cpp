@@ -18,7 +18,7 @@
 //   * meta merge: puid, requestPath, routing, tags
 //     (reference: PredictiveUnitBean.java:354-372)
 //   * /api/v0.1|v1.0/predictions, /ping /live /ready /pause /unpause,
-//     /metrics (Prometheus text)
+//     /inflight (drain probe), /metrics (Prometheus text)
 //   * binary protobuf front: Content-Type application/x-protobuf bodies
 //     carry SeldonMessage bytes — raw tensors cross the native hop as
 //     bytes, not base64-inside-JSON (the zero-copy encoding's native
@@ -383,6 +383,10 @@ struct Engine {
   Unit root;
   std::string deployment = "default";
   std::atomic<bool> paused{false};
+  // live requests across all worker threads: orchestrators poll /inflight
+  // after /pause for an exact rolling-update drain (matches the Python
+  // engine's probe; reference preStop was a blind 10s sleep)
+  std::atomic<int64_t> inflight{0};
   Metrics metrics;
   int port = 8000;
   int threads = 1;
@@ -1205,8 +1209,15 @@ static std::string proto_error_bytes(int code, const std::string& info) {
   return out;
 }
 
+struct InflightGuard {
+  std::atomic<int64_t>& n;
+  explicit InflightGuard(std::atomic<int64_t>& n_) : n(n_) { n.fetch_add(1); }
+  ~InflightGuard() { n.fetch_sub(1); }
+};
+
 static void handle_predictions(Engine& eng, RequestCtx& ctx, const std::string& body,
                                std::string& out, bool binary = false) {
+  InflightGuard guard(eng.inflight);
   auto t0 = std::chrono::steady_clock::now();
   json::Value msg;
   std::string reply_enc;
@@ -1392,6 +1403,10 @@ static bool process_buffer(Engine& eng, Conn& c, std::mt19937& rng,
     } else if (path == "/unpause") {
       eng.paused.store(false);
       http_response(c.out, 200, "{\"status\":\"ok\"}");
+    } else if (path == "/inflight") {
+      http_response(c.out, 200,
+                    "{\"inflight\":" + std::to_string(eng.inflight.load()) +
+                        ",\"paused\":" + (eng.paused.load() ? "true" : "false") + "}");
     } else if (path == "/metrics" || path == "/prometheus") {
       http_response(c.out, 200, prometheus_text(eng), "text/plain; version=0.0.4");
     } else if (binary) {

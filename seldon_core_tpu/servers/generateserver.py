@@ -193,8 +193,18 @@ class GenerateServer(SeldonComponent):
         if self.batcher is None:
             return []
         s = self.batcher.stats
-        return [
+        out = [
             {"type": "GAUGE", "key": "gen_tokens_total", "value": float(s["tokens"])},
             {"type": "GAUGE", "key": "gen_steps_total", "value": float(s["steps"])},
             {"type": "GAUGE", "key": "gen_finished_total", "value": float(s["finished"])},
         ]
+        if s.get("spec_rounds"):
+            out.append(
+                {
+                    "type": "GAUGE",
+                    "key": "gen_spec_tokens_per_round",
+                    # 1.0 = nothing accepted, gamma+1 = every draft accepted
+                    "value": round(s["spec_emitted"] / s["spec_rounds"], 4),
+                }
+            )
+        return out

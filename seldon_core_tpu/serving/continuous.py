@@ -137,7 +137,12 @@ class ContinuousBatcher:
         self._thread: Optional[threading.Thread] = None
         self._thread_lock = threading.Lock()
         self._started = threading.Event()
-        self.stats = {"admitted": 0, "finished": 0, "steps": 0, "tokens": 0}
+        # spec_rounds / spec_emitted feed the acceptance-rate gauge:
+        # emitted/rounds ranges 1 (nothing accepted) .. gamma+1 (all)
+        self.stats = {
+            "admitted": 0, "finished": 0, "steps": 0, "tokens": 0,
+            "spec_rounds": 0, "spec_emitted": 0,
+        }
 
         # -- device state ----------------------------------------------------
         # The persistent KV cache lives UNSTACKED: per-layer [S, KV, T, Dh]
@@ -535,6 +540,11 @@ class ContinuousBatcher:
         host_toks = np.asarray(toks_dev)  # [k, S, gamma+1]
         counts = np.asarray(counts_dev)  # [k, S]
         worst = k * (self.speculate_tokens + 1)
+        # acceptance telemetry over ALL lanes that ran rounds (device-true,
+        # independent of host-side crediting cutoffs)
+        ran = counts > 0
+        self.stats["spec_rounds"] += int(ran.sum())
+        self.stats["spec_emitted"] += int(counts.sum())
         for slot, (s, start) in snapshot.items():
             if self._active.get(slot) is not s:
                 continue

@@ -151,6 +151,12 @@ def test_error_paths(built):
         urllib.request.urlopen(f"http://127.0.0.1:{port}/unpause", timeout=5)
         status, _ = post(port, "/api/v0.1/predictions", {"data": {"ndarray": [[1]]}})
         assert status == 200
+        # drain probe: idle engine reports zero in-flight + pause state
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/inflight", timeout=5
+        ) as r:
+            body = json.loads(r.read())
+        assert body == {"inflight": 0, "paused": False}
 
 
 def test_native_engine_fronts_python_microservice(built):
