@@ -490,10 +490,10 @@ def bench_bert_grpc(
 def bench_generate(
     root: str,
     seconds: float = 8.0,
-    concurrency: int = 32,
+    concurrency: int = 64,
     prompt_len: int = 32,
     max_new_tokens: int = 32,
-    slots: int = 16,
+    slots: int = 32,
     steps_per_poll: int = 16,
     config: Optional[Dict[str, Any]] = None,
     peak: Optional[float] = None,
