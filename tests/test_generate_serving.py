@@ -342,6 +342,47 @@ def test_moe_model_through_batcher(model_and_params):
         b.close()
 
 
+def test_scheduler_death_fails_all_waiters(model_and_params):
+    """A device fault mid-burst must fail every in-flight AND queued
+    request promptly (not hang futures), poison the batcher, and reject
+    later submits — the donated cache is gone, a silent relaunch would
+    compute on invalidated buffers."""
+    model, params = model_and_params
+    b = ContinuousBatcher(
+        model, params, slots=2, max_seq=64, prefill_buckets=(8,), steps_per_poll=2
+    )
+    try:
+        b.generate([1, 2], max_new_tokens=2)  # warm, loop running
+
+        def boom(*a, **kw):
+            raise RuntimeError("synthetic device fault")
+
+        b._burst_fn = boom
+        # the scheduler may die (and poison the batcher) while we are
+        # still submitting — a late submit is then ALLOWED to raise
+        # directly instead of returning a doomed future
+        futures = []
+        for _ in range(4):
+            try:
+                futures.append(b.submit([3, 4, 5], max_new_tokens=8))
+            except RuntimeError as e:
+                assert "closed" in str(e) or "died" in str(e)
+        for f in futures:
+            with pytest.raises(RuntimeError, match="batcher died|closed"):
+                f.result(timeout=30)
+        # poisoned for good: later submits are rejected up front
+        for _ in range(100):
+            if b._stop.is_set():
+                break
+            import time as _time
+
+            _time.sleep(0.05)
+        with pytest.raises(RuntimeError, match="closed"):
+            b.submit([1, 2, 3])
+    finally:
+        b.close()
+
+
 def test_submit_after_close_raises(model_and_params):
     model, params = model_and_params
     b = ContinuousBatcher(model, params, slots=2, max_seq=64, prefill_buckets=(8,))

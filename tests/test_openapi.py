@@ -1,0 +1,70 @@
+"""OpenAPI documents (reference: openapi/engine.oas3.json,
+openapi/wrapper.oas3.json): generated from the schema table and served
+live at /openapi.json so they cannot drift from the real routes."""
+
+import asyncio
+import json
+
+import numpy as np
+
+from seldon_core_tpu.openapi import engine_spec, wrapper_spec
+
+
+def test_engine_spec_shape():
+    doc = engine_spec()
+    assert doc["openapi"].startswith("3.")
+    assert "/api/v0.1/predictions" in doc["paths"]
+    assert "/api/v0.1/feedback" in doc["paths"]
+    assert "/inflight" in doc["paths"]
+    schema = doc["components"]["schemas"]["SeldonMessage"]
+    assert "raw" in schema["properties"]["data"]["properties"]
+    json.dumps(doc)  # must be serializable
+
+
+def test_wrapper_spec_shape():
+    doc = wrapper_spec()
+    for path in ("/predict", "/route", "/aggregate", "/send-feedback", "/explain"):
+        assert path in doc["paths"], path
+    json.dumps(doc)
+
+
+def test_reconcile_tracks_real_routes():
+    """The served document drops paths the server doesn't register and
+    surfaces undocumented routes — no silent drift in either direction."""
+    doc = engine_spec(served_paths={"/api/v0.1/predictions", "/made-up"})
+    assert set(doc["paths"]) == {"/api/v0.1/predictions", "/made-up"}
+    assert "undocumented" in doc["paths"]["/made-up"]["post"]["summary"]
+
+
+def test_engine_serves_openapi():
+    from seldon_core_tpu.graph.service import EngineApp
+    from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
+    from seldon_core_tpu.http_server import Request
+
+    spec = default_predictor(
+        PredictorSpec.from_dict(
+            {"name": "d", "graph": {"name": "m", "implementation": "SIMPLE_MODEL"}}
+        )
+    )
+    app = EngineApp(spec)
+    resp = asyncio.run(
+        app.rest_app()._dispatch(Request("GET", "/openapi.json", "", {}, b""))
+    )
+    doc = json.loads(resp.body)
+    assert "/api/v0.1/predictions" in doc["paths"]
+    asyncio.run(app.executor.close())
+
+
+def test_wrapper_serves_openapi():
+    from seldon_core_tpu.http_server import Request
+    from seldon_core_tpu.user_model import SeldonComponent
+    from seldon_core_tpu.wrapper import get_rest_microservice
+
+    class M(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return np.asarray(X)
+
+    app = get_rest_microservice(M())
+    resp = asyncio.run(app._dispatch(Request("GET", "/openapi.json", "", {}, b"")))
+    doc = json.loads(resp.body)
+    assert "/predict" in doc["paths"]
