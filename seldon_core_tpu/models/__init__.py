@@ -16,6 +16,7 @@ _FAMILIES: Dict[str, str] = {
     "resnet50": "seldon_core_tpu.models.resnet.ResNet50",
     "bert": "seldon_core_tpu.models.bert.BertClassifier",
     "llm": "seldon_core_tpu.models.llm.DecoderLM",
+    "vit": "seldon_core_tpu.models.vit.ViTClassifier",
 }
 
 

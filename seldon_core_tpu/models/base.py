@@ -50,3 +50,16 @@ class ServedModel:
         sequence of ``seq_len`` tokens). Used by the benchmark tier to
         report MFU against the chip's peak; ``None`` means unknown."""
         return None
+
+
+def layer_norm(x, scale, bias, eps: float):
+    """Shared LayerNorm: f32 statistics, result cast back to x.dtype.
+    One implementation for every encoder family (BERT eps=1e-12,
+    ViT eps=1e-6) so numerics can't drift between them."""
+    import jax.numpy as jnp
+
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    out = (x32 - mu) * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (out * scale + bias).astype(x.dtype)

@@ -36,13 +36,9 @@ class BertConfig:
 
 
 def _layer_norm(x, scale, bias, eps=1e-12):
-    import jax.numpy as jnp
+    from .base import layer_norm
 
-    x32 = x.astype(jnp.float32)
-    mu = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.var(x32, axis=-1, keepdims=True)
-    out = (x32 - mu) * jnp.reciprocal(jnp.sqrt(var + eps))
-    return (out * scale + bias).astype(x.dtype)
+    return layer_norm(x, scale, bias, eps)
 
 
 class BertClassifier(ServedModel):

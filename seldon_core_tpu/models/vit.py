@@ -1,0 +1,186 @@
+"""ViT (vision transformer) image classifier family.
+
+No direct reference counterpart (the reference served vision models
+through TFServing/Triton blobs — integrations/tfserving/TfServingProxy.py);
+this extends the zoo's vision coverage beyond ResNet-50 with the
+architecture modern image serving actually deploys. ViT-B/16 defaults.
+
+TPU-first notes: patchify is ONE conv (= a [P*P*3, D] matmul on the MXU),
+the encoder is pre-LN with GELU FFN stacked + `lax.scan` like BERT, and
+attention over the fixed patch grid (197 tokens at 224^2/16) is dense
+bf16 — no masking, perfectly shaped for XLA. TP sharding shares the
+BERT/DecoderLM rule: heads + FFN columns over the mesh's `model` axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import numpy as np
+
+from .base import ServedModel
+
+
+@dataclasses.dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    num_classes: int = 1000
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+    @property
+    def n_patches(self):
+        return (self.image_size // self.patch_size) ** 2
+
+
+def _layer_norm(x, scale, bias, eps=1e-6):
+    from .base import layer_norm
+
+    return layer_norm(x, scale, bias, eps)
+
+
+class ViTClassifier(ServedModel):
+    def __init__(self, **config):
+        fields = {f.name for f in dataclasses.fields(ViTConfig)}
+        self.cfg = ViTConfig(**{k: v for k, v in config.items() if k in fields})
+        if self.cfg.image_size % self.cfg.patch_size:
+            raise ValueError(
+                f"image_size {self.cfg.image_size} must tile by patch_size "
+                f"{self.cfg.patch_size}"
+            )
+        self.example_input_shape = (self.cfg.image_size, self.cfg.image_size, 3)
+        self.compute_dtype = self.cfg.dtype
+
+    # -- params ---------------------------------------------------------
+
+    def init_params(self, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        D, L, F = cfg.d_model, cfg.n_layers, cfg.d_ff
+        P = cfg.patch_size
+        keys = iter(jax.random.split(jax.random.PRNGKey(seed), 16))
+
+        def init(shape, scale=0.02):
+            return jax.random.normal(next(keys), shape, jnp.float32) * scale
+
+        return {
+            "patch_embed": {"w": init((P * P * 3, D)), "b": jnp.zeros((D,))},
+            "cls_token": init((1, 1, D)),
+            "pos_embed": init((cfg.n_patches + 1, D)),
+            "blocks": {
+                "ln1_scale": jnp.ones((L, D)),
+                "ln1_bias": jnp.zeros((L, D)),
+                "wq": init((L, D, D)),
+                "wq_b": jnp.zeros((L, D)),
+                "wk": init((L, D, D)),
+                "wk_b": jnp.zeros((L, D)),
+                "wv": init((L, D, D)),
+                "wv_b": jnp.zeros((L, D)),
+                "wo": init((L, D, D)),
+                "wo_b": jnp.zeros((L, D)),
+                "ln2_scale": jnp.ones((L, D)),
+                "ln2_bias": jnp.zeros((L, D)),
+                "w1": init((L, D, F)),
+                "w1_b": jnp.zeros((L, F)),
+                "w2": init((L, F, D)),
+                "w2_b": jnp.zeros((L, D)),
+            },
+            "ln_f": {"scale": jnp.ones((D,)), "bias": jnp.zeros((D,))},
+            "head": {"w": init((D, cfg.num_classes)), "b": jnp.zeros((cfg.num_classes,))},
+        }
+
+    # -- forward --------------------------------------------------------
+
+    def apply(self, params, x):
+        """x [B, H, W, 3] (uint8 or float, any scale) -> logits [B, classes]."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        B = x.shape[0]
+        P = cfg.patch_size
+        g = cfg.image_size // P
+        # patchify as one reshape + matmul (the conv-free MXU form):
+        # [B,H,W,3] -> [B, g, P, g, P, 3] -> [B, g*g, P*P*3]
+        x = x.astype(dt)
+        x = x.reshape(B, g, P, g, P, 3).transpose(0, 1, 3, 2, 4, 5)
+        x = x.reshape(B, g * g, P * P * 3)
+        x = x @ params["patch_embed"]["w"].astype(dt) + params["patch_embed"]["b"].astype(dt)
+        cls = jnp.broadcast_to(params["cls_token"].astype(dt), (B, 1, cfg.d_model))
+        x = jnp.concatenate([cls, x], axis=1)  # [B, N+1, D]
+        x = x + params["pos_embed"].astype(dt)[None]
+        T = x.shape[1]
+        H, Dh = cfg.n_heads, cfg.head_dim
+
+        def block(x, p):
+            h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+            q = (h @ p["wq"].astype(dt) + p["wq_b"].astype(dt)).reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
+            k = (h @ p["wk"].astype(dt) + p["wk_b"].astype(dt)).reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
+            v = (h @ p["wv"].astype(dt) + p["wv_b"].astype(dt)).reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
+            s = lax.dot_general(
+                q, k, (((3,), (3,)), ((0, 1), (0, 1))),
+                preferred_element_type=jnp.float32,
+            ) / np.sqrt(Dh)
+            a = jax.nn.softmax(s, axis=-1).astype(dt)
+            o = lax.dot_general(
+                a, v, (((3,), (2,)), ((0, 1), (0, 1))),
+                preferred_element_type=jnp.float32,
+            ).astype(dt)
+            o = o.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
+            x = x + (o @ p["wo"].astype(dt) + p["wo_b"].astype(dt))
+            h2 = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+            f = jax.nn.gelu(h2 @ p["w1"].astype(dt) + p["w1_b"].astype(dt), approximate=False)
+            return x + (f @ p["w2"].astype(dt) + p["w2_b"].astype(dt)), None
+
+        x, _ = lax.scan(block, x, params["blocks"])
+        cls_out = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])[:, 0]
+        return (
+            cls_out.astype(jnp.float32) @ params["head"]["w"] + params["head"]["b"]
+        )
+
+    # -- analytics / sharding ------------------------------------------
+
+    def flops_per_row(self, *_a) -> float:
+        cfg = self.cfg
+        T = cfg.n_patches + 1
+        D, F = cfg.d_model, cfg.d_ff
+        per_token = cfg.n_layers * (8.0 * D * D + 4.0 * T * D + 4.0 * D * F)
+        patchify = 2.0 * cfg.n_patches * (cfg.patch_size**2 * 3) * D
+        return T * per_token + patchify + 2.0 * D * cfg.num_classes
+
+    def input_sharding(self, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        data_ax = "data" if "data" in mesh.axis_names else None
+        return NamedSharding(mesh, P(data_ax, None, None, None))
+
+    def param_sharding(self, mesh, params):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if "model" not in mesh.axis_names:
+            repl = NamedSharding(mesh, P())
+            return jax.tree_util.tree_map(lambda _: repl, params)
+
+        def spec_for(path, leaf):
+            name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+            if name in ("wq", "wk", "wv", "w1"):
+                return NamedSharding(mesh, P(None, None, "model"))
+            if name in ("wo", "w2"):
+                return NamedSharding(mesh, P(None, "model", None))
+            return NamedSharding(mesh, P())
+
+        return jax.tree_util.tree_map_with_path(spec_for, params)

@@ -88,13 +88,26 @@ FEEDBACK_SCHEMA: Dict[str, Any] = {
     },
 }
 
+MESSAGE_LIST_SCHEMA: Dict[str, Any] = {
+    "type": "object",
+    "description": "SeldonMessageList: combiner input (one message per child branch).",
+    "properties": {
+        "seldonMessages": {
+            "type": "array",
+            "items": {"$ref": "#/components/schemas/SeldonMessage"},
+        }
+    },
+}
 
-def _message_op(summary: str, tag: str) -> Dict[str, Any]:
+
+def _message_op(
+    summary: str, tag: str, request_schema: str = "SeldonMessage"
+) -> Dict[str, Any]:
     body = {
         "required": True,
         "content": {
             "application/json": {
-                "schema": {"$ref": "#/components/schemas/SeldonMessage"}
+                "schema": {"$ref": f"#/components/schemas/{request_schema}"}
             },
             "application/x-protobuf": {
                 "schema": {"type": "string", "format": "binary"}
@@ -139,6 +152,7 @@ def _base(title: str, description: str) -> Dict[str, Any]:
             "schemas": {
                 "SeldonMessage": SELDON_MESSAGE_SCHEMA,
                 "Feedback": FEEDBACK_SCHEMA,
+                "SeldonMessageList": MESSAGE_LIST_SCHEMA,
             }
         },
     }
@@ -154,9 +168,14 @@ def _reconcile(doc: Dict[str, Any], served_paths) -> Dict[str, Any]:
     served = set(served_paths)
     doc["paths"] = {p: op for p, op in doc["paths"].items() if p in served}
     for p in sorted(served - set(doc["paths"])):
-        doc["paths"][p] = {
-            "post": _message_op(f"(undocumented route {p})", "extra")
+        # method/shape unknown: advertise both verbs with no required body
+        # rather than inventing a POST-only SeldonMessage contract
+        unknown = {
+            "summary": f"(undocumented route {p})",
+            "tags": ["extra"],
+            "responses": {"200": {"description": "see server source"}},
         }
+        doc["paths"][p] = {"get": dict(unknown), "post": dict(unknown)}
     return doc
 
 
@@ -207,18 +226,18 @@ def wrapper_spec(served_paths=None) -> Dict[str, Any]:
         "the gRPC services mirror these one-to-one).",
     )
     doc["paths"] = {
-        path: {"post": _message_op(summary, "component")}
-        for path, summary in [
-            ("/predict", "Model predict"),
-            ("/api/v0.1/predictions", "Model predict"),
-            ("/api/v1.0/predictions", "Model predict"),
-            ("/transform-input", "Input transformer"),
-            ("/transform-output", "Output transformer"),
-            ("/route", "Router: pick a child branch"),
-            ("/aggregate", "Combiner: merge child outputs"),
-            ("/send-feedback", "Reward feedback"),
-            ("/explain", "Explanation (integrated gradients)"),
-            ("/api/v1.0/explain", "Explanation (integrated gradients)"),
+        path: {"post": _message_op(summary, "component", request_schema=schema)}
+        for path, summary, schema in [
+            ("/predict", "Model predict", "SeldonMessage"),
+            ("/api/v0.1/predictions", "Model predict", "SeldonMessage"),
+            ("/api/v1.0/predictions", "Model predict", "SeldonMessage"),
+            ("/transform-input", "Input transformer", "SeldonMessage"),
+            ("/transform-output", "Output transformer", "SeldonMessage"),
+            ("/route", "Router: pick a child branch", "SeldonMessage"),
+            ("/aggregate", "Combiner: merge child outputs", "SeldonMessageList"),
+            ("/send-feedback", "Reward feedback", "Feedback"),
+            ("/explain", "Explanation (integrated gradients)", "SeldonMessage"),
+            ("/api/v1.0/explain", "Explanation (integrated gradients)", "SeldonMessage"),
         ]
     }
     doc["paths"]["/health/status"] = {

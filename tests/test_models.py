@@ -56,3 +56,84 @@ def test_bert_tp_sharding_specs():
     p_sharded = jax.device_put(p, shardings)
     logits = jax.jit(m.apply)(p_sharded, jnp.ones((4, 8), jnp.int32))
     assert logits.shape == (4, 2)
+
+
+def test_vit_forward_and_patch_equivalence():
+    m = models.build(
+        "vit", image_size=32, patch_size=8, d_model=32, n_layers=2,
+        n_heads=4, d_ff=64, num_classes=5, dtype="float32",
+    )
+    p = m.init_params(0)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(2, 32, 32, 3), jnp.float32)
+    logits = jax.jit(m.apply)(p, x)
+    assert logits.shape == (2, 5)
+    assert np.isfinite(np.asarray(logits)).all()
+    # uint8 input takes the same path (serving's raw image encoding)
+    xu8 = jnp.asarray(rng.randint(0, 256, (2, 32, 32, 3)), jnp.uint8)
+    logits_u8 = jax.jit(m.apply)(p, xu8)
+    assert logits_u8.shape == (2, 5)
+    # the patchify reshape must agree with an explicit per-patch gather
+    g, P = 32 // 8, 8
+    xh = np.asarray(x)
+    patches = np.stack(
+        [
+            xh[:, i * P:(i + 1) * P, j * P:(j + 1) * P, :].reshape(2, -1)
+            for i in range(g) for j in range(g)
+        ],
+        axis=1,
+    )
+    emb_manual = patches @ np.asarray(p["patch_embed"]["w"]) + np.asarray(
+        p["patch_embed"]["b"]
+    )
+    xp = xh.reshape(2, g, P, g, P, 3).transpose(0, 1, 3, 2, 4, 5).reshape(2, g * g, -1)
+    emb_reshape = xp @ np.asarray(p["patch_embed"]["w"]) + np.asarray(
+        p["patch_embed"]["b"]
+    )
+    np.testing.assert_allclose(emb_manual, emb_reshape, atol=1e-5)
+    # non-tiling patch size rejected at build
+    with pytest.raises(ValueError, match="tile"):
+        models.build("vit", image_size=30, patch_size=8)
+
+
+def test_vit_tp_sharding_specs():
+    from seldon_core_tpu.parallel import make_mesh
+
+    m = models.build(
+        "vit", image_size=16, patch_size=8, d_model=32, n_layers=2,
+        n_heads=4, d_ff=64, num_classes=4, dtype="float32",
+    )
+    p = m.init_params(0)
+    mesh = make_mesh({"data": 2, "model": 4})
+    p_sharded = jax.device_put(p, m.param_sharding(mesh, p))
+    x = jnp.ones((4, 16, 16, 3), jnp.float32)
+    logits = jax.jit(m.apply)(p_sharded, x)
+    assert logits.shape == (4, 4)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_vit_serves_through_jaxserver(tmp_path):
+    import json as _json
+
+    from seldon_core_tpu.servers.jaxserver import JAXServer
+
+    d = tmp_path / "vit"
+    d.mkdir()
+    (d / "jax_config.json").write_text(
+        _json.dumps(
+            {
+                "family": "vit",
+                "config": {
+                    "image_size": 16, "patch_size": 8, "d_model": 32,
+                    "n_layers": 1, "n_heads": 2, "d_ff": 64,
+                    "num_classes": 3, "dtype": "float32",
+                },
+            }
+        )
+    )
+    s = JAXServer(model_uri=str(d))
+    s.load()
+    img = np.random.RandomState(0).randint(0, 256, (2, 16, 16, 3), dtype=np.uint8)
+    out = np.asarray(s.predict(img, []))
+    assert out.shape == (2, 3)
+    assert np.isfinite(out).all()
