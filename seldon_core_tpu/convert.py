@@ -14,10 +14,12 @@ Supported families:
   * Llama-style decoders (``LlamaForCausalLM``) -> ``models.llm.DecoderLM``
     (GQA, SwiGLU, RoPE — same rotate-half convention, so weights map
     without permutation).
+  * ViT (``ViTForImageClassification``) -> ``models.vit.ViTClassifier``
+    (Conv2d patch projection re-laid as the patchify matmul).
 
 CLI::
 
-    seldon-tpu-export --hf <name-or-path> --family bert|llama --out DIR
+    seldon-tpu-export --hf <name-or-path> --family bert|llama|vit --out DIR
     # DIR then serves as a jaxserver/generateserver modelUri
 """
 
@@ -197,6 +199,88 @@ def convert_hf_llama(model) -> Tuple[Dict[str, Any], Dict[str, Any]]:
 
 
 # ---------------------------------------------------------------------------
+# ViT image classifier
+# ---------------------------------------------------------------------------
+
+
+def convert_hf_vit(model) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """transformers ViTForImageClassification/ViTModel ->
+    (jax_config dict, ViTClassifier params pytree)."""
+    vit = getattr(model, "vit", model)
+    hf_cfg = model.config
+    act = getattr(hf_cfg, "hidden_act", "gelu")
+    if act not in ("gelu", "gelu_python"):  # both are the exact erf gelu
+        raise ValueError(
+            f"ViTClassifier implements exact gelu; checkpoint uses "
+            f"hidden_act={act!r} — conversion would serve wrong logits"
+        )
+    layers = list(vit.encoder.layer)
+    emb = vit.embeddings
+    P = hf_cfg.patch_size
+
+    config = {
+        "image_size": hf_cfg.image_size,
+        "patch_size": P,
+        "d_model": hf_cfg.hidden_size,
+        "n_layers": hf_cfg.num_hidden_layers,
+        "n_heads": hf_cfg.num_attention_heads,
+        "d_ff": hf_cfg.intermediate_size,
+        "num_classes": getattr(hf_cfg, "num_labels", 1000),
+        "ln_eps": float(getattr(hf_cfg, "layer_norm_eps", 1e-12)),
+    }
+
+    def lin_w(linear):
+        return _t(linear.weight).T
+
+    # Conv2d patch projection [D, 3, P, P] -> matmul weight [P*P*3, D]:
+    # our patch vectors flatten (row, col, channel), i.e. permute to
+    # [kh, kw, C, D] before the reshape
+    conv = emb.patch_embeddings.projection
+    patch_w = _t(conv.weight).transpose(2, 3, 1, 0).reshape(P * P * 3, -1)
+
+    attn = lambda l: l.attention.attention if hasattr(l.attention, "attention") else l.attention  # noqa: E731
+
+    blocks = {
+        "ln1_scale": _stack(layers, lambda l: _t(l.layernorm_before.weight)),
+        "ln1_bias": _stack(layers, lambda l: _t(l.layernorm_before.bias)),
+        "wq": _stack(layers, lambda l: lin_w(attn(l).query)),
+        "wq_b": _stack(layers, lambda l: _t(attn(l).query.bias)),
+        "wk": _stack(layers, lambda l: lin_w(attn(l).key)),
+        "wk_b": _stack(layers, lambda l: _t(attn(l).key.bias)),
+        "wv": _stack(layers, lambda l: lin_w(attn(l).value)),
+        "wv_b": _stack(layers, lambda l: _t(attn(l).value.bias)),
+        "wo": _stack(layers, lambda l: lin_w(l.attention.output.dense)),
+        "wo_b": _stack(layers, lambda l: _t(l.attention.output.dense.bias)),
+        "ln2_scale": _stack(layers, lambda l: _t(l.layernorm_after.weight)),
+        "ln2_bias": _stack(layers, lambda l: _t(l.layernorm_after.bias)),
+        "w1": _stack(layers, lambda l: lin_w(l.intermediate.dense)),
+        "w1_b": _stack(layers, lambda l: _t(l.intermediate.dense.bias)),
+        "w2": _stack(layers, lambda l: lin_w(l.output.dense)),
+        "w2_b": _stack(layers, lambda l: _t(l.output.dense.bias)),
+    }
+    params: Dict[str, Any] = {
+        "patch_embed": {"w": patch_w, "b": _t(conv.bias)},
+        "cls_token": _t(emb.cls_token),
+        "pos_embed": _t(emb.position_embeddings)[0],
+        "blocks": blocks,
+        "ln_f": {
+            "scale": _t(vit.layernorm.weight),
+            "bias": _t(vit.layernorm.bias),
+        },
+    }
+    classifier = getattr(model, "classifier", None)
+    D = config["d_model"]
+    if classifier is not None and hasattr(classifier, "weight"):
+        params["head"] = {"w": _t(classifier.weight).T, "b": _t(classifier.bias)}
+    else:
+        params["head"] = {
+            "w": np.zeros((D, config["num_classes"]), np.float32),
+            "b": np.zeros((config["num_classes"],), np.float32),
+        }
+    return config, params
+
+
+# ---------------------------------------------------------------------------
 # Export to the jaxserver model-dir layout
 # ---------------------------------------------------------------------------
 
@@ -217,9 +301,13 @@ def export_model(family: str, config: Dict[str, Any], params: Dict[str, Any],
     return out_dir
 
 
-HF_FAMILIES = {"bert": convert_hf_bert, "llama": convert_hf_llama}
+HF_FAMILIES = {
+    "bert": convert_hf_bert,
+    "llama": convert_hf_llama,
+    "vit": convert_hf_vit,
+}
 # exported family names match the model-zoo registry
-ZOO_FAMILY = {"bert": "bert", "llama": "llm"}
+ZOO_FAMILY = {"bert": "bert", "llama": "llm", "vit": "vit"}
 
 
 def convert_hf(name_or_path: str, family: str, out_dir: str) -> str:
@@ -240,6 +328,20 @@ def convert_hf(name_or_path: str, family: str, out_dir: str) -> str:
                 "ForSequenceClassification checkpoint"
             )
         model = AutoModelForSequenceClassification.from_pretrained(name_or_path)
+    elif family == "vit":
+        from transformers import AutoConfig, AutoModelForImageClassification
+
+        hf_cfg = AutoConfig.from_pretrained(name_or_path)
+        archs = hf_cfg.architectures or []
+        if not any("ForImageClassification" in a for a in archs):
+            # a backbone-only checkpoint would random-init the head and
+            # serve random logits with only an HF warning
+            raise ValueError(
+                f"checkpoint {name_or_path!r} has no classification head "
+                f"(architectures={archs}); convert a ForImageClassification "
+                "checkpoint"
+            )
+        model = AutoModelForImageClassification.from_pretrained(name_or_path)
     else:
         from transformers import AutoModelForCausalLM
 

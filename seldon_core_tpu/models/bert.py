@@ -15,7 +15,10 @@ from typing import Any, Dict
 
 import numpy as np
 
-from .base import ServedModel
+from .base import ServedModel, layer_norm
+
+# BERT's canonical LayerNorm eps (convert.py refuses checkpoints that differ)
+_BERT_LN_EPS = 1e-12
 
 
 @dataclasses.dataclass
@@ -35,10 +38,7 @@ class BertConfig:
         return self.d_model // self.n_heads
 
 
-def _layer_norm(x, scale, bias, eps=1e-12):
-    from .base import layer_norm
 
-    return layer_norm(x, scale, bias, eps)
 
 
 class BertClassifier(ServedModel):
@@ -102,7 +102,7 @@ class BertClassifier(ServedModel):
             + params["pos_embed"][None, :T]
             + params["type_embed"][0][None, None]
         )
-        x = _layer_norm(x.astype(dt), params["embed_ln"]["scale"], params["embed_ln"]["bias"])
+        x = layer_norm(x.astype(dt), params["embed_ln"]["scale"], params["embed_ln"]["bias"], _BERT_LN_EPS)
         attn_bias = jnp.where(mask, 0.0, -1e30)[:, None, None, :]  # [B,1,1,T]
 
         H, Dh = cfg.n_heads, cfg.head_dim
@@ -118,11 +118,11 @@ class BertClassifier(ServedModel):
             o = jnp.einsum("bhqk,bhkd->bhqd", a, v.astype(jnp.float32)).astype(dt)
             o = o.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
             o = o @ p["wo"].astype(dt) + p["wo_b"].astype(dt)
-            x = _layer_norm(x + o, p["ln1_scale"], p["ln1_bias"])
+            x = layer_norm(x + o, p["ln1_scale"], p["ln1_bias"], _BERT_LN_EPS)
             # exact (erf) gelu — original BERT and HF checkpoints use it
             f = jax.nn.gelu(x @ p["w1"].astype(dt) + p["w1_b"].astype(dt), approximate=False)
             f = f @ p["w2"].astype(dt) + p["w2_b"].astype(dt)
-            return _layer_norm(x + f, p["ln2_scale"], p["ln2_bias"]), None
+            return layer_norm(x + f, p["ln2_scale"], p["ln2_bias"], _BERT_LN_EPS), None
 
         x, _ = lax.scan(block, x, params["blocks"])
         cls = x[:, 0]

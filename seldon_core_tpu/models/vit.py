@@ -19,7 +19,7 @@ from typing import Any, Dict
 
 import numpy as np
 
-from .base import ServedModel
+from .base import ServedModel, layer_norm
 
 
 @dataclasses.dataclass
@@ -31,6 +31,9 @@ class ViTConfig:
     n_heads: int = 12
     d_ff: int = 3072
     num_classes: int = 1000
+    # HF ViT checkpoints use 1e-12 (transformers default); 1e-6 is the
+    # original-paper value — the converter sets this from the checkpoint
+    ln_eps: float = 1e-6
     dtype: str = "bfloat16"
 
     @property
@@ -42,10 +45,7 @@ class ViTConfig:
         return (self.image_size // self.patch_size) ** 2
 
 
-def _layer_norm(x, scale, bias, eps=1e-6):
-    from .base import layer_norm
 
-    return layer_norm(x, scale, bias, eps)
 
 
 class ViTClassifier(ServedModel):
@@ -124,9 +124,10 @@ class ViTClassifier(ServedModel):
         x = x + params["pos_embed"].astype(dt)[None]
         T = x.shape[1]
         H, Dh = cfg.n_heads, cfg.head_dim
+        eps = cfg.ln_eps
 
         def block(x, p):
-            h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+            h = layer_norm(x, p["ln1_scale"], p["ln1_bias"], eps)
             q = (h @ p["wq"].astype(dt) + p["wq_b"].astype(dt)).reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
             k = (h @ p["wk"].astype(dt) + p["wk_b"].astype(dt)).reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
             v = (h @ p["wv"].astype(dt) + p["wv_b"].astype(dt)).reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
@@ -141,12 +142,12 @@ class ViTClassifier(ServedModel):
             ).astype(dt)
             o = o.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
             x = x + (o @ p["wo"].astype(dt) + p["wo_b"].astype(dt))
-            h2 = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+            h2 = layer_norm(x, p["ln2_scale"], p["ln2_bias"], eps)
             f = jax.nn.gelu(h2 @ p["w1"].astype(dt) + p["w1_b"].astype(dt), approximate=False)
             return x + (f @ p["w2"].astype(dt) + p["w2_b"].astype(dt)), None
 
         x, _ = lax.scan(block, x, params["blocks"])
-        cls_out = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])[:, 0]
+        cls_out = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"], eps)[:, 0]
         return (
             cls_out.astype(jnp.float32) @ params["head"]["w"] + params["head"]["b"]
         )
@@ -160,12 +161,6 @@ class ViTClassifier(ServedModel):
         per_token = cfg.n_layers * (8.0 * D * D + 4.0 * T * D + 4.0 * D * F)
         patchify = 2.0 * cfg.n_patches * (cfg.patch_size**2 * 3) * D
         return T * per_token + patchify + 2.0 * D * cfg.num_classes
-
-    def input_sharding(self, mesh):
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        data_ax = "data" if "data" in mesh.axis_names else None
-        return NamedSharding(mesh, P(data_ax, None, None, None))
 
     def param_sharding(self, mesh, params):
         import jax

@@ -87,6 +87,41 @@ def test_llama_conversion_matches_torch_logits():
     np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-3)
 
 
+def tiny_hf_vit():
+    cfg = transformers.ViTConfig(
+        image_size=32,
+        patch_size=8,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        intermediate_size=64,
+        num_labels=5,
+        hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+    torch.manual_seed(0)
+    model = transformers.ViTForImageClassification(cfg)
+    model.eval()
+    return model
+
+
+def test_vit_conversion_matches_torch_logits():
+    from seldon_core_tpu.convert import convert_hf_vit
+    from seldon_core_tpu.models.vit import ViTClassifier
+
+    hf = tiny_hf_vit()
+    config, params = convert_hf_vit(hf)
+    config["dtype"] = "float32"
+    ours = ViTClassifier(**config)
+
+    # HF ViT eats [B, C, H, W] float; ours eats [B, H, W, C]
+    x = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+    with torch.no_grad():
+        ref = hf(pixel_values=torch.tensor(x.transpose(0, 3, 1, 2))).logits.numpy()
+    got = np.asarray(ours.apply(params, x))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-3)
+
+
 def test_export_then_serve_via_jaxserver(tmp_path):
     """Exported dir loads through the REAL jaxserver path (storage ->
     jax_config.json -> orbax restore) and predicts the converted logits."""
