@@ -68,7 +68,7 @@ def convert_hf_bert(model) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         raise ValueError(f"unsupported position_embedding_type {pos_type!r}")
     ln_eps = float(getattr(hf_cfg, "layer_norm_eps", 1e-12))
     if abs(ln_eps - 1e-12) > 1e-15:
-        # BertClassifier._layer_norm hardcodes BERT's canonical 1e-12
+        # the BERT forward hardcodes the canonical 1e-12 (models/bert._BERT_LN_EPS)
         raise ValueError(
             f"BertClassifier uses layer_norm eps 1e-12; checkpoint uses {ln_eps}"
         )
@@ -214,6 +214,19 @@ def convert_hf_vit(model) -> Tuple[Dict[str, Any], Dict[str, Any]]:
             f"ViTClassifier implements exact gelu; checkpoint uses "
             f"hidden_act={act!r} — conversion would serve wrong logits"
         )
+    channels = int(getattr(hf_cfg, "num_channels", 3))
+    if channels != 3:
+        # the patchify reshape hardcodes RGB; a silent reshape of a
+        # grayscale conv weight would scramble the patch embedding
+        raise ValueError(
+            f"ViTClassifier expects 3 input channels; checkpoint has {channels}"
+        )
+    if not hasattr(vit, "encoder") or not hasattr(vit, "embeddings"):
+        raise ValueError(
+            f"unsupported checkpoint structure {type(model).__name__}; "
+            "convert a plain ViTForImageClassification (DeiT/Swin/ConvNeXt "
+            "layouts differ)"
+        )
     layers = list(vit.encoder.layer)
     emb = vit.embeddings
     P = hf_cfg.patch_size
@@ -333,13 +346,12 @@ def convert_hf(name_or_path: str, family: str, out_dir: str) -> str:
 
         hf_cfg = AutoConfig.from_pretrained(name_or_path)
         archs = hf_cfg.architectures or []
-        if not any("ForImageClassification" in a for a in archs):
-            # a backbone-only checkpoint would random-init the head and
-            # serve random logits with only an HF warning
+        if not any(a == "ViTForImageClassification" for a in archs):
+            # backbone-only checkpoints would random-init the head; other
+            # vision families (DeiT/Swin/ConvNeXt) have different layouts
             raise ValueError(
-                f"checkpoint {name_or_path!r} has no classification head "
-                f"(architectures={archs}); convert a ForImageClassification "
-                "checkpoint"
+                f"checkpoint {name_or_path!r} is not a plain "
+                f"ViTForImageClassification (architectures={archs})"
             )
         model = AutoModelForImageClassification.from_pretrained(name_or_path)
     else:

@@ -38,9 +38,6 @@ class BertConfig:
         return self.d_model // self.n_heads
 
 
-
-
-
 class BertClassifier(ServedModel):
     def __init__(self, **config):
         fields = {f.name for f in dataclasses.fields(BertConfig)}

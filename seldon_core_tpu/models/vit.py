@@ -45,9 +45,6 @@ class ViTConfig:
         return (self.image_size // self.patch_size) ** 2
 
 
-
-
-
 class ViTClassifier(ServedModel):
     def __init__(self, **config):
         fields = {f.name for f in dataclasses.fields(ViTConfig)}

@@ -1,0 +1,114 @@
+"""KS drift detector: statistics against scipy-free closed forms, the
+transformer graph idiom, and engine-served tags/metrics."""
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.components.drift import KSDrift, ks_statistic, ks_threshold
+
+
+def test_ks_statistic_known_values():
+    # identical samples -> 0
+    a = np.arange(100.0)
+    assert ks_statistic(a, a) == 0.0
+    # disjoint supports -> 1
+    assert ks_statistic(np.zeros(50), np.ones(50)) == 1.0
+    # half-overlapping uniform grids -> 0.5
+    assert ks_statistic(np.arange(100.0), np.arange(50.0, 150.0)) == pytest.approx(0.5)
+
+
+def test_threshold_monotone_in_p_and_n():
+    assert ks_threshold(100, 100, 0.01) > ks_threshold(100, 100, 0.10)
+    assert ks_threshold(50, 50, 0.05) > ks_threshold(500, 500, 0.05)
+
+
+def test_no_drift_on_same_distribution():
+    rng = np.random.RandomState(0)
+    det = KSDrift(reference=rng.randn(500, 3), window=200, min_window=100)
+    flagged = 0
+    for _ in range(20):
+        det.transform_input(rng.randn(20, 3), [])
+        flagged += int(det.drifted)
+    # family-wise p=0.05: same-distribution data should almost never flag
+    assert flagged <= 2
+    assert det.n_tests > 0
+
+
+def test_detects_mean_shift_in_one_feature():
+    rng = np.random.RandomState(1)
+    det = KSDrift(reference=rng.randn(500, 3), window=200, min_window=100)
+    shifted = rng.randn(200, 3)
+    shifted[:, 1] += 3.0  # one drifted feature among three
+    det.transform_input(shifted, [])
+    assert det.drifted
+    assert np.argmax(det.feature_scores) == 1
+    assert det.tags()["drift"] is True
+    assert any(m["key"] == "drift_detected" and m["value"] == 1.0 for m in det.metrics())
+
+
+def test_transform_passthrough_and_validation():
+    det = KSDrift(reference=np.random.RandomState(2).randn(50, 2))
+    X = [[1.0, 2.0], [3.0, 4.0]]
+    assert det.transform_input(X, []) is X
+    with pytest.raises(ValueError, match="feature count"):
+        det.transform_input([[1.0, 2.0, 3.0]], [])
+    with pytest.raises(RuntimeError, match="reference"):
+        KSDrift().transform_input(X, [])
+
+
+def test_state_roundtrip():
+    """to_state_dict/from_state_dict — the protocol persistence.py
+    checkpoints — round-trips the window, counters, AND the verdict."""
+    rng = np.random.RandomState(3)
+    det = KSDrift(reference=rng.randn(100, 2), window=50, min_window=10)
+    det.transform_input(rng.randn(30, 2) + 4.0, [])  # force drift
+    assert det.drifted
+    state = det.to_state_dict()
+    det2 = KSDrift(window=50, min_window=10)
+    det2.from_state_dict(state)
+    assert det2.n_tests == det.n_tests
+    assert det2.drifted  # alert state survives the restart
+    assert det2.tags()["drift"] is True
+    np.testing.assert_array_equal(det2.to_state_dict()["buffer"], state["buffer"])
+    det2.transform_input(rng.randn(5, 2), [])  # usable after restore
+
+
+def test_persistence_protocol_detected():
+    from seldon_core_tpu.persistence import _has_state_dict
+
+    assert _has_state_dict(KSDrift(reference=np.random.randn(10, 2)))
+
+
+def test_drift_transformer_in_engine_graph():
+    """Drift node ahead of a model: payload flows through, tags surface
+    the verdict in the engine response meta."""
+    import asyncio
+
+    from seldon_core_tpu.graph.service import EngineApp
+    from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
+
+    rng = np.random.RandomState(4)
+    det = KSDrift(reference=rng.randn(200, 2), window=100, min_window=20)
+    spec = default_predictor(
+        PredictorSpec.from_dict(
+            {
+                "name": "d",
+                "graph": {
+                    "name": "drift",
+                    "type": "TRANSFORMER",
+                    "children": [{"name": "m", "implementation": "SIMPLE_MODEL"}],
+                },
+            }
+        )
+    )
+    app = EngineApp(spec, registry={"drift": det})
+
+    async def go():
+        out = await app.predict(
+            {"data": {"ndarray": (rng.randn(30, 2) + 5.0).tolist()}}
+        )
+        assert out["data"]["ndarray"]  # model answered through the chain
+        assert out["meta"]["tags"]["drift"] is True
+        await app.executor.close()
+
+    asyncio.run(go())
