@@ -100,6 +100,22 @@ def _branch_index(route_response: Dict[str, Any]) -> int:
     return int(v[0])
 
 
+def _ann_seconds(ann: Dict[str, str], key: str, default_s: float) -> float:
+    """Millisecond annotation -> seconds, falling back on junk (the
+    reference logs-and-defaults too rather than failing the pod)."""
+    try:
+        return float(ann[key]) / 1000.0
+    except (KeyError, TypeError, ValueError):
+        return default_s
+
+
+def _ann_int(ann: Dict[str, str], key: str) -> Optional[int]:
+    try:
+        return int(ann[key])
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
 class GraphExecutor:
     def __init__(
         self,
@@ -128,6 +144,14 @@ class GraphExecutor:
         self.spec = spec
         self._registry = registry or {}
         self._timeout = timeout_s
+        # per-annotation unit-call tuning, the reference's feature-flag
+        # idiom (InternalPredictionService.java:82-91 reads seldon.io/
+        # rest-read-timeout, grpc-read-timeout [ms] and
+        # grpc-max-message-size [bytes] from pod annotations)
+        ann = getattr(spec, "annotations", None) or {}
+        self._rest_timeout = _ann_seconds(ann, "seldon.io/rest-read-timeout", timeout_s)
+        self._grpc_timeout = _ann_seconds(ann, "seldon.io/grpc-read-timeout", timeout_s)
+        self._grpc_max_message = _ann_int(ann, "seldon.io/grpc-max-message-size")
         self._batching = batching or {}
         self._mesh = mesh
         self._metrics = metrics
@@ -147,11 +171,14 @@ class GraphExecutor:
         transport = (unit.endpoint.transport or "INPROCESS").upper()
         if transport in ("REST", "HTTP"):
             client: UnitClient = RestClient(
-                unit.endpoint.service_host, unit.endpoint.service_port, self._timeout
+                unit.endpoint.service_host, unit.endpoint.service_port,
+                self._rest_timeout,
             )
         elif transport == "GRPC":
             client = GrpcClient(
-                unit.endpoint.service_host, unit.endpoint.grpc_port, self._timeout
+                unit.endpoint.service_host, unit.endpoint.grpc_port,
+                self._grpc_timeout,
+                max_message_bytes=self._grpc_max_message,
             )
         else:
             client = InProcessClient(self._resolve_object(unit), executor=self._pool)

@@ -257,6 +257,15 @@ class EngineApp:
     # -- gRPC front ---------------------------------------------------------
 
     def grpc_server(self, max_workers: int = 4, max_message_bytes: Optional[int] = None):
+        # the engine's own gRPC front honors seldon.io/grpc-max-message-size
+        # like the reference's SeldonGrpcServer (SeldonGrpcServer.java:40)
+        if max_message_bytes is None:
+            from .executor import _ann_int
+
+            max_message_bytes = _ann_int(
+                getattr(self.spec, "annotations", None) or {},
+                "seldon.io/grpc-max-message-size",
+            )
         """grpc.aio server registering the Seldon service
         (reference: SeldonGrpcServer.java:40-143)."""
         import grpc

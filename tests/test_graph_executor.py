@@ -308,3 +308,63 @@ def test_spec_b64_roundtrip():
     back = PredictorSpec.from_env_b64(blob)
     assert back.graph.name == "m"
     assert back.graph.endpoint.service_port == 9000
+
+
+def test_timeout_annotations_reach_unit_clients():
+    """seldon.io/rest-read-timeout / grpc-read-timeout / grpc-max-message-
+    size annotations tune the engine's unit clients (the reference's
+    InternalPredictionService.java:82-91 idiom)."""
+    from seldon_core_tpu.graph.client import GrpcClient, RestClient
+    from seldon_core_tpu.graph.executor import GraphExecutor
+    from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
+
+    spec = default_predictor(
+        PredictorSpec.from_dict(
+            {
+                "name": "t",
+                "annotations": {
+                    "seldon.io/rest-read-timeout": "2500",
+                    "seldon.io/grpc-read-timeout": "7000",
+                    "seldon.io/grpc-max-message-size": "104857600",
+                },
+                "graph": {
+                    "name": "r",
+                    "type": "MODEL",
+                    "endpoint": {
+                        "service_host": "127.0.0.1",
+                        "service_port": 19999,
+                        "transport": "REST",
+                    },
+                    "children": [
+                        {
+                            "name": "g",
+                            "type": "MODEL",
+                            "endpoint": {
+                                "service_host": "127.0.0.1",
+                                "grpc_port": 19998,
+                                "transport": "GRPC",
+                            },
+                        }
+                    ],
+                },
+            }
+        )
+    )
+    ex = GraphExecutor(spec)
+    rest = ex.root.client
+    grpc_client = ex.root.children[0].client
+    assert isinstance(rest, RestClient) and rest.timeout == 2.5
+    assert isinstance(grpc_client, GrpcClient)
+    assert grpc_client.timeout == 7.0
+    assert grpc_client.max_message_bytes == 104857600
+    asyncio.run(ex.close())
+
+
+def test_junk_timeout_annotations_fall_back():
+    from seldon_core_tpu.graph.executor import _ann_int, _ann_seconds
+
+    assert _ann_seconds({"k": "oops"}, "k", 5.0) == 5.0
+    assert _ann_seconds({}, "k", 5.0) == 5.0
+    assert _ann_seconds({"k": "1500"}, "k", 5.0) == 1.5
+    assert _ann_int({"k": "junk"}, "k") is None
+    assert _ann_int({"k": "42"}, "k") == 42
