@@ -140,8 +140,8 @@ class ContinuousBatcher:
         # spec_rounds / spec_emitted feed the acceptance-rate gauge:
         # emitted/rounds ranges 1 (nothing accepted) .. gamma+1 (all)
         self.stats = {
-            "admitted": 0, "finished": 0, "steps": 0, "tokens": 0,
-            "spec_rounds": 0, "spec_emitted": 0,
+            "admitted": 0, "finished": 0, "cancelled": 0, "steps": 0,
+            "tokens": 0, "spec_rounds": 0, "spec_emitted": 0,
         }
 
         # -- device state ----------------------------------------------------
@@ -494,7 +494,9 @@ class ContinuousBatcher:
         s = self._active.pop(slot)
         self._pos_host.pop(slot, None)
         self._masks_dirty = True
-        if not s.request.future.done():
+        if s.request.future.cancelled():
+            self.stats["cancelled"] += 1
+        elif not s.request.future.done():
             s.request.future.set_result(s.request.tokens + s.emitted)
         self.stats["finished"] += 1
 
@@ -502,6 +504,11 @@ class ContinuousBatcher:
         for slot in list(self._active):
             s = self._active[slot]
             req = s.request
+            if req.future.cancelled():
+                # caller gave up (client disconnect / deadline): reclaim the
+                # lane instead of decoding the rest of its budget for no one
+                self._finish(slot)
+                continue
             if len(s.emitted) >= req.max_new_tokens or (
                 req.eos_id is not None and s.emitted and s.emitted[-1] == req.eos_id
             ):
@@ -577,6 +584,9 @@ class ContinuousBatcher:
                         req = self._queue.get_nowait()
                     except queue.Empty:
                         break
+                    if req.future.cancelled():
+                        self.stats["cancelled"] += 1
+                        continue  # caller gave up while queued
                     free = next(i for i in range(self.slots) if i not in self._active)
                     try:
                         self._admit(free, req)

@@ -342,6 +342,39 @@ def test_moe_model_through_batcher(model_and_params):
         b.close()
 
 
+def test_cancelled_request_frees_its_lane(model_and_params):
+    """A cancelled future (client disconnect) reclaims the decode lane
+    instead of burning device time on the rest of its budget, and a
+    cancelled queued request is never admitted."""
+    import time
+
+    model, params = model_and_params
+    b = ContinuousBatcher(
+        model, params, slots=1, max_seq=64, prefill_buckets=(8,), steps_per_poll=2
+    )
+    try:
+        long_f = b.submit([1, 2, 3], max_new_tokens=50)
+        queued_f = b.submit([4, 5], max_new_tokens=4)  # waits: 1 slot
+        time.sleep(0.2)  # long request is mid-decode
+        long_f.cancel()
+        # the queued request gets the lane promptly (well before the 50
+        # tokens the cancelled one would have decoded)
+        out = queued_f.result(timeout=60)
+        assert out[:2] == [4, 5] and len(out) == 6
+        # a cancelled QUEUED request never runs
+        blocker = b.submit([1, 2], max_new_tokens=40)
+        doomed = b.submit([9, 9], max_new_tokens=4)
+        doomed.cancel()
+        blocker.result(timeout=60)
+        for _ in range(100):
+            if b.stats["cancelled"] >= 2:
+                break
+            time.sleep(0.05)
+        assert b.stats["cancelled"] >= 2
+    finally:
+        b.close()
+
+
 def test_scheduler_death_fails_all_waiters(model_and_params):
     """A device fault mid-burst must fail every in-flight AND queued
     request promptly (not hang futures), poison the batcher, and reject
