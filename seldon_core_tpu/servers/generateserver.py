@@ -14,9 +14,12 @@ Model URI layout: same ``jax_config.json`` as jaxserver with
     steps_per_poll   decode steps fused into one device burst (default 8)
     pipeline_depth   bursts in flight before the host reads the oldest
                      (default 3; 1 = synchronous)
-    speculate_tokens greedy-exact speculative decoding: draft this many
-                     tokens per round, verify with one target forward
-                     (0 = off). Needs a draft:
+    speculate_tokens speculative decoding: draft this many tokens per
+                     round, verify with one target forward (0 = off).
+                     Exact for any draft — greedy lanes reproduce the
+                     target argmax decode, temperature lanes use
+                     speculative sampling (the emitted distribution
+                     equals sampling the target). Needs a draft:
     draft_layers     early-exit self-draft — the first N layers of the
                      SERVED model propose (no second checkpoint)
     draft_uri        separate draft model dir (same vocab)

@@ -109,11 +109,13 @@ class ContinuousBatcher:
         # how many bursts may be in flight before the host reads the oldest
         # one's tokens; 1 = fully synchronous (dispatch, read, dispatch ...)
         self.pipeline_depth = max(1, int(pipeline_depth))
-        # speculative decoding (greedy-exact): a cheap draft proposes
-        # `speculate_tokens` tokens per round and ONE target chunk forward
-        # verifies them — the OUTPUT is exactly the target model's greedy
-        # decode no matter how bad the draft is (acceptance only sets how
-        # many target forwards each token costs)
+        # speculative decoding: a cheap draft proposes `speculate_tokens`
+        # tokens per round and ONE target chunk forward verifies them.
+        # Exact for any draft: greedy lanes emit the target's argmax
+        # decode; temperature lanes use speculative SAMPLING (accept with
+        # min(1, p/q), resample the residual on rejection) whose output
+        # distribution equals sampling the target. The draft only sets
+        # how many target forwards each token costs.
         self.draft_model = draft_model
         self.speculate_tokens = int(speculate_tokens) if draft_model is not None else 0
         self.prefill_buckets = tuple(
@@ -129,6 +131,7 @@ class ContinuousBatcher:
         self._masks_dirty = True
         self._active_dev = None
         self._temps_dev = None
+        self._any_stoch = False
         # host mirror of each lane's device position (prompt length at
         # admit, +k per dispatched burst) — lets the scheduler pick the
         # attention-read bucket WITHOUT a device sync
@@ -280,7 +283,7 @@ class ContinuousBatcher:
         self._insert_fn = jax.jit(insert, donate_argnums=(0,))
         self._prefill_fn = jax.jit(prefill_one)
 
-        # -- speculative executables (greedy-exact; see class docstring) ----
+        # -- speculative executables (exact; see spec_round docstring) ------
         self._spec_burst_fn = None
         self._draft_prefill_fn = None
         self._draft_insert_fn = None
@@ -288,20 +291,47 @@ class ContinuousBatcher:
             gamma = self.speculate_tokens
             draft = draft_model
 
-            def spec_round(params, dparams, ks, vs, dks, dvs, cur_tok, pos, active, attn_len):
-                """One speculation round: draft gamma greedy tokens, verify
-                with ONE target chunk forward, emit the accepted prefix + the
-                target's correction token. Returns per-lane emitted tokens
-                [S, gamma+1] (zero-padded) and counts [S]."""
+            def _lane_split(keys):
+                split = jax.vmap(jax.random.split)(keys)
+                return split[:, 0], split[:, 1]
+
+            def spec_round(
+                params, dparams, ks, vs, dks, dvs, cur_tok, pos, active,
+                temps, keys, attn_len, any_stoch,
+            ):
+                """One speculation round: the draft proposes gamma tokens,
+                ONE target chunk forward verifies, the accepted prefix + a
+                correction/bonus token are emitted.
+
+                Exactness per lane (Leviathan et al. speculative sampling):
+                  * temp == 0 — draft argmax, accept while it equals the
+                    target argmax: output IS the target's greedy decode.
+                  * temp > 0 — draft SAMPLES from q, accept d_i with prob
+                    min(1, p(d_i)/q(d_i)); on first rejection resample from
+                    norm(max(p-q, 0)); on full acceptance sample the bonus
+                    from p. The emitted distribution provably equals
+                    sampling from the target — for ANY draft.
+                Returns per-lane emitted tokens [S, gamma+1] and counts [S].
+                """
+                safe_t = jnp.maximum(temps, 1e-6)[:, None]  # [S,1]
+                stoch = (temps > 0)
                 dtok, dpos = cur_tok, pos
-                drafts = []
+                drafts, q_rows = [], []
                 for _ in range(gamma):
                     dlogits, dks, dvs = draft.decode_step_ragged_list(
                         dparams, dks, dvs, dtok[:, None], dpos, attn_len=attn_len
                     )
-                    dtok = jnp.where(
-                        active, jnp.argmax(dlogits, -1).astype(jnp.int32), 0
-                    )
+                    greedy = jnp.argmax(dlogits, -1).astype(jnp.int32)
+                    if any_stoch:
+                        keys, subs = _lane_split(keys)
+                        q_rows.append(jax.nn.softmax(dlogits / safe_t, axis=-1))
+                        sampled = jax.vmap(jax.random.categorical)(
+                            subs, dlogits / safe_t
+                        ).astype(jnp.int32)
+                        dtok = jnp.where(stoch, sampled, greedy)
+                    else:
+                        dtok = greedy
+                    dtok = jnp.where(active, dtok, 0)
                     drafts.append(dtok)
                     dpos = jnp.where(active, dpos + 1, dpos)
                 drafts_arr = jnp.stack(drafts, axis=1)  # [S, gamma]
@@ -309,11 +339,55 @@ class ContinuousBatcher:
                 tlogits, ks, vs = model.decode_chunk_ragged_list(
                     params, ks, vs, window, pos, attn_len=attn_len
                 )
-                t = jnp.argmax(tlogits, -1).astype(jnp.int32)  # [S, gamma+1]
-                match = (drafts_arr == t[:, :gamma]).astype(jnp.int32)
-                accepted = jnp.cumprod(match, axis=1).sum(axis=1)  # [S]
+                t_greedy = jnp.argmax(tlogits, -1).astype(jnp.int32)  # [S,g+1]
+                acc_greedy = drafts_arr == t_greedy[:, :gamma]
+
+                if any_stoch:
+                    q_full = jnp.stack(q_rows, axis=1)  # [S, gamma, V]
+                    p = jax.nn.softmax(tlogits / safe_t[..., None], axis=-1)
+                    # acceptance: p_{i-1}(d_i)/q_{i-1}(d_i) vs lane uniforms
+                    p_sel = jnp.take_along_axis(
+                        p[:, :gamma, :], drafts_arr[..., None], axis=2
+                    )[..., 0]  # [S, gamma]
+                    q_sel = jnp.take_along_axis(
+                        q_full, drafts_arr[..., None], axis=2
+                    )[..., 0]
+                    keys, subs = _lane_split(keys)
+                    u = jax.vmap(lambda kk: jax.random.uniform(kk, (gamma,)))(subs)
+                    acc_stoch = u < jnp.minimum(
+                        p_sel / jnp.maximum(q_sel, 1e-20), 1.0
+                    )
+                    acc = jnp.where(stoch[:, None], acc_stoch, acc_greedy)
+                else:
+                    acc = acc_greedy
+                accepted = jnp.cumprod(acc.astype(jnp.int32), axis=1).sum(axis=1)
+
+                # correction/bonus token at window index `accepted`
+                corr_greedy = jnp.take_along_axis(
+                    t_greedy, accepted[:, None], axis=1
+                )[:, 0]
+                if any_stoch:
+                    p_at_a = jnp.take_along_axis(
+                        p, accepted[:, None, None], axis=1
+                    )[:, 0]  # [S, V]
+                    a_clamp = jnp.minimum(accepted, gamma - 1)
+                    q_at_a = jnp.take_along_axis(
+                        q_full, a_clamp[:, None, None], axis=1
+                    )[:, 0]
+                    resid = jnp.maximum(p_at_a - q_at_a, 0.0)
+                    resid_sum = resid.sum(-1, keepdims=True)
+                    # numerically-empty residual (p <= q everywhere) -> p
+                    resid = jnp.where(resid_sum > 1e-12, resid, p_at_a)
+                    dist = jnp.where((accepted == gamma)[:, None], p_at_a, resid)
+                    keys, subs = _lane_split(keys)
+                    corr_sample = jax.vmap(jax.random.categorical)(
+                        subs, jnp.log(dist + 1e-30)
+                    ).astype(jnp.int32)
+                    correction = jnp.where(stoch, corr_sample, corr_greedy)
+                else:
+                    correction = corr_greedy
+
                 cols = jnp.arange(gamma + 1, dtype=jnp.int32)[None, :]
-                correction = jnp.take_along_axis(t, accepted[:, None], axis=1)[:, 0]
                 drafts_padded = jnp.concatenate(
                     [drafts_arr, jnp.zeros((self.slots, 1), jnp.int32)], axis=1
                 )
@@ -323,33 +397,38 @@ class ContinuousBatcher:
                 out = jnp.where(active[:, None], out, 0)
                 cur_tok = jnp.where(active, correction, cur_tok)
                 pos = jnp.where(active, pos + accepted + 1, pos)
-                return ks, vs, dks, dvs, cur_tok, pos, out, count
+                return ks, vs, dks, dvs, cur_tok, pos, keys, out, count
 
-            def spec_burst(params, dparams, caches, cur_tok, pos, active, k, attn_len):
-                """k speculation rounds as one executable. Returns
+            def spec_burst(
+                params, dparams, caches, cur_tok, pos, active, temps, keys,
+                k, attn_len, any_stoch,
+            ):
+                """k speculation rounds as one executable. ``any_stoch``
+                (static) compiles the greedy-only variant without the
+                q/p softmaxes + sampling when every lane is greedy. Returns
                 (start_tok [S], toks [k, S, gamma+1], counts [k, S], ...)."""
 
                 def body(carry, _):
-                    ks, vs, dks, dvs, cur_tok, pos = carry
-                    ks, vs, dks, dvs, cur_tok, pos, out, count = spec_round(
+                    ks, vs, dks, dvs, cur_tok, pos, keys = carry
+                    ks, vs, dks, dvs, cur_tok, pos, keys, out, count = spec_round(
                         params, dparams, ks, vs, dks, dvs, cur_tok, pos,
-                        active, attn_len,
+                        active, temps, keys, attn_len, any_stoch,
                     )
-                    return (ks, vs, dks, dvs, cur_tok, pos), (out, count)
+                    return (ks, vs, dks, dvs, cur_tok, pos, keys), (out, count)
 
                 start_tok = cur_tok
-                (ks, vs, dks, dvs, cur_tok, pos), (toks, counts) = lax.scan(
+                (ks, vs, dks, dvs, cur_tok, pos, keys), (toks, counts) = lax.scan(
                     body,
                     (caches["k"], caches["v"], caches["dk"], caches["dv"],
-                     cur_tok, pos),
+                     cur_tok, pos, keys),
                     None,
                     length=k,
                 )
                 new_caches = {"k": ks, "v": vs, "dk": dks, "dv": dvs}
-                return start_tok, toks, counts, cur_tok, pos, new_caches
+                return start_tok, toks, counts, cur_tok, pos, keys, new_caches
 
             self._spec_burst_fn = jax.jit(
-                spec_burst, donate_argnums=(2,), static_argnums=(6, 7)
+                spec_burst, donate_argnums=(2,), static_argnums=(8, 9, 10)
             )
 
             def draft_prefill(dparams, prompt, last_index):
@@ -391,11 +470,6 @@ class ContinuousBatcher:
             raise ValueError("empty prompt")
         if len(tokens) >= self.max_seq:
             raise ValueError(f"prompt of {len(tokens)} exceeds max_seq {self.max_seq}")
-        if self.speculate_tokens > 0 and float(temperature) > 0.0:
-            raise ValueError(
-                "speculative decoding is greedy-exact; temperature sampling "
-                "needs a non-speculative batcher (speculate_tokens=0)"
-            )
         budget = self.max_seq - len(tokens)
         req = GenRequest(
             tokens=list(map(int, tokens)),
@@ -494,9 +568,13 @@ class ContinuousBatcher:
         s = self._active.pop(slot)
         self._pos_host.pop(slot, None)
         self._masks_dirty = True
+        # `finished` counts requests that ran to completion; `cancelled`
+        # counts abandonments (queued or mid-decode) — disjoint, so
+        # finished + cancelled = all requests ever resolved
         if s.request.future.cancelled():
             self.stats["cancelled"] += 1
-        elif not s.request.future.done():
+            return
+        if not s.request.future.done():
             s.request.future.set_result(s.request.tokens + s.emitted)
         self.stats["finished"] += 1
 
@@ -614,6 +692,9 @@ class ContinuousBatcher:
                             active[i] = True
                         self._active_dev = jnp.asarray(active)
                         self._temps_dev = jnp.asarray(temps)
+                        # static flag: a greedy-only burst compiles without
+                        # the q/p softmax + sampling machinery
+                        self._any_stoch = bool((temps > 0).any())
                         self._masks_dirty = False
                     active_dev = self._active_dev
                     temps_dev = self._temps_dev
@@ -648,11 +729,13 @@ class ContinuousBatcher:
                             "dk": self._draft_cache["k"],
                             "dv": self._draft_cache["v"],
                         }
-                        start_tok, toks, counts, self._cur_tok, self._pos, nc = (
-                            self._spec_burst_fn(
-                                self.params, self._draft_params, caches,
-                                self._cur_tok, self._pos, active_dev, k, attn_len,
-                            )
+                        (
+                            start_tok, toks, counts, self._cur_tok, self._pos,
+                            self._keys, nc,
+                        ) = self._spec_burst_fn(
+                            self.params, self._draft_params, caches,
+                            self._cur_tok, self._pos, active_dev, temps_dev,
+                            self._keys, k, attn_len, self._any_stoch,
                         )
                         self._cache = {"k": nc["k"], "v": nc["v"]}
                         self._draft_cache = {"k": nc["dk"], "v": nc["dv"]}
