@@ -479,7 +479,16 @@ def proto_to_json(msg) -> JsonDict:
             },
         }
         return out
-    return json_format.MessageToDict(msg)
+    out = json_format.MessageToDict(msg)
+    # proto json_data is a STRING field; the JSON-side convention (REST
+    # bodies, unit hooks) is the decoded structure — decode here so the
+    # gRPC front hands units the same shape the REST front does
+    if isinstance(out.get("jsonData"), str):
+        try:
+            out["jsonData"] = json.loads(out["jsonData"])
+        except ValueError as e:
+            raise PayloadError(f"malformed jsonData payload: {e}") from e
+    return out
 
 
 def json_to_proto(body: JsonDict, msg_cls=pb.SeldonMessage):
@@ -525,6 +534,14 @@ def json_to_proto(body: JsonDict, msg_cls=pb.SeldonMessage):
         msg.data.raw.shape.extend(int(s) for s in raw.get("shape", ()))
         msg.data.raw.data = bytes(raw["data"])
         return msg
+    if (
+        msg_cls is pb.SeldonMessage
+        and "jsonData" in body
+        and not isinstance(body["jsonData"], (str, type(None)))
+    ):
+        # inverse of proto_to_json's decode: the structured payload goes
+        # back into the proto's string field
+        body = {**body, "jsonData": json.dumps(body["jsonData"])}
     msg = msg_cls()
     try:
         # jsonable() base64-encodes any interior bytes the fast paths above

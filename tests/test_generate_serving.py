@@ -618,3 +618,41 @@ def test_long_prompt_spans_seq_shards(model_and_params):
         assert "model" in spec and "seq" in spec
     finally:
         b.close()
+
+
+def test_engine_grpc_generate_e2e(tmp_path):
+    """generate() over the engine's gRPC front: jsonData prompts in a
+    SeldonMessage through Seldon/Predict, tokens back — the reference's
+    gRPC external API shape carrying the TPU-native generate payload."""
+    import grpc
+
+    from seldon_core_tpu.modelbench import EngineHarness
+    from seldon_core_tpu.proto import prediction_pb2 as pb
+    from seldon_core_tpu.proto.services import method_path
+    from seldon_core_tpu.servers.generateserver import GenerateServer
+
+    d = tmp_path / "llm"
+    d.mkdir()
+    (d / "jax_config.json").write_text(json.dumps({"family": "llm", "config": CFG}))
+    component = GenerateServer(model_uri=str(d), slots=2, steps_per_poll=2)
+    component.load()
+    harness = EngineHarness(component).start()
+    try:
+        request = pb.SeldonMessage(
+            json_data=json.dumps(
+                {"prompt_tokens": [[5, 17, 42]], "max_new_tokens": 6}
+            )
+        ).SerializeToString()
+        with grpc.insecure_channel(f"127.0.0.1:{harness.grpc_port}") as ch:
+            rpc = ch.unary_unary(
+                method_path("Seldon", "Predict"),
+                request_serializer=lambda b: b,
+                response_deserializer=pb.SeldonMessage.FromString,
+            )
+            out = rpc(request, timeout=120.0)
+        toks = json.loads(out.json_data)["tokens"][0]
+        assert toks[:3] == [5, 17, 42] and len(toks) == 9
+    finally:
+        harness.stop()
+        if component.batcher:
+            component.batcher.close()
