@@ -310,10 +310,12 @@ class GraphExecutor:
         routing = ((feedback.get("response") or {}).get("meta") or {}).get("routing") or {}
         reward = float(feedback.get("reward", 0.0))
         await self._feedback_walk(self.root, feedback, routing)
+        # the response is a conforming SeldonMessage (the proto's
+        # SendFeedback returns one) — the echoed reward rides in tags,
+        # not as a top-level key no transport could serialize
         return {
-            "meta": {"tags": {}, "metrics": []},
+            "meta": {"tags": {"reward": reward}, "metrics": []},
             "status": {"code": 200, "status": "SUCCESS"},
-            "reward": reward,
         }
 
     async def _feedback_walk(self, rt: UnitRuntime, feedback: Dict[str, Any], routing):
