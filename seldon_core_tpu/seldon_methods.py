@@ -15,10 +15,13 @@ import json
 import logging
 from typing import Any, Dict, List, Optional, Union
 
+import numpy as np
+
 from . import payload
 from .proto import prediction_pb2 as pb
 from .user_model import (
     SeldonNotImplementedError,
+    _has_hook,
     client_aggregate,
     client_custom_metrics,
     client_custom_tags,
@@ -58,8 +61,24 @@ def _merged_meta(user_model, request_meta: Dict, extra_tags: Optional[Dict] = No
 
 
 def _respond(user_model, parts: payload.Parts, result: Any, is_proto: bool,
-             extra_tags: Optional[Dict] = None) -> Message:
-    names = client_class_names(user_model, result)
+             extra_tags: Optional[Dict] = None,
+             fallback_names: Optional[list] = None) -> Message:
+    width = getattr(np.asarray(result), "shape", (0,))[-1] if (
+        isinstance(result, (list, tuple)) or hasattr(result, "shape")
+    ) else None
+    if (
+        fallback_names
+        and not _has_hook(user_model, "class_names")
+        and (width is None or len(fallback_names) == width)
+    ):
+        # combiner semantics: a component without its own class_names
+        # inherits the (first) upstream names instead of synthesizing
+        # t:N placeholders (reference: AverageCombinerUnit.java keeps
+        # outputs[0]'s DefaultData names via PredictorUtils.updateData).
+        # Width-changed aggregates fall back to synthesized names.
+        names = list(fallback_names)
+    else:
+        names = client_class_names(user_model, result)
     meta = _merged_meta(user_model, parts.meta, extra_tags)
     if is_proto:
         return payload.build_proto_response(result, names, parts.datadef_type, meta)
@@ -137,7 +156,7 @@ def aggregate(user_model, request) -> Message:
         [p.meta for p in parts_list],
     )
     first = parts_list[0]
-    return _respond(user_model, first, result, is_proto)
+    return _respond(user_model, first, result, is_proto, fallback_names=first.names)
 
 
 def explain(user_model, request: Message) -> Message:
