@@ -932,6 +932,55 @@ static void value_to_pbvalue(const json::Value& v, google::protobuf::Value* out)
   }
 }
 
+// base64 (standard alphabet, padded) — the JSON edge's raw-bytes carrier
+static const char kB64[] = "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+
+static std::string b64_encode(const std::string& in) {
+  std::string out;
+  out.reserve((in.size() + 2) / 3 * 4);
+  size_t i = 0;
+  for (; i + 3 <= in.size(); i += 3) {
+    uint32_t v = (uint8_t(in[i]) << 16) | (uint8_t(in[i + 1]) << 8) | uint8_t(in[i + 2]);
+    out += kB64[(v >> 18) & 63]; out += kB64[(v >> 12) & 63];
+    out += kB64[(v >> 6) & 63]; out += kB64[v & 63];
+  }
+  if (i + 1 == in.size()) {
+    uint32_t v = uint8_t(in[i]) << 16;
+    out += kB64[(v >> 18) & 63]; out += kB64[(v >> 12) & 63]; out += "==";
+  } else if (i + 2 == in.size()) {
+    uint32_t v = (uint8_t(in[i]) << 16) | (uint8_t(in[i + 1]) << 8);
+    out += kB64[(v >> 18) & 63]; out += kB64[(v >> 12) & 63];
+    out += kB64[(v >> 6) & 63]; out += '=';
+  }
+  return out;
+}
+
+static bool b64_decode(const std::string& in, std::string& out) {
+  static int8_t lut[256];
+  static bool init = false;
+  if (!init) {
+    for (int i = 0; i < 256; i++) lut[i] = -1;
+    for (int i = 0; i < 64; i++) lut[uint8_t(kB64[i])] = int8_t(i);
+    init = true;
+  }
+  out.clear();
+  out.reserve(in.size() / 4 * 3);
+  uint32_t acc = 0;
+  int bits = 0;
+  for (char c : in) {
+    if (c == '=' || c == '\n' || c == '\r') continue;
+    int8_t v = lut[uint8_t(c)];
+    if (v < 0) return false;
+    acc = (acc << 6) | uint32_t(v);
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out += char((acc >> bits) & 0xff);
+    }
+  }
+  return true;
+}
+
 // decode a RawTensor (rank 1 or 2) into internal numeric rows
 static bool raw_to_rows(const seldontpu::RawTensor& r, json::Value& ndarray, std::string& err) {
   int64_t rows = 1, cols = 1;
@@ -1242,6 +1291,42 @@ static void handle_predictions(Engine& eng, RequestCtx& ctx, const std::string& 
       http_response(out, 400, error_json(400, "invalid JSON body"));
       return;
     }
+    // JSON edge carries raw tensors base64-encoded: decode here so the
+    // builtin units (and batch detection) see numeric rows exactly like
+    // the binary front's raw_to_rows path; the reply mirrors raw back
+    const json::Value* data_c = msg.find("data");
+    const json::Value* raw = data_c ? data_c->find("raw") : nullptr;
+    if (raw && raw->type == json::Value::Obj) {
+      seldontpu::RawTensor rt;
+      if (const json::Value* dt = raw->find("dtype"))
+        if (dt->type == json::Value::Str) rt.set_dtype(dt->str);
+      if (const json::Value* sh = raw->find("shape"))
+        if (sh->type == json::Value::Arr)
+          for (auto& s : *sh->arr) rt.add_shape(int64_t(s.num));
+      std::string bytes;
+      if (const json::Value* d = raw->find("data")) {
+        if (d->type != json::Value::Str || !b64_decode(d->str, bytes)) {
+          eng.metrics.errors.fetch_add(1, std::memory_order_relaxed);
+          http_response(out, 400, error_json(400, "raw.data is not valid base64"));
+          return;
+        }
+      }
+      rt.set_data(std::move(bytes));
+      std::string err;
+      json::Value nd;
+      if (!raw_to_rows(rt, nd, err)) {
+        eng.metrics.errors.fetch_add(1, std::memory_order_relaxed);
+        http_response(out, 400, error_json(400, err));
+        return;
+      }
+      // rebuild data without the raw member (Object is a flat vector)
+      json::Value new_data = json::Value::object();
+      for (auto& kv : *data_c->obj)
+        if (kv.first != "raw") new_data.set(kv.first, kv.second);
+      new_data.set("ndarray", std::move(nd));
+      msg.set("data", std::move(new_data));
+      reply_enc = "raw_json";
+    }
   }
   // puid (reference: PredictionService.PuidGenerator:77)
   if (auto* meta = msg.find("meta"))
@@ -1276,6 +1361,32 @@ static void handle_predictions(Engine& eng, RequestCtx& ctx, const std::string& 
     resp.SerializeToString(&bytes);
     http_response(out, 200, bytes, "application/x-protobuf");
   } else {
+    if (reply_enc == "raw_json") {
+      // mirror the request's raw encoding on the JSON edge: numeric rows
+      // go back as base64 float64 bytes, like the Python engine does
+      if (const json::Value* data = result.find("data")) {
+        std::vector<std::vector<double>> rows;
+        if (result_rows(*data, rows)) {
+          std::string bytes;
+          for (auto& row : rows)
+            bytes.append(reinterpret_cast<const char*>(row.data()),
+                         row.size() * sizeof(double));
+          json::Value rawv = json::Value::object();
+          rawv.set("dtype", json::Value::string("float64"));
+          json::Value shape = json::Value::array();
+          shape.arr->push_back(json::Value::number(double(rows.size())));
+          shape.arr->push_back(json::Value::number(rows.empty() ? 0 : double(rows[0].size())));
+          rawv.set("shape", std::move(shape));
+          rawv.set("data", json::Value::string(b64_encode(bytes)));
+          json::Value new_data = json::Value::object();
+          for (auto& kv : *data->obj)
+            if (kv.first != "ndarray" && kv.first != "tensor")
+              new_data.set(kv.first, kv.second);
+          new_data.set("raw", std::move(rawv));
+          result.set("data", std::move(new_data));
+        }
+      }
+    }
     http_response(out, 200, json::serialize(result));
   }
   eng.metrics.requests.fetch_add(1, std::memory_order_relaxed);

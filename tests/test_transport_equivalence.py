@@ -251,10 +251,38 @@ def test_native_and_python_engines_agree(tmp_path):
             },
         },
     ]
-    body = {"data": {"ndarray": [[1.0, 2.0], [3.0, 4.0]]}}
     import asyncio
+    import base64
 
-    for spec_dict in specs:
+    bodies = [
+        {"data": {"ndarray": [[1.0, 2.0], [3.0, 4.0]]}},
+        # raw on the JSON edge: base64 bytes; batch size must come from
+        # the raw shape on BOTH engines (a native-engine bug this caught)
+        {
+            "data": {
+                "raw": {
+                    "dtype": "float32",
+                    "shape": [2, 2],
+                    "data": base64.b64encode(
+                        np.ones((2, 2), np.float32).tobytes()
+                    ).decode(),
+                }
+            }
+        },
+    ]
+
+    def canon(resp):
+        data = resp["data"]
+        if "raw" in data:
+            rr = data["raw"]
+            buf = rr["data"]
+            if isinstance(buf, str):
+                buf = base64.b64decode(buf)
+            arr = np.frombuffer(bytes(buf), dtype=rr["dtype"]).reshape(rr["shape"])
+            return arr.tolist()
+        return data["ndarray"]
+
+    for spec_dict, body in [(s_, b_) for s_ in specs for b_ in bodies]:
         port = _free_port()
         with NativeEngine(spec_dict, port=port):
             _wait_port(port)
@@ -265,7 +293,7 @@ def test_native_and_python_engines_agree(tmp_path):
         python = asyncio.run(app.predict(json.loads(json.dumps(body))))
         asyncio.run(app.executor.close())
 
-        assert native["data"]["ndarray"] == python["data"]["ndarray"], spec_dict["name"]
+        assert canon(native) == canon(python), spec_dict["name"]
         assert native["data"].get("names") == python["data"].get("names")
         assert native["meta"]["requestPath"] == python["meta"]["requestPath"]
         assert native["meta"].get("routing", {}) == python["meta"].get("routing", {})
