@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -100,9 +101,16 @@ class EngineApp:
         # in-flight request gauge: rolling updates pause the engine then
         # wait for this to hit zero before tearing the graph down
         # (reference's preStop `curl /pause; sleep 10` drain idiom,
-        # seldondeployment_engine.go:173-177 — here the wait is exact)
+        # seldondeployment_engine.go:173-177 — here the wait is exact).
+        # Mutated from the event loop AND stream-iterator executor threads,
+        # so updates go through _inflight_add's lock.
         self.inflight = 0
+        self._inflight_lock = threading.Lock()
         self._ready_task: Optional[asyncio.Task] = None
+
+    def _inflight_add(self, n: int) -> None:
+        with self._inflight_lock:
+            self.inflight += n
 
     # -- core entrypoints (shared by REST and gRPC fronts) ------------------
 
@@ -112,7 +120,7 @@ class EngineApp:
 
         t0 = time.perf_counter()
         labels = {"deployment": self.spec.name}
-        self.inflight += 1
+        self._inflight_add(1)
         try:
             with get_tracer().span(
                 "predictions", tags={"deployment": self.spec.name}, headers=headers
@@ -122,7 +130,7 @@ class EngineApp:
             self.metrics.counter_inc("seldon_api_engine_server_errors", labels)
             raise
         finally:
-            self.inflight -= 1
+            self._inflight_add(-1)
             self.metrics.observe(
                 "seldon_api_engine_server_requests_seconds", time.perf_counter() - t0, labels
             )
@@ -132,7 +140,7 @@ class EngineApp:
         return out
 
     async def send_feedback(self, feedback: Dict[str, Any]) -> Dict[str, Any]:
-        self.inflight += 1
+        self._inflight_add(1)
         try:
             out = await self.executor.send_feedback(feedback)
             self.metrics.counter_inc(
@@ -142,7 +150,7 @@ class EngineApp:
             )
             return out
         finally:
-            self.inflight -= 1
+            self._inflight_add(-1)
 
     # -- readiness loop -----------------------------------------------------
 
@@ -245,10 +253,57 @@ class EngineApp:
 
             return Response(engine_spec(served_paths=app.routes))
 
+        async def generate_stream(req: Request):
+            """SSE token streaming for single-node GENERATE_SERVER graphs:
+            each credited token span arrives as `data: {"tokens": [...]}`
+            and the stream ends with `data: {"done": true, ...}`. Unary
+            graphs (or multi-node ones) 501 — streaming can't flow through
+            transformer hops."""
+            from ..http_server import StreamingResponse
+
+            if self.paused:
+                return Response(error_body(503, "paused"), 503)
+            target = getattr(self.executor.root.client, "user_object", None)
+            if target is None or not hasattr(target, "stream"):
+                return Response(
+                    error_body(
+                        501,
+                        "streaming needs a single in-process GENERATE_SERVER graph",
+                    ),
+                    501,
+                )
+            body = req.json()
+            if body is None:
+                return Response(error_body(400, "empty request body"), 400)
+            if "jsonData" in body:
+                body = body["jsonData"]
+            try:
+                # stream() validates AND submits eagerly — malformed bodies
+                # and closed batchers 400 here, before any bytes go out
+                handle = target.stream(body)
+            except (ValueError, RuntimeError) as e:
+                return Response(error_body(400, str(e)), 400)
+
+            def sse():
+                # in-flight for the WHOLE stream: rolling-update drain must
+                # wait for open streams, not just the handler return
+                self._inflight_add(1)
+                try:
+                    for chunk in handle.chunks:
+                        yield b"data: " + json.dumps(chunk).encode() + b"\n\n"
+                finally:
+                    self._inflight_add(-1)
+
+            # on client disconnect the server cancels the request, which
+            # frees the decode lane and unblocks the generator's queue
+            return StreamingResponse(sse(), on_abort=handle.cancel)
+
         app.add_route("/pause", pause)
         app.add_route("/unpause", unpause)
         app.add_route("/inflight", inflight)
         app.add_route("/openapi.json", openapi)
+        app.add_route("/api/v0.1/generate", generate_stream)
+        app.add_route("/api/v1.0/generate", generate_stream)
         app.add_route("/metrics", prometheus)
         app.add_route("/prometheus", prometheus)
         app.add_route("/traces", traces)

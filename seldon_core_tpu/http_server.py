@@ -95,6 +95,35 @@ class Response:
         return head.encode() + self.body
 
 
+class StreamingResponse:
+    """Chunked-transfer response driven by a (possibly blocking) iterator
+    of byte chunks — the server pulls items on the default executor so a
+    queue-backed generator (SSE token streaming) never blocks the event
+    loop. The connection closes after the stream (simplest correct
+    keep-alive story for a body of unknown length)."""
+
+    __slots__ = ("iterator", "status", "content_type", "on_abort")
+
+    def __init__(self, iterator, status: int = 200,
+                 content_type: str = "text/event-stream", on_abort=None):
+        self.iterator = iterator
+        self.status = status
+        self.content_type = content_type
+        # called when the client goes away mid-stream (disconnect): gives
+        # the producer a chance to cancel upstream work so the iterator
+        # can finish (and its finally blocks run) instead of lingering
+        self.on_abort = on_abort
+
+    def head(self) -> bytes:
+        reason = _STATUS_TEXT.get(self.status, "Unknown")
+        return (
+            f"HTTP/1.1 {self.status} {reason}\r\n"
+            f"Content-Type: {self.content_type}\r\n"
+            f"Transfer-Encoding: chunked\r\n"
+            f"Connection: close\r\n\r\n"
+        ).encode()
+
+
 class HTTPServer:
     """Exact-path router + asyncio serve loop."""
 
@@ -169,6 +198,49 @@ class HTTPServer:
                 req = Request(method, unquote(parts.path), parts.query, headers, body)
                 keep = headers.get("connection", "keep-alive").lower() != "close"
                 resp = await self._dispatch(req)
+                if isinstance(resp, StreamingResponse):
+                    loop = asyncio.get_running_loop()
+                    it = iter(resp.iterator)
+                    sentinel = object()
+                    try:
+                        writer.write(resp.head())
+                        await writer.drain()
+                        while True:
+                            chunk = await loop.run_in_executor(None, next, it, sentinel)
+                            if chunk is sentinel:
+                                break
+                            if not chunk:
+                                continue
+                            writer.write(
+                                f"{len(chunk):x}\r\n".encode() + bytes(chunk) + b"\r\n"
+                            )
+                            await writer.drain()
+                        writer.write(b"0\r\n\r\n")
+                        await writer.drain()
+                    except (ConnectionError, OSError, asyncio.CancelledError):
+                        # client went away mid-stream: cancel upstream work,
+                        # then drain the iterator on the executor so its
+                        # finally blocks (in-flight gauges, lane release)
+                        # run promptly instead of at GC time
+                        if resp.on_abort is not None:
+                            try:
+                                resp.on_abort()
+                            except Exception:  # noqa: BLE001
+                                logger.exception("stream abort hook failed")
+
+                        def _drain(iterator=it):
+                            try:
+                                for _ in iterator:
+                                    pass
+                            except Exception:  # noqa: BLE001 - cancelled
+                                pass
+                            try:
+                                iterator.close()
+                            except Exception:  # noqa: BLE001
+                                pass
+
+                        loop.run_in_executor(None, _drain)
+                    break  # Connection: close after a chunked stream
                 writer.write(resp.encode(keep))
                 await writer.drain()
                 if not keep:

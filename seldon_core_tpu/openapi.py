@@ -204,6 +204,29 @@ def engine_spec(served_paths=None) -> Dict[str, Any]:
         "/predict": {"post": predict_op},
         "/api/v0.1/feedback": {"post": feedback_op},
         "/api/v1.0/feedback": {"post": feedback_op},
+        **{
+            p: {
+                "post": {
+                    "summary": "SSE token streaming (GENERATE_SERVER graphs)",
+                    "tags": ["engine"],
+                    "requestBody": {
+                        "required": True,
+                        "content": {"application/json": {"schema": {}}},
+                    },
+                    "responses": {
+                        "200": {
+                            "description": "text/event-stream of "
+                            '`data: {"tokens": [...]}` events, ending with '
+                            '`data: {"done": true, "tokens": [...]}`',
+                        },
+                        "501": {
+                            "description": "graph is not a single generate server"
+                        },
+                    },
+                }
+            }
+            for p in ("/api/v0.1/generate", "/api/v1.0/generate")
+        },
         "/ready": {"get": _probe_op("Readiness (graph-gated)", "probes")},
         "/live": {"get": _probe_op("Liveness", "probes")},
         "/ping": {"get": _probe_op("Ping", "probes")},

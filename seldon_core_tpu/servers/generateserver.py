@@ -39,13 +39,23 @@ only for byte-level string prompts.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Callable, Dict, Iterable, List, Optional
 
 from ..user_model import SeldonComponent
 from .jaxserver import JAXServer
 
 logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class StreamHandle:
+    """A live token stream: iterate ``chunks``; call ``cancel()`` when the
+    consumer goes away so the decode lane is reclaimed."""
+
+    chunks: Iterable
+    cancel: Callable[[], bool]
 
 
 class GenerateServer(SeldonComponent):
@@ -152,34 +162,42 @@ class GenerateServer(SeldonComponent):
     def _decode(self, tokens: Iterable[int]) -> str:
         return bytes(t for t in tokens if 0 <= t < 256).decode("utf-8", "replace")
 
-    def predict(self, X, names, meta=None):
-        if self.batcher is None:
-            self.load()
-        body = X if isinstance(X, dict) else None
-        text_mode = False
-        if body is None:
-            if isinstance(X, str):
-                body, text_mode = {"prompt": X}, True
-            else:
-                raise ValueError(
-                    "generate expects jsonData {prompt_tokens|prompt, ...} or strData"
-                )
+    def _parse_prompts(self, body: Dict[str, Any]):
+        """ONE wire-schema parser for the unary and streaming paths:
+        returns (token_lists, text_mode, sampling_kwargs)."""
         if "prompt" in body and "prompt_tokens" not in body:
-            text_mode = True
             prompts = body["prompt"]
             prompts = [prompts] if isinstance(prompts, str) else list(prompts)
             token_lists = [self._encode(p) for p in prompts]
+            text_mode = True
         else:
             pt = body.get("prompt_tokens")
             if not pt:
                 raise ValueError("need prompt_tokens or prompt")
-            token_lists = [list(p) for p in pt] if isinstance(pt[0], (list, tuple)) else [list(pt)]
+            token_lists = (
+                [list(p) for p in pt] if isinstance(pt[0], (list, tuple)) else [list(pt)]
+            )
+            text_mode = False
         kw = dict(
             max_new_tokens=int(body.get("max_new_tokens", 32)),
             temperature=float(body.get("temperature", 0.0)),
             eos_id=body.get("eos_id"),
             seed=int(body.get("seed", 0)),
         )
+        return token_lists, text_mode, kw
+
+    def predict(self, X, names, meta=None):
+        if self.batcher is None:
+            self.load()
+        body = X if isinstance(X, dict) else None
+        if body is None:
+            if isinstance(X, str):
+                body = {"prompt": X}
+            else:
+                raise ValueError(
+                    "generate expects jsonData {prompt_tokens|prompt, ...} or strData"
+                )
+        token_lists, text_mode, kw = self._parse_prompts(body)
         futures = [self.batcher.submit(toks, **kw) for toks in token_lists]
         results = [f.result(timeout=600.0) for f in futures]
         out: Dict[str, Any] = {"tokens": results}
@@ -188,6 +206,43 @@ class GenerateServer(SeldonComponent):
                 self._decode(r[len(p):]) for r, p in zip(results, token_lists)
             ]
         return out
+
+    def stream(self, body: Dict[str, Any]) -> "StreamHandle":
+        """Streaming generate: validates and SUBMITS eagerly (malformed
+        bodies and closed batchers raise HERE, before any response bytes
+        exist), then returns a :class:`StreamHandle` whose ``chunks``
+        iterator yields ``{"tokens": [...]}`` per credited span and a
+        final ``{"done": true, "tokens": [prompt+generated]}``.
+        ``handle.cancel()`` (client disconnect) releases the decode lane.
+        One prompt per stream; batch prompts belong to unary predict."""
+        import queue as _queue
+
+        if self.batcher is None:
+            self.load()
+        token_lists, text_mode, kw = self._parse_prompts(body)
+        if len(token_lists) != 1:
+            raise ValueError("stream takes ONE prompt")
+        toks = token_lists[0]
+        q: "_queue.Queue" = _queue.Queue()
+        fut = self.batcher.submit(toks, on_tokens=q.put, **kw)
+        fut.add_done_callback(lambda _f: q.put(None))
+
+        def chunks():
+            while True:
+                item = q.get()
+                if item is None:
+                    break
+                chunk: Dict[str, Any] = {"tokens": item}
+                if text_mode:
+                    chunk["text"] = self._decode(item)
+                yield chunk
+            result = fut.result(timeout=600.0)
+            final: Dict[str, Any] = {"done": True, "tokens": result}
+            if text_mode:
+                final["text"] = self._decode(result[len(toks):])
+            yield final
+
+        return StreamHandle(chunks=chunks(), cancel=fut.cancel)
 
     def tags(self) -> Dict:
         return {"server": "generateserver"}

@@ -63,6 +63,10 @@ class GenRequest:
     eos_id: Optional[int] = None
     seed: int = 0
     future: Future = dataclasses.field(default_factory=Future)
+    # streaming: called from the scheduler thread with each newly credited
+    # span of tokens (must be cheap + non-blocking; exceptions are logged,
+    # never propagated into the decode loop)
+    on_tokens: Optional[object] = None
 
 
 @dataclasses.dataclass
@@ -463,6 +467,7 @@ class ContinuousBatcher:
         temperature: float = 0.0,
         eos_id: Optional[int] = None,
         seed: int = 0,
+        on_tokens=None,
     ) -> Future:
         if self._stop.is_set():
             raise RuntimeError("batcher is closed")
@@ -477,6 +482,7 @@ class ContinuousBatcher:
             temperature=float(temperature),
             eos_id=eos_id,
             seed=int(seed),
+            on_tokens=on_tokens,
         )
         self._queue.put(req)
         if self._stop.is_set():
@@ -596,14 +602,22 @@ class ContinuousBatcher:
         """Append tokens to a request; True once it is done (budget/eos —
         the caller drops the rest of the burst's tokens for this lane)."""
         req = s.request
+        start = len(s.emitted)
+        done = False
         for t in tokens:
             s.emitted.append(int(t))
             self.stats["tokens"] += 1
             if len(s.emitted) >= req.max_new_tokens or (
                 req.eos_id is not None and int(t) == req.eos_id
             ):
-                return True
-        return False
+                done = True
+                break
+        if req.on_tokens is not None and len(s.emitted) > start:
+            try:
+                req.on_tokens(list(s.emitted[start:]))
+            except Exception:  # noqa: BLE001 - consumer bugs can't stall decode
+                logger.exception("on_tokens callback failed")
+        return done
 
     def _process_burst(self, toks_dev, snapshot) -> None:
         """Credit one burst's tokens to the requests that occupied each lane

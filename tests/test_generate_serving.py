@@ -656,3 +656,141 @@ def test_engine_grpc_generate_e2e(tmp_path):
         harness.stop()
         if component.batcher:
             component.batcher.close()
+
+
+def test_streaming_generate_over_sse(tmp_path):
+    """/api/v0.1/generate streams SSE events whose token spans concatenate
+    to exactly the unary result, with incremental delivery (more than one
+    event before done) and an exact final payload."""
+    import http.client
+
+    from seldon_core_tpu.modelbench import EngineHarness
+    from seldon_core_tpu.servers.generateserver import GenerateServer
+
+    d = tmp_path / "llm"
+    d.mkdir()
+    (d / "jax_config.json").write_text(json.dumps({"family": "llm", "config": CFG}))
+    component = GenerateServer(model_uri=str(d), slots=2, steps_per_poll=2)
+    component.load()
+    harness = EngineHarness(component).start()
+    try:
+        body = {"jsonData": {"prompt_tokens": [[5, 17, 42]], "max_new_tokens": 10}}
+        unary_conn = http.client.HTTPConnection("127.0.0.1", harness.http_port)
+        unary_conn.request(
+            "POST", "/api/v0.1/predictions", json.dumps(body).encode(),
+            {"Content-Type": "application/json"},
+        )
+        unary = json.loads(unary_conn.getresponse().read())["jsonData"]["tokens"][0]
+
+        conn = http.client.HTTPConnection("127.0.0.1", harness.http_port)
+        conn.request(
+            "POST", "/api/v0.1/generate", json.dumps(body).encode(),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "text/event-stream"
+        events = []
+        for line in resp.read().decode().split("\n\n"):
+            if line.startswith("data: "):
+                events.append(json.loads(line[len("data: "):]))
+        assert events[-1]["done"] is True
+        assert events[-1]["tokens"] == unary
+        streamed = [t for ev in events[:-1] for t in ev["tokens"]]
+        assert streamed == unary[3:]  # generated tokens only, in order
+        assert len(events) > 2  # genuinely incremental, not one blob
+    finally:
+        harness.stop()
+        if component.batcher:
+            component.batcher.close()
+
+
+def test_streaming_rejects_batch_and_multinode(tmp_path):
+    """Batch bodies 400 at the HTTP layer (validation is EAGER — no 200 +
+    truncated stream), and a non-generate graph 501s."""
+    import http.client
+
+    from seldon_core_tpu.modelbench import EngineHarness
+    from seldon_core_tpu.servers.generateserver import GenerateServer
+    from seldon_core_tpu.user_model import SeldonComponent
+
+    d = tmp_path / "llm"
+    d.mkdir()
+    (d / "jax_config.json").write_text(json.dumps({"family": "llm", "config": CFG}))
+    s = GenerateServer(model_uri=str(d), slots=2, steps_per_poll=2)
+    try:
+        with pytest.raises(ValueError, match="ONE prompt"):
+            s.stream({"prompt_tokens": [[1, 2], [3, 4]]})
+
+        harness = EngineHarness(s).start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", harness.http_port)
+            conn.request(
+                "POST", "/api/v0.1/generate",
+                json.dumps({"jsonData": {"prompt_tokens": [[1, 2], [3, 4]]}}).encode(),
+                {"Content-Type": "application/json"},
+            )
+            assert conn.getresponse().status == 400
+        finally:
+            harness.stop()
+    finally:
+        if s.batcher:
+            s.batcher.close()
+
+    class Plain(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return np.asarray(X)
+
+    harness2 = EngineHarness(Plain()).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", harness2.http_port)
+        conn.request(
+            "POST", "/api/v0.1/generate",
+            json.dumps({"jsonData": {"prompt_tokens": [[1, 2]]}}).encode(),
+            {"Content-Type": "application/json"},
+        )
+        assert conn.getresponse().status == 501
+    finally:
+        harness2.stop()
+
+
+def test_streaming_disconnect_cancels_request(tmp_path):
+    """Dropping the connection mid-stream cancels the request: the decode
+    lane is reclaimed (cancelled stat) and the engine's in-flight gauge
+    returns to zero."""
+    import http.client
+    import time
+
+    from seldon_core_tpu.modelbench import EngineHarness
+    from seldon_core_tpu.servers.generateserver import GenerateServer
+
+    d = tmp_path / "llm"
+    d.mkdir()
+    (d / "jax_config.json").write_text(json.dumps({"family": "llm", "config": CFG}))
+    s = GenerateServer(model_uri=str(d), slots=1, steps_per_poll=1)
+    s.load()
+    harness = EngineHarness(s).start()
+    try:
+        import socket as _socket
+
+        body = json.dumps(
+            {"jsonData": {"prompt_tokens": [[5, 6, 7]], "max_new_tokens": 55}}
+        ).encode()
+        sock = _socket.create_connection(("127.0.0.1", harness.http_port))
+        sock.sendall(
+            b"POST /api/v0.1/generate HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            + f"Content-Length: {len(body)}\r\n\r\n".encode() + body
+        )
+        assert sock.recv(16)  # first bytes arrived: stream is live
+        sock.close()  # client vanishes mid-stream
+        for _ in range(200):
+            if s.batcher.stats["cancelled"] >= 1 and harness.app.inflight == 0:
+                break
+            time.sleep(0.05)
+        assert s.batcher.stats["cancelled"] >= 1
+        assert harness.app.inflight == 0
+    finally:
+        harness.stop()
+        if s.batcher:
+            s.batcher.close()
