@@ -349,6 +349,39 @@ class EngineApp:
             out = await app.send_feedback(proto_to_json(request))
             return json_to_proto(out)
 
+        async def generate_stream_rpc(request: pb.SeldonMessage, context):
+            """Server-streaming generate: the gRPC twin of the SSE route."""
+            if app.paused:
+                await context.abort(grpc.StatusCode.UNAVAILABLE, "paused")
+            target = getattr(app.executor.root.client, "user_object", None)
+            if target is None or not hasattr(target, "stream"):
+                await context.abort(
+                    grpc.StatusCode.UNIMPLEMENTED,
+                    "streaming needs a single in-process GENERATE_SERVER graph",
+                )
+            body = proto_to_json(request)
+            if "jsonData" in body:
+                body = body["jsonData"]
+            try:
+                handle = target.stream(body)
+            except (ValueError, RuntimeError) as e:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            app._inflight_add(1)
+            it = iter(handle.chunks)
+            sentinel = object()
+            loop = asyncio.get_running_loop()
+            try:
+                while True:
+                    chunk = await loop.run_in_executor(None, next, it, sentinel)
+                    if chunk is sentinel:
+                        break
+                    yield json_to_proto({"jsonData": chunk})
+            finally:
+                app._inflight_add(-1)
+                # no-op on a finished future; on client cancellation this
+                # releases the decode lane
+                handle.cancel()
+
         handlers = {
             "Predict": grpc.unary_unary_rpc_method_handler(
                 predict_rpc,
@@ -358,6 +391,11 @@ class EngineApp:
             "SendFeedback": grpc.unary_unary_rpc_method_handler(
                 feedback_rpc,
                 request_deserializer=pb.Feedback.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            ),
+            "GenerateStream": grpc.unary_stream_rpc_method_handler(
+                generate_stream_rpc,
+                request_deserializer=pb.SeldonMessage.FromString,
                 response_serializer=lambda m: m.SerializeToString(),
             ),
         }

@@ -42,6 +42,14 @@ SERVICES = {
     },
 }
 
+# server-streaming methods (engine-level; NOT in SERVICES because the
+# wrapper's generic unary handler builder iterates that table)
+STREAMING = {
+    "Seldon": {
+        "GenerateStream": (pb.SeldonMessage, pb.SeldonMessage),
+    },
+}
+
 PACKAGE = "seldontpu"
 
 

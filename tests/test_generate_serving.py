@@ -794,3 +794,55 @@ def test_streaming_disconnect_cancels_request(tmp_path):
         harness.stop()
         if s.batcher:
             s.batcher.close()
+
+
+def test_streaming_generate_over_grpc(tmp_path):
+    """gRPC twin of the SSE stream: Seldon/GenerateStream server-streaming
+    responses concatenate to the unary result."""
+    import grpc
+
+    from seldon_core_tpu.modelbench import EngineHarness
+    from seldon_core_tpu.payload import proto_to_json
+    from seldon_core_tpu.proto import prediction_pb2 as pb
+    from seldon_core_tpu.proto.services import method_path
+    from seldon_core_tpu.servers.generateserver import GenerateServer
+
+    d = tmp_path / "llm"
+    d.mkdir()
+    (d / "jax_config.json").write_text(json.dumps({"family": "llm", "config": CFG}))
+    component = GenerateServer(model_uri=str(d), slots=2, steps_per_poll=2)
+    component.load()
+    harness = EngineHarness(component).start()
+    try:
+        request = pb.SeldonMessage(
+            json_data=json.dumps({"prompt_tokens": [[5, 17, 42]], "max_new_tokens": 10})
+        ).SerializeToString()
+        with grpc.insecure_channel(f"127.0.0.1:{harness.grpc_port}") as ch:
+            rpc = ch.unary_stream(
+                method_path("Seldon", "GenerateStream"),
+                request_serializer=lambda b: b,
+                response_deserializer=pb.SeldonMessage.FromString,
+            )
+            events = [proto_to_json(m)["jsonData"] for m in rpc(request, timeout=120.0)]
+        assert events[-1]["done"] is True
+        expected = events[-1]["tokens"]
+        streamed = [t for ev in events[:-1] for t in ev["tokens"]]
+        assert [5, 17, 42] + streamed == expected
+        assert len(events) > 2  # incremental
+        # bad body -> INVALID_ARGUMENT before any stream items
+        with grpc.insecure_channel(f"127.0.0.1:{harness.grpc_port}") as ch:
+            rpc = ch.unary_stream(
+                method_path("Seldon", "GenerateStream"),
+                request_serializer=lambda b: b,
+                response_deserializer=pb.SeldonMessage.FromString,
+            )
+            bad = pb.SeldonMessage(
+                json_data=json.dumps({"prompt_tokens": [[1, 2], [3, 4]]})
+            ).SerializeToString()
+            with pytest.raises(grpc.RpcError) as e:
+                list(rpc(bad, timeout=60.0))
+            assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        harness.stop()
+        if component.batcher:
+            component.batcher.close()
