@@ -1419,6 +1419,9 @@ static std::string prometheus_text(Engine& eng) {
   s += "# TYPE seldon_api_engine_server_errors counter\nseldon_api_engine_server_errors{deployment=\"";
   s += dep;
   s += "\"} " + std::to_string(eng.metrics.errors.load()) + "\n";
+  s += "# TYPE seldon_api_engine_server_feedback counter\nseldon_api_engine_server_feedback{deployment=\"";
+  s += dep;
+  s += "\"} " + std::to_string(eng.metrics.feedback.load()) + "\n";
   s += "# TYPE seldon_api_engine_server_requests_seconds histogram\n";
   uint64_t cum = 0;
   for (int b = 0; b < Metrics::kBuckets; b++) {
@@ -1501,6 +1504,55 @@ static bool process_buffer(Engine& eng, Conn& c, std::mt19937& rng,
         ctx.binary = binary;
         handle_predictions(eng, ctx, body, c.out, binary);
       }
+    } else if (path == "/api/v0.1/feedback" || path == "/api/v1.0/feedback") {
+      // reward feedback (reference: RestClientController.java:244-291).
+      // Builtin units are stateless (the reference's hardcoded units ignore
+      // feedback too; bandit learning lives in router microservices), so
+      // the walk reduces to acknowledging with a conforming SeldonMessage
+      // and counting the reward like the Python engine's metrics do.
+      if (eng.paused.load(std::memory_order_relaxed)) {
+        if (binary) http_response(c.out, 503, proto_error_bytes(503, "paused"), "application/x-protobuf");
+        else http_response(c.out, 503, error_json(503, "paused"));
+      } else {
+        double reward = 0.0;
+        if (binary) {
+          seldontpu::Feedback fb;
+          if (!fb.ParseFromArray(body.data(), int(body.size()))) {
+            eng.metrics.errors.fetch_add(1, std::memory_order_relaxed);
+            http_response(c.out, 400, proto_error_bytes(400, "invalid protobuf body"), "application/x-protobuf");
+            goto feedback_done;
+          }
+          reward = fb.reward();
+        } else {
+          json::Parser parser(body);
+          json::Value fb = parser.parse();
+          if (!parser.ok || fb.type != json::Value::Obj) {
+            eng.metrics.errors.fetch_add(1, std::memory_order_relaxed);
+            http_response(c.out, 400, error_json(400, "invalid JSON body"));
+            goto feedback_done;
+          }
+          if (auto* r = fb.find("reward")) reward = r->num;
+        }
+        eng.metrics.feedback.fetch_add(1, std::memory_order_relaxed);
+        if (binary) {
+          seldontpu::SeldonMessage resp;
+          auto* st = resp.mutable_status();
+          st->set_code(200);
+          google::protobuf::Value rv;
+          rv.set_number_value(reward);
+          (*resp.mutable_meta()->mutable_tags())["reward"] = rv;
+          std::string bytes;
+          resp.SerializeToString(&bytes);
+          http_response(c.out, 200, bytes, "application/x-protobuf");
+        } else {
+          char buf[128];
+          snprintf(buf, sizeof buf,
+                   "{\"status\":{\"code\":200,\"status\":\"SUCCESS\"},"
+                   "\"meta\":{\"tags\":{\"reward\":%g}}}", reward);
+          http_response(c.out, 200, buf);
+        }
+      }
+      feedback_done:;
     } else if (path == "/ping") {
       http_response(c.out, 200, "pong", "text/plain");
     } else if (path == "/live") {

@@ -539,3 +539,22 @@ def test_binary_rank1_raw_keeps_rank(built):
         # stub output is a matrix -> rank 2 is correct for the response;
         # what must not happen is a crash or [1,3] echo of the request
         assert list(out.data.raw.shape) in ([1, 3], [3]) or out.data.raw.shape
+
+
+def test_feedback_route(built):
+    port = free_port()
+    spec = {"name": "t", "graph": {"name": "stub", "implementation": "SIMPLE_MODEL"}}
+    with NativeEngine(spec, port=port):
+        wait_port(port)
+        status, body = post(
+            port, "/api/v0.1/feedback",
+            {"request": {"data": {"ndarray": [[1.0]]}},
+             "response": {"data": {"ndarray": [[0.9]]}}, "reward": 0.75},
+        )
+        assert status == 200
+        assert body["status"]["code"] == 200
+        assert body["meta"]["tags"]["reward"] == 0.75
+        # metrics count it
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert "feedback" in text
