@@ -1,0 +1,78 @@
+#!/usr/bin/env bash
+# Release smoke: boot the full single-host stack and drive every external
+# surface once (counterpart of the reference's testing/scripts e2e tier,
+# minus the kind cluster). Exits non-zero on the first failed check.
+#
+#   JAX_PLATFORMS=cpu bash deploy/smoke.sh
+#
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export PYTHONPATH="${PYTHONPATH:-$PWD}"
+WORK=$(mktemp -d)
+trap 'kill $(jobs -p) 2>/dev/null || true; rm -rf "$WORK"' EXIT
+
+say() { printf '\n== %s\n' "$*"; }
+
+# --- model + graph ---------------------------------------------------------
+mkdir -p "$WORK/model"
+cat > "$WORK/model/jax_config.json" <<'EOF'
+{"family": "llm", "config": {"vocab_size": 256, "d_model": 64, "n_layers": 2,
+ "n_heads": 4, "n_kv_heads": 2, "d_ff": 128, "max_seq": 64, "dtype": "float32"}}
+EOF
+cat > "$WORK/graph.json" <<EOF
+{"name": "smoke", "graph": {"name": "llm", "type": "MODEL",
+  "implementation": "GENERATE_SERVER", "modelUri": "$WORK/model",
+  "parameters": [{"name": "slots", "type": "INT", "value": "2"},
+                 {"name": "steps_per_poll", "type": "INT", "value": "4"}]}}
+EOF
+
+PORT=${SMOKE_PORT:-9971}
+LOGPORT=$((PORT + 1))
+
+say "request-logger on :$LOGPORT"
+python -m seldon_core_tpu.request_logging --port "$LOGPORT" >"$WORK/logger.log" 2>&1 &
+
+say "engine on :$PORT"
+SELDON_MESSAGE_LOGGING_SERVICE="http://127.0.0.1:$LOGPORT/" \
+python -m seldon_core_tpu.engine_main --spec "$WORK/graph.json" \
+    --http-port "$PORT" >"$WORK/engine.log" 2>&1 &
+
+for i in $(seq 1 120); do
+  curl -fsS "http://127.0.0.1:$PORT/ready" >/dev/null 2>&1 && break
+  sleep 0.5
+  [ "$i" = 120 ] && { echo "engine never became ready"; cat "$WORK/engine.log"; exit 1; }
+done
+
+say "unary generate"
+OUT=$(curl -fsS -X POST "http://127.0.0.1:$PORT/api/v0.1/predictions" \
+  -H 'Content-Type: application/json' \
+  -d '{"jsonData": {"prompt_tokens": [[5, 17, 42]], "max_new_tokens": 6}}')
+echo "$OUT" | python -c 'import json,sys; t=json.load(sys.stdin)["jsonData"]["tokens"][0]; assert t[:3]==[5,17,42] and len(t)==9, t; print("tokens:", t)'
+
+say "SSE stream"
+curl -fsS -N -X POST "http://127.0.0.1:$PORT/api/v0.1/generate" \
+  -H 'Content-Type: application/json' \
+  -d '{"jsonData": {"prompt_tokens": [[5, 17, 42]], "max_new_tokens": 6}}' \
+  | grep -c '^data: ' | xargs -I{} sh -c 'test {} -ge 2 && echo "events: {}"'
+
+say "feedback"
+curl -fsS -X POST "http://127.0.0.1:$PORT/api/v0.1/feedback" \
+  -H 'Content-Type: application/json' \
+  -d '{"reward": 1.0}' >/dev/null && echo ok
+
+say "probes + metrics + openapi + traces"
+curl -fsS "http://127.0.0.1:$PORT/ping" >/dev/null && echo ping-ok
+curl -fsS "http://127.0.0.1:$PORT/inflight" | grep -q '"inflight"' && echo inflight-ok
+curl -fsS "http://127.0.0.1:$PORT/prometheus" | grep -q seldon_api_engine_server_requests && echo metrics-ok
+curl -fsS "http://127.0.0.1:$PORT/openapi.json" | grep -q '"/api/v0.1/predictions"' && echo openapi-ok
+curl -fsS "http://127.0.0.1:$PORT/traces" >/dev/null && echo traces-ok
+
+say "payload logging reached the collector"
+for i in $(seq 1 20); do
+  N=$(curl -fsS "http://127.0.0.1:$LOGPORT/entries" | python -c 'import json,sys; print(len(json.load(sys.stdin)))' 2>/dev/null || echo 0)
+  [ "$N" -ge 1 ] && { echo "entries: $N"; break; }
+  sleep 0.5
+  [ "$i" = 20 ] && { echo "no logged pairs"; exit 1; }
+done
+
+say "SMOKE PASSED"
