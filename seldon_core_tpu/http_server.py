@@ -270,8 +270,14 @@ class HTTPServer:
         guarantee the socket is bound before advertising readiness (the
         component runtime) await ``start()`` first, then run this in a
         task."""
-        async with self._server:
+        # no `async with`: its __aexit__ AWAITS wait_closed(), which blows
+        # up with "coroutine ignored GeneratorExit" when the coroutine is
+        # garbage-collected mid-suspend (event loop stopped under it) —
+        # the synchronous close() is all the cleanup needed
+        try:
             await self._server.serve_forever()
+        finally:
+            self._server.close()
 
     def is_serving(self) -> bool:
         return self._server is not None and self._server.is_serving()
