@@ -6,6 +6,8 @@ reference: testing/scripts/test_prepackaged_servers.py:7-35):
 
     python -m seldon_core_tpu.controlplane apply -f dep.json
     python -m seldon_core_tpu.controlplane get [name]
+    python -m seldon_core_tpu.controlplane scale <name> <replicas> [--predictor P]
+    python -m seldon_core_tpu.controlplane status <name>
     python -m seldon_core_tpu.controlplane delete <name>
     python -m seldon_core_tpu.controlplane controller --gateway-port 8003
 """
@@ -43,6 +45,21 @@ def main(argv=None) -> None:
 
     p_delete = sub.add_parser("delete")
     p_delete.add_argument("name")
+
+    p_scale = sub.add_parser(
+        "scale", help="set a predictor's replica count (kubectl scale parity)"
+    )
+    p_scale.add_argument("name")
+    p_scale.add_argument("replicas", type=int)
+    p_scale.add_argument(
+        "--predictor", default=None,
+        help="predictor to scale (default: the only one; required when several)",
+    )
+
+    p_status = sub.add_parser(
+        "status", help="per-predictor replica/traffic rollup for one deployment"
+    )
+    p_status.add_argument("name")
 
     p_ctl = sub.add_parser("controller")
     p_ctl.add_argument("--gateway-port", type=int, default=int(os.environ.get("GATEWAY_PORT", 8003)))
@@ -86,6 +103,58 @@ def main(argv=None) -> None:
             f"seldondeployment.machinelearning.seldon.io/{args.name} "
             + ("deleted" if ok else "not found")
         )
+        return
+
+    if args.cmd == "scale":
+        dep = store.get(args.name, args.namespace)
+        if dep is None:
+            print(f"not found: {args.name}", file=sys.stderr)
+            raise SystemExit(1)
+        dep = dep.clone()
+        candidates = [
+            p for p in dep.predictors
+            if args.predictor is None or p.name == args.predictor
+        ]
+        if args.predictor is None and len(candidates) > 1:
+            names = [p.name for p in dep.predictors]
+            print(f"deployment has predictors {names}; pass --predictor", file=sys.stderr)
+            raise SystemExit(1)
+        if not candidates:
+            print(f"no predictor {args.predictor!r} in {args.name}", file=sys.stderr)
+            raise SystemExit(1)
+        if args.replicas < 1:
+            print("replicas must be >= 1", file=sys.stderr)
+            raise SystemExit(1)
+        candidates[0].replicas = args.replicas
+        store.apply(dep)  # generation bump -> controller reconciles
+        print(
+            f"seldondeployment.machinelearning.seldon.io/{args.name} "
+            f"predictor {candidates[0].name} scaled to {args.replicas}"
+        )
+        return
+
+    if args.cmd == "status":
+        dep = store.get(args.name, args.namespace)
+        if dep is None:
+            print(f"not found: {args.name}", file=sys.stderr)
+            raise SystemExit(1)
+        s = dep.status
+        print(f"{dep.namespace}/{dep.name}  gen={dep.generation}  {s.state}  {s.description}")
+        by_name = {ps.name: ps for ps in s.predictor_status}
+        for p in dep.predictors:
+            ps = by_name.get(p.name)
+            avail = f"{ps.replicas_available}/{ps.replicas}" if ps else "?/?"
+            extras = []
+            if p.hpa_spec:
+                extras.append(
+                    f"hpa {p.hpa_spec.get('minReplicas', 1)}-{p.hpa_spec.get('maxReplicas')}"
+                )
+            if p.tpu_mesh:
+                extras.append(f"mesh {p.tpu_mesh}")
+            print(
+                f"  {p.name}\treplicas {avail}\ttraffic {p.traffic}%"
+                + ("\t" + ", ".join(extras) if extras else "")
+            )
         return
 
     if args.cmd == "controller":

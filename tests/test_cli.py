@@ -1,0 +1,73 @@
+"""sdctl CLI: apply/get/scale/status/delete against a tmp file store
+(kubectl-parity surface — reference users drive the operator with
+kubectl, testing/scripts/test_prepackaged_servers.py:7-35)."""
+
+import json
+
+import pytest
+
+from seldon_core_tpu.controlplane import cli
+
+
+def run(capsys, tmp_store, *argv):
+    cli.main(["--store-dir", str(tmp_store), *argv])
+    return capsys.readouterr().out
+
+
+@pytest.fixture
+def dep_file(tmp_path):
+    f = tmp_path / "dep.json"
+    f.write_text(
+        json.dumps(
+            {
+                "name": "d1",
+                "predictors": [
+                    {
+                        "name": "main",
+                        "traffic": 100,
+                        "replicas": 1,
+                        "hpaSpec": {"minReplicas": 1, "maxReplicas": 3,
+                                     "targetConcurrency": 4},
+                        "graph": {"name": "m", "implementation": "SIMPLE_MODEL"},
+                    }
+                ],
+            }
+        )
+    )
+    return f
+
+
+def test_apply_get_scale_status_delete(tmp_path, capsys, dep_file):
+    store = tmp_path / "store"
+    out = run(capsys, store, "apply", "-f", str(dep_file))
+    assert "d1 added" in out
+
+    out = run(capsys, store, "get")
+    assert "default/d1" in out
+
+    out = run(capsys, store, "scale", "d1", "3")
+    assert "scaled to 3" in out
+    out = run(capsys, store, "get", "d1")
+    assert json.loads(out)["spec"]["predictors"][0]["replicas"] == 3
+
+    out = run(capsys, store, "status", "d1")
+    assert "main" in out and "traffic 100%" in out and "hpa 1-3" in out
+
+    out = run(capsys, store, "delete", "d1")
+    assert "deleted" in out
+
+
+def test_scale_errors(tmp_path, capsys, dep_file):
+    store = tmp_path / "store"
+    run(capsys, store, "apply", "-f", str(dep_file))
+    with pytest.raises(SystemExit):
+        cli.main(["--store-dir", str(store), "scale", "nope", "2"])
+    with pytest.raises(SystemExit):
+        cli.main(["--store-dir", str(store), "scale", "d1", "0"])
+    with pytest.raises(SystemExit):
+        cli.main(["--store-dir", str(store), "scale", "d1", "2", "--predictor", "ghost"])
+
+
+def test_status_missing(tmp_path, capsys):
+    with pytest.raises(SystemExit):
+        cli.main(["--store-dir", str(tmp_path / "s"), "status", "ghost"])
