@@ -43,6 +43,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <array>
 #include <atomic>
 #include <cassert>
 #include <cctype>
@@ -956,13 +957,14 @@ static std::string b64_encode(const std::string& in) {
 }
 
 static bool b64_decode(const std::string& in, std::string& out) {
-  static int8_t lut[256];
-  static bool init = false;
-  if (!init) {
-    for (int i = 0; i < 256; i++) lut[i] = -1;
-    for (int i = 0; i < 64; i++) lut[uint8_t(kB64[i])] = int8_t(i);
-    init = true;
-  }
+  // magic static: C++11 guarantees thread-safe initialization (the engine
+  // runs one epoll loop per worker thread)
+  static const std::array<int8_t, 256> lut = [] {
+    std::array<int8_t, 256> t;
+    t.fill(-1);
+    for (int i = 0; i < 64; i++) t[uint8_t(kB64[i])] = int8_t(i);
+    return t;
+  }();
   out.clear();
   out.reserve(in.size() / 4 * 3);
   uint32_t acc = 0;

@@ -284,10 +284,15 @@ class EngineApp:
             except (ValueError, RuntimeError) as e:
                 return Response(error_body(400, str(e)), 400)
 
+            # in-flight from SUBMISSION (the decode lane is already
+            # occupied), not from the first pulled chunk — a rolling-update
+            # drain polling between submit and first pull must see it. The
+            # generator is the single decrementer; the connection handler
+            # guarantees it runs (it drains/starts the iterator even on
+            # abort), so the pair always balances.
+            self._inflight_add(1)
+
             def sse():
-                # in-flight for the WHOLE stream: rolling-update drain must
-                # wait for open streams, not just the handler return
-                self._inflight_add(1)
                 try:
                     for chunk in handle.chunks:
                         yield b"data: " + json.dumps(chunk).encode() + b"\n\n"

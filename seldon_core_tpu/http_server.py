@@ -229,14 +229,17 @@ class HTTPServer:
                                 logger.exception("stream abort hook failed")
 
                         def _drain(iterator=it):
+                            # BaseException: a cancelled request surfaces
+                            # concurrent.futures.CancelledError (a
+                            # BaseException since 3.8) from the iterator
                             try:
                                 for _ in iterator:
                                     pass
-                            except Exception:  # noqa: BLE001 - cancelled
+                            except BaseException:  # noqa: BLE001
                                 pass
                             try:
                                 iterator.close()
-                            except Exception:  # noqa: BLE001
+                            except BaseException:  # noqa: BLE001
                                 pass
 
                         loop.run_in_executor(None, _drain)

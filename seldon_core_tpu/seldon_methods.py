@@ -63,9 +63,10 @@ def _merged_meta(user_model, request_meta: Dict, extra_tags: Optional[Dict] = No
 def _respond(user_model, parts: payload.Parts, result: Any, is_proto: bool,
              extra_tags: Optional[Dict] = None,
              fallback_names: Optional[list] = None) -> Message:
-    width = getattr(np.asarray(result), "shape", (0,))[-1] if (
-        isinstance(result, (list, tuple)) or hasattr(result, "shape")
-    ) else None
+    width = None
+    if fallback_names and (isinstance(result, (list, tuple)) or hasattr(result, "shape")):
+        shape = np.asarray(result).shape
+        width = shape[-1] if shape else 0  # 0-d results can't match names
     if (
         fallback_names
         and not _has_hook(user_model, "class_names")

@@ -184,3 +184,32 @@ def test_parse_parameters():
         {"name": "d", "value": "x", "type": "STRING"},
     ]
     assert parse_parameters(params) == {"a": 1, "b": 0.5, "c": True, "d": "x"}
+
+
+def test_scalar_result_predict():
+    """A model returning a 0-d scalar must serialize, not crash the
+    response builder (regression: fallback-width computation indexed
+    shape[-1] on an empty shape)."""
+    import asyncio
+    import json
+
+    from seldon_core_tpu.http_server import Request
+    from seldon_core_tpu.user_model import SeldonComponent
+    from seldon_core_tpu.wrapper import get_rest_microservice
+
+    class Scorer(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return np.float64(0.5)
+
+    app = get_rest_microservice(Scorer())
+    resp = asyncio.run(
+        app._dispatch(
+            Request(
+                "POST", "/predict", "", {"content-type": "application/json"},
+                json.dumps({"data": {"ndarray": [[1.0, 2.0]]}}).encode(),
+            )
+        )
+    )
+    assert resp.status == 200
+    out = json.loads(resp.body)
+    assert out["data"]["ndarray"] == 0.5 or out["data"]["ndarray"] == [0.5]
