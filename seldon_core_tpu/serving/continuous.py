@@ -158,32 +158,43 @@ class ContinuousBatcher:
         # scales with total cache bytes); per-layer arrays carried through
         # the burst scan update in place — only the one-position scatter
         # touches HBM (see DecoderLM.decode_step_ragged_list).
-        cache_sharding = None
-        if mesh is not None:
+        def cache_sharding_for(kv_heads: int):
+            """Per-layer cache [S, KV, T, Dh]: KV heads over `model` (tp),
+            cache length over `seq` (long context spans ICI). KV head
+            counts that don't divide the model axis (GQA targets, thin
+            drafts) replicate the KV dim instead of failing device_put."""
+            if mesh is None:
+                return None
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            params = jax.device_put(params, model.param_sharding(mesh, params))
             model_ax = "model" if "model" in mesh.axis_names else None
             seq_ax = (
                 "seq"
                 if shard_cache_seq and "seq" in mesh.axis_names and mesh.shape["seq"] > 1
                 else None
             )
-            # per-layer cache [S, KV, T, Dh]: KV heads over `model` (tp),
-            # cache length over `seq` (long context spans ICI)
-            cache_sharding = NamedSharding(mesh, P(None, model_ax, seq_ax, None))
+            if model_ax is not None and kv_heads % dict(mesh.shape)["model"] != 0:
+                model_ax = None
+            return NamedSharding(mesh, P(None, model_ax, seq_ax, None))
+
+        def unstack_cache(owner, sharding):
+            stacked = owner.init_cache(self.slots, self.max_seq)
+            n_layers = stacked["k"].shape[0]
+            out = {
+                "k": [stacked["k"][l] for l in range(n_layers)],
+                "v": [stacked["v"][l] for l in range(n_layers)],
+            }
+            if sharding is not None:
+                out = jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, sharding), out
+                )
+            return out
+
+        if mesh is not None:
+            params = jax.device_put(params, model.param_sharding(mesh, params))
         self.params = params
-        stacked = model.init_cache(self.slots, self.max_seq)
-        n_layers = stacked["k"].shape[0]
-        cache = {
-            "k": [stacked["k"][l] for l in range(n_layers)],
-            "v": [stacked["v"][l] for l in range(n_layers)],
-        }
-        if cache_sharding is not None:
-            cache = jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, cache_sharding), cache
-            )
-        self._cache = cache
+        cache_sharding = cache_sharding_for(model.cfg.n_kv_heads)
+        self._cache = unstack_cache(model, cache_sharding)
         self._draft_params = None
         self._draft_cache = None
         if self.speculate_tokens > 0:
@@ -191,17 +202,9 @@ class ContinuousBatcher:
             if mesh is not None:
                 dp = jax.device_put(dp, draft_model.param_sharding(mesh, dp))
             self._draft_params = dp
-            dstacked = draft_model.init_cache(self.slots, self.max_seq)
-            dl = dstacked["k"].shape[0]
-            dcache = {
-                "k": [dstacked["k"][l] for l in range(dl)],
-                "v": [dstacked["v"][l] for l in range(dl)],
-            }
-            if cache_sharding is not None:
-                dcache = jax.tree_util.tree_map(
-                    lambda a: jax.device_put(a, cache_sharding), dcache
-                )
-            self._draft_cache = dcache
+            self._draft_cache = unstack_cache(
+                draft_model, cache_sharding_for(draft_model.cfg.n_kv_heads)
+            )
         self._cur_tok = jnp.zeros((self.slots,), jnp.int32)
         self._pos = jnp.zeros((self.slots,), jnp.int32)
         # per-lane PRNG streams: each request's sampling is seeded by ITS

@@ -846,3 +846,41 @@ def test_streaming_generate_over_grpc(tmp_path):
         harness.stop()
         if component.batcher:
             component.batcher.close()
+
+
+def test_speculation_on_mesh_with_thin_draft(model_and_params):
+    """Speculation composes with tensor parallelism: the target shards
+    over the mesh while a THIN draft (1 KV head, not divisible by the
+    model axis) falls back to replicated KV — and stays exact."""
+    import jax
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.parallel import make_mesh
+
+    model, params = model_and_params
+    mesh = make_mesh({"model": 4})
+    # self-draft (shards cleanly) AND a thin independent draft
+    self_draft_params = {
+        **params,
+        "blocks": jax.tree_util.tree_map(lambda a: a[:1], params["blocks"]),
+    }
+    self_draft = DecoderLM(**{**CFG, "n_layers": 1})
+    thin = DecoderLM(
+        vocab_size=CFG["vocab_size"], d_model=16, n_layers=1, n_heads=4,
+        n_kv_heads=1, d_ff=32, max_seq=64, dtype="float32",
+    )
+    thin_params = thin.init_params(9)
+    prompt = [3, 5, 7]
+    exp = np.asarray(
+        model.generate(params, jnp.asarray([prompt], jnp.int32), 6)
+    )[0].tolist()
+    for draft, dparams in ((self_draft, self_draft_params), (thin, thin_params)):
+        b = ContinuousBatcher(
+            model, params, slots=2, max_seq=64, prefill_buckets=(8,),
+            steps_per_poll=2, mesh=mesh,
+            draft_model=draft, draft_params=dparams, speculate_tokens=3,
+        )
+        try:
+            assert b.generate(prompt, max_new_tokens=6) == exp
+        finally:
+            b.close()
