@@ -884,3 +884,32 @@ def test_speculation_on_mesh_with_thin_draft(model_and_params):
             assert b.generate(prompt, max_new_tokens=6) == exp
         finally:
             b.close()
+
+
+def test_stream_speculation_mesh_compose(tmp_path):
+    """The whole round-2 serving stack at once: token STREAMING from a
+    SPECULATIVE batcher whose target is SHARDED over the mesh (self-draft)
+    — incremental chunks whose final event equals the unary result."""
+    from seldon_core_tpu.parallel import make_mesh
+    from seldon_core_tpu.servers.generateserver import GenerateServer
+
+    d = tmp_path / "llm"
+    d.mkdir()
+    (d / "jax_config.json").write_text(json.dumps({"family": "llm", "config": CFG}))
+    s = GenerateServer(
+        model_uri=str(d), slots=2, steps_per_poll=2,
+        speculate_tokens=3, draft_layers=1, mesh=make_mesh({"model": 4}),
+    )
+    s.load()
+    try:
+        handle = s.stream({"prompt_tokens": [[3, 5, 7]], "max_new_tokens": 8})
+        chunks = list(handle.chunks)
+        assert chunks[-1]["done"] is True
+        streamed = [t for c in chunks[:-1] for t in c["tokens"]]
+        assert [3, 5, 7] + streamed == chunks[-1]["tokens"]
+        assert len(chunks) > 2  # incremental
+        unary = s.predict({"prompt_tokens": [[3, 5, 7]], "max_new_tokens": 8}, [])
+        assert chunks[-1]["tokens"] == unary["tokens"][0]
+    finally:
+        if s.batcher:
+            s.batcher.close()
