@@ -622,3 +622,55 @@ def test_autoscale_scale_event_keeps_existing_replicas():
         await ctl.shutdown()
 
     run(go())
+
+
+# -- subprocess runtime (the multi-process production mode) ------------------
+
+
+def test_subprocess_runtime_end_to_end():
+    """Reconcile with SubprocessRuntime: a REAL engine_main child process
+    serves the graph; predict over its socket, the autoscaler's load()
+    probe reads its /inflight, and delete drains + terminates it."""
+    import json as _json
+    import urllib.request
+
+    from seldon_core_tpu.controlplane.runtime import SubprocessRuntime
+
+    async def go():
+        store = ResourceStore()
+        ctl = DeploymentController(
+            store, runtime=SubprocessRuntime(), ready_timeout_s=60.0
+        )
+        dep, _ = store.apply(simple_dep(name="subp"))
+        status = await ctl.reconcile(dep.clone())
+        assert status.state == STATE_AVAILABLE
+
+        engines = [
+            h for h, _ in ctl.components.values() if h.spec.kind == "engine"
+        ]
+        assert len(engines) == 1
+        handle = engines[0]
+        assert handle.proc.poll() is None  # child alive
+
+        def predict():
+            req = urllib.request.Request(
+                f"{handle.url}/api/v0.1/predictions",
+                data=_json.dumps({"data": {"ndarray": [[1.0, 2.0]]}}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return _json.loads(r.read())
+
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(None, predict)
+        assert out["data"]["ndarray"] == [[0.9, 0.05, 0.05]]
+
+        # the autoscaler's probe path over the real socket
+        load = await handle.load()
+        assert load == 0.0
+
+        proc = handle.proc
+        await ctl.delete(dep)
+        assert proc.poll() is not None  # terminated after drain
+
+    run(go())
