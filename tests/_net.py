@@ -78,3 +78,65 @@ class FixedResponseServer:
     def __exit__(self, *exc):
         self._stop.set()
         self._srv.close()
+
+
+def wait_port(port: int, timeout: float = 5.0) -> None:
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            s = socket.create_connection(("127.0.0.1", port), 0.2)
+            s.close()
+            return
+        except OSError:
+            time.sleep(0.02)
+    raise TimeoutError(f"port {port} never opened")
+
+
+def serve_on_thread(serve_coro, port=None):
+    """Run a ``serve_forever``-style coroutine on its own event-loop thread.
+
+    Returns a ``stop()`` callable. Teardown CANCELS the serve task (so its
+    finally blocks run) instead of ``loop.stop()`` — a bare stop leaves
+    ``run_until_complete`` raising "Event loop stopped before Future
+    completed" into the thread, which pytest reports as
+    PytestUnhandledThreadExceptionWarning at whatever later test happens to
+    trigger the GC.
+    """
+    import asyncio
+
+    loop = asyncio.new_event_loop()
+    box = {}
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        box["task"] = loop.create_task(serve_coro)
+        started.set()
+        try:
+            loop.run_until_complete(box["task"])
+        except asyncio.CancelledError:
+            pass
+        finally:
+            try:
+                loop.close()
+            except Exception:
+                pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    started.wait(5)
+    if port is not None:
+        wait_port(port)
+
+    def stop():
+        task = box.get("task")
+        if task is not None and not loop.is_closed():
+            try:
+                loop.call_soon_threadsafe(task.cancel)
+            except RuntimeError:
+                pass  # loop already closed
+        t.join(timeout=5)
+
+    return stop

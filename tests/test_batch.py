@@ -4,18 +4,15 @@ output, client-side row fusing, failure records, live engine target."""
 import asyncio
 import io
 import json
-import socket
-import threading
 import time
 
-import numpy as np
 import pytest
 
 from seldon_core_tpu.batch import BatchScorer, fuse_rows, read_records
 from seldon_core_tpu.graph.service import EngineApp
 from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
 
-from _net import free_port
+from _net import free_port, serve_on_thread
 
 
 @pytest.fixture
@@ -27,22 +24,9 @@ def engine_port():
     )
     app = EngineApp(spec)
     port = free_port()
-    loop = asyncio.new_event_loop()
-
-    def run():
-        asyncio.set_event_loop(loop)
-        loop.run_until_complete(app.rest_app().serve_forever("127.0.0.1", port))
-
-    threading.Thread(target=run, daemon=True).start()
-    deadline = time.time() + 5
-    while time.time() < deadline:
-        try:
-            socket.create_connection(("127.0.0.1", port), 0.2).close()
-            break
-        except OSError:
-            time.sleep(0.02)
+    stop = serve_on_thread(app.rest_app().serve_forever("127.0.0.1", port), port)
     yield port
-    loop.call_soon_threadsafe(loop.stop)
+    stop()
 
 
 def test_read_records_jsonl_and_csv():

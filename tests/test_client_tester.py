@@ -4,9 +4,6 @@ fixtures under python/tests/resources/)."""
 
 import asyncio
 import json
-import socket
-import threading
-import time
 
 import numpy as np
 import pytest
@@ -23,7 +20,7 @@ from seldon_core_tpu.tester import (
 from seldon_core_tpu.user_model import SeldonComponent
 from seldon_core_tpu.wrapper import get_grpc_server, get_rest_microservice
 
-from _net import free_port
+from _net import free_port, serve_on_thread
 
 CONTRACT = {
     "features": [
@@ -56,27 +53,13 @@ def microservice_endpoint():
     port, gport = free_port(), free_port()
     obj = Proba()
     app = get_rest_microservice(obj)
-    loop = asyncio.new_event_loop()
-
-    def run():
-        asyncio.set_event_loop(loop)
-        loop.run_until_complete(app.serve_forever("127.0.0.1", port))
-
-    t = threading.Thread(target=run, daemon=True)
-    t.start()
+    stop = serve_on_thread(app.serve_forever("127.0.0.1", port), port)
     server = get_grpc_server(obj)
     server.add_insecure_port(f"127.0.0.1:{gport}")
     server.start()
-    deadline = time.time() + 5
-    while time.time() < deadline:
-        try:
-            socket.create_connection(("127.0.0.1", port), 0.2).close()
-            break
-        except OSError:
-            time.sleep(0.02)
     yield f"127.0.0.1:{port}", f"127.0.0.1:{gport}"
     server.stop(grace=0)
-    loop.call_soon_threadsafe(loop.stop)
+    stop()
 
 
 # -- contract machinery -----------------------------------------------------

@@ -4,9 +4,6 @@ tier, scaled to one host — reference: testing/scripts/test_s2i_python.py).
 """
 
 import asyncio
-import socket
-import threading
-import time
 
 import numpy as np
 import pytest
@@ -22,31 +19,16 @@ class Doubler(SeldonComponent):
         return np.asarray(X) * 2
 
 
-from _net import free_port  # noqa: E402
+from _net import free_port, serve_on_thread  # noqa: E402
 
 
 @pytest.fixture
 def rest_microservice_port():
     port = free_port()
     app = get_rest_microservice(Doubler())
-    loop = asyncio.new_event_loop()
-
-    def run():
-        asyncio.set_event_loop(loop)
-        loop.run_until_complete(app.serve_forever("127.0.0.1", port))
-
-    t = threading.Thread(target=run, daemon=True)
-    t.start()
-    deadline = time.time() + 5
-    while time.time() < deadline:
-        try:
-            s = socket.create_connection(("127.0.0.1", port), 0.2)
-            s.close()
-            break
-        except OSError:
-            time.sleep(0.02)
+    stop = serve_on_thread(app.serve_forever("127.0.0.1", port), port)
     yield port
-    loop.call_soon_threadsafe(loop.stop)
+    stop()
 
 
 @pytest.fixture
@@ -114,24 +96,9 @@ def test_engine_rest_server_full_stack(rest_microservice_port):
 
     engine_port = free_port()
     app = engine_for("REST", rest_microservice_port)
-    loop = asyncio.new_event_loop()
-
-    def run():
-        asyncio.set_event_loop(loop)
-        loop.run_until_complete(
-            app.rest_app().serve_forever("127.0.0.1", engine_port)
-        )
-
-    t = threading.Thread(target=run, daemon=True)
-    t.start()
-    deadline = time.time() + 5
-    while time.time() < deadline:
-        try:
-            s = socket.create_connection(("127.0.0.1", engine_port), 0.2)
-            s.close()
-            break
-        except OSError:
-            time.sleep(0.02)
+    stop = serve_on_thread(
+        app.rest_app().serve_forever("127.0.0.1", engine_port), engine_port
+    )
     req = urllib.request.Request(
         f"http://127.0.0.1:{engine_port}/api/v0.1/predictions",
         data=json.dumps({"data": {"ndarray": [[3.0]]}}).encode(),
@@ -140,7 +107,7 @@ def test_engine_rest_server_full_stack(rest_microservice_port):
     with urllib.request.urlopen(req, timeout=5) as r:
         body = json.loads(r.read())
     assert body["data"]["ndarray"] == [[6.0]]
-    loop.call_soon_threadsafe(loop.stop)
+    stop()
 
 
 def test_engine_rest_unit_hop_goes_binary_for_raw(rest_microservice_port):

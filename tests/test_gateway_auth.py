@@ -3,9 +3,6 @@ legacy apife gateway the client SDK speaks, seldon_client.py:931-1106)."""
 
 import asyncio
 import base64
-import socket
-import threading
-import time
 
 import pytest
 
@@ -18,7 +15,7 @@ from seldon_core_tpu.controlplane import (
 from seldon_core_tpu.controlplane.resource import STATE_AVAILABLE
 from seldon_core_tpu.controlplane.runtime import InProcessRuntime
 
-from _net import free_port
+from _net import free_port, serve_on_thread
 
 
 def simple_dep():
@@ -45,23 +42,9 @@ def gateway_port():
     assert status.state == STATE_AVAILABLE
 
     port = free_port()
-    loop = asyncio.new_event_loop()
-
-    def run():
-        asyncio.set_event_loop(loop)
-        loop.run_until_complete(gw.app().serve_forever("127.0.0.1", port))
-
-    t = threading.Thread(target=run, daemon=True)
-    t.start()
-    deadline = time.time() + 5
-    while time.time() < deadline:
-        try:
-            socket.create_connection(("127.0.0.1", port), 0.2).close()
-            break
-        except OSError:
-            time.sleep(0.02)
+    stop = serve_on_thread(gw.app().serve_forever("127.0.0.1", port), port)
     yield port
-    loop.call_soon_threadsafe(loop.stop)
+    stop()
 
 
 def test_unauthenticated_request_rejected(gateway_port):

@@ -1,11 +1,7 @@
 """Load tester against a live engine (counterpart of reference
 util/loadtester/ locust suite, reporting benchmarking.md's table)."""
 
-import asyncio
 import json
-import socket
-import threading
-import time
 
 import pytest
 
@@ -13,7 +9,7 @@ from seldon_core_tpu import loadtester
 from seldon_core_tpu.graph.service import EngineApp
 from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
 
-from _net import free_port
+from _net import free_port, serve_on_thread
 
 
 @pytest.fixture
@@ -25,23 +21,9 @@ def engine_port():
     )
     app = EngineApp(spec)
     port = free_port()
-    loop = asyncio.new_event_loop()
-
-    def run():
-        asyncio.set_event_loop(loop)
-        loop.run_until_complete(app.rest_app().serve_forever("127.0.0.1", port))
-
-    t = threading.Thread(target=run, daemon=True)
-    t.start()
-    deadline = time.time() + 5
-    while time.time() < deadline:
-        try:
-            socket.create_connection(("127.0.0.1", port), 0.2).close()
-            break
-        except OSError:
-            time.sleep(0.02)
+    stop = serve_on_thread(app.rest_app().serve_forever("127.0.0.1", port), port)
     yield port
-    loop.call_soon_threadsafe(loop.stop)
+    stop()
 
 
 def test_build_payload_fixed_ndarray():

@@ -4,9 +4,6 @@ mixed path (native engine fronting a Python REST microservice)."""
 import asyncio
 import json
 import shutil
-import socket
-import threading
-import time
 import urllib.request
 import urllib.error
 
@@ -19,7 +16,7 @@ from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
 from seldon_core_tpu.native_engine import NativeEngine, build, version
 
 
-from _net import free_port  # noqa: E402
+from _net import free_port, serve_on_thread, wait_port  # noqa: E402
 
 
 def post(port, path, body, timeout=10):
@@ -39,17 +36,6 @@ def post(port, path, body, timeout=10):
 def built():
     build()
     return True
-
-
-def wait_port(port, timeout=5.0):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        try:
-            socket.create_connection(("127.0.0.1", port), 0.2).close()
-            return
-        except OSError:
-            time.sleep(0.02)
-    raise TimeoutError(f"port {port} never opened")
 
 
 def test_version(built):
@@ -173,15 +159,7 @@ def test_native_engine_fronts_python_microservice(built):
 
     ms_port = free_port()
     app = get_rest_microservice(Doubler())
-    loop = asyncio.new_event_loop()
-
-    def run():
-        asyncio.set_event_loop(loop)
-        loop.run_until_complete(app.serve_forever("127.0.0.1", ms_port))
-
-    t = threading.Thread(target=run, daemon=True)
-    t.start()
-    wait_port(ms_port)
+    stop = serve_on_thread(app.serve_forever("127.0.0.1", ms_port), ms_port)
 
     port = free_port()
     spec = {
@@ -205,7 +183,7 @@ def test_native_engine_fronts_python_microservice(built):
             status, body = post(port, "/api/v0.1/predictions",
                                 {"data": {"ndarray": [[2.0]]}})
             assert status == 200 and body["data"]["ndarray"] == [[4.0]]
-    loop.call_soon_threadsafe(loop.stop)
+    stop()
 
 
 def test_python_engine_parity_on_same_graph(built):
@@ -478,14 +456,7 @@ def test_native_engine_forwards_binary_upstream(built):
     app._dispatch = spy
 
     ms_port = free_port()
-    loop = asyncio.new_event_loop()
-
-    def run():
-        asyncio.set_event_loop(loop)
-        loop.run_until_complete(app.serve_forever("127.0.0.1", ms_port))
-
-    threading.Thread(target=run, daemon=True).start()
-    wait_port(ms_port)
+    stop = serve_on_thread(app.serve_forever("127.0.0.1", ms_port), ms_port)
 
     port = free_port()
     spec = {
@@ -515,7 +486,7 @@ def test_native_engine_forwards_binary_upstream(built):
                             {"data": {"ndarray": [[2.0]]}})
         assert status == 200 and body["data"]["ndarray"] == [[10.0]]
         assert seen_types[-1].startswith("application/json")
-    loop.call_soon_threadsafe(loop.stop)
+    stop()
 
 
 def test_binary_rank1_raw_keeps_rank(built):

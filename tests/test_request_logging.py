@@ -3,13 +3,9 @@
 seldon-request-logger/app/app.py:15-51)."""
 
 import asyncio
-import json
 import socket
-import threading
 import time
 
-import numpy as np
-import pytest
 
 from seldon_core_tpu.graph.service import EngineApp, RequestLogger
 from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
@@ -19,7 +15,7 @@ from seldon_core_tpu.request_logging import (
     flatten_pair,
 )
 
-from _net import free_port
+from _net import free_port, serve_on_thread
 
 
 def make_event(req_rows, resp_rows, puid="p1"):
@@ -99,21 +95,7 @@ def test_cloudevents_sink_posts_to_collector():
     real socket."""
     port = free_port()
     collector = RequestLoggerApp()
-    loop = asyncio.new_event_loop()
-
-    def run():
-        asyncio.set_event_loop(loop)
-        loop.run_until_complete(collector.app().serve_forever("127.0.0.1", port))
-
-    t = threading.Thread(target=run, daemon=True)
-    t.start()
-    deadline = time.time() + 5
-    while time.time() < deadline:
-        try:
-            socket.create_connection(("127.0.0.1", port), 0.2).close()
-            break
-        except OSError:
-            time.sleep(0.02)
+    stop = serve_on_thread(collector.app().serve_forever("127.0.0.1", port), port)
 
     sink = CloudEventsSink(f"http://127.0.0.1:{port}/", maxsize=8)
     spec = default_predictor(
@@ -128,7 +110,7 @@ def test_cloudevents_sink_posts_to_collector():
     while time.time() < deadline and sink.stats["posted"] < 1:
         time.sleep(0.05)
     sink.close()
-    loop.call_soon_threadsafe(loop.stop)
+    stop()
     assert sink.stats["posted"] == 1
     assert sink.stats["errors"] == 0
     assert collector.stats["events"] == 1

@@ -4,8 +4,6 @@ engine TracingProvider + wrapper FlaskTracer, SURVEY §5)."""
 
 import asyncio
 import json
-import threading
-import time
 
 import numpy as np
 
@@ -120,7 +118,7 @@ def test_trace_crosses_rest_process_boundary():
     from seldon_core_tpu.user_model import SeldonComponent
     from seldon_core_tpu.wrapper import get_rest_microservice
 
-    from _net import free_port
+    from _net import free_port, serve_on_thread
 
     class Doubler(SeldonComponent):
         def predict(self, X, names, meta=None):
@@ -129,15 +127,7 @@ def test_trace_crosses_rest_process_boundary():
     tracer = init_tracer("xproc", enabled=True)
     port = free_port()
     ms_app = get_rest_microservice(Doubler())
-    loop = asyncio.new_event_loop()
-
-    def run():
-        asyncio.set_event_loop(loop)
-        loop.run_until_complete(ms_app.serve_forever("127.0.0.1", port))
-
-    t = threading.Thread(target=run, daemon=True)
-    t.start()
-    time.sleep(0.3)
+    stop = serve_on_thread(ms_app.serve_forever("127.0.0.1", port), port)
 
     spec = default_predictor(
         PredictorSpec.from_dict(
@@ -161,7 +151,7 @@ def test_trace_crosses_rest_process_boundary():
     assert server_side, [s.operation for s in spans]
     # same trace id across the socket hop
     assert server_side[0].trace_id == root.trace_id
-    loop.call_soon_threadsafe(loop.stop)
+    stop()
     init_tracer(enabled=False)
 
 
