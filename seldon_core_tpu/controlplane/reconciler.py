@@ -492,7 +492,21 @@ class DeploymentController:
             if not hpa:
                 continue
             lo, hi, target = parse_hpa_spec(hpa, who=f"{dep.key}/{pspec.name}")
+            handles = [
+                handle
+                for handle, _ in self.components.values()
+                if handle.spec.deployment == dep.key
+                and handle.spec.predictor == pspec.name
+                and handle.spec.routable
+            ]
+            # two counts: ``current`` is what the spec says (the value a
+            # scale decision rewrites), ``observed`` what is actually
+            # serving — after a placement-capped or lagging reconcile the
+            # two diverge, and a decision made against the wrong one either
+            # mis-triggers the scale-down streak or (worse) applies an
+            # instant scale-down through the scale-UP branch
             current = max(1, pspec.replicas)
+            observed = max(1, len(handles) or pspec.replicas)
             if self.placement is not None and pspec.tpu_mesh:
                 # never scale past the chips that exist: desired beyond the
                 # free device blocks would just flip the deployment FAILED
@@ -501,15 +515,15 @@ class DeploymentController:
                 per_replica = 1
                 for v in pspec.tpu_mesh.values():
                     per_replica *= int(v)
-                placeable = current + self.placement.capacity()["free"] // per_replica
+                # anchor at the LARGER of spec/observed: when placement is
+                # exhausted (free=0) an observed-only anchor would clamp
+                # desired to the observed count and ratchet the spec down
+                # under sustained load, killing the lag guard below
+                placeable = (
+                    max(current, observed)
+                    + self.placement.capacity()["free"] // per_replica
+                )
                 hi = min(hi, max(lo, placeable))
-            handles = [
-                handle
-                for handle, _ in self.components.values()
-                if handle.spec.deployment == dep.key
-                and handle.spec.predictor == pspec.name
-                and handle.spec.routable
-            ]
             # probes run concurrently: with SubprocessRuntime each is an
             # HTTP call with a 0.5s timeout, and the controller loop must
             # not stall on M x N sequential probes
@@ -524,11 +538,18 @@ class DeploymentController:
                 self._scale_down_streak.pop(streak_key, None)
                 new_replicas[pspec.name] = desired
             elif desired < current:
-                streak = self._scale_down_streak.get(streak_key, 0) + 1
-                self._scale_down_streak[streak_key] = streak
-                if streak >= self.scale_down_ticks:
+                if desired > observed:
+                    # load demands MORE capacity than is actually serving;
+                    # the spec merely hasn't materialized yet. Not a
+                    # low-load signal — don't let reconcile lag accumulate
+                    # into a streak that shrinks the spec.
                     self._scale_down_streak.pop(streak_key, None)
-                    new_replicas[pspec.name] = desired
+                else:
+                    streak = self._scale_down_streak.get(streak_key, 0) + 1
+                    self._scale_down_streak[streak_key] = streak
+                    if streak >= self.scale_down_ticks:
+                        self._scale_down_streak.pop(streak_key, None)
+                        new_replicas[pspec.name] = desired
             else:
                 self._scale_down_streak.pop(streak_key, None)
         if not new_replicas:

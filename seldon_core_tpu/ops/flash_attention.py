@@ -66,6 +66,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, causal):
             s = jnp.where(row >= col, s, NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
+        # Masked entries hold NEG_INF (finite -1e30): under this kernel's
+        # causal dispatch every row admits column 0, so m_new is finite
+        # after the first k-block and exp(NEG_INF - m_new) underflows to
+        # exactly 0 — no NaN, no select needed in the hot loop. A mask
+        # that fully hides a row would leave m_new == NEG_INF and make
+        # p == 1 per entry (an unweighted mean of V, not zeros); reuse
+        # with such masks requires a p = where(s == NEG_INF, 0, ...) guard.
         p = jnp.exp(s - m_new)
         l = l * alpha + p.sum(axis=-1, keepdims=True)
         o = o * alpha + jax.lax.dot_general(
@@ -83,9 +90,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, causal):
     m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l = jnp.zeros((block_q, 1), jnp.float32)
     o, m, l = lax.fori_loop(0, n_k, body, (o, m, l))
-    # fully-masked rows (l == 0) would divide 0/0; emit zeros like the
-    # XLA softmax path never does — callers only read real rows, but the
-    # kernel must not poison the block with NaNs
+    # l == 0 is unreachable via the causal equal-block dispatch (see the
+    # loop-body comment); kept as a belt against 0/0 if the kernel is
+    # rebuilt with a row-hiding mask
     l = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (o / l).astype(o_ref.dtype)
 

@@ -599,6 +599,60 @@ def test_autoscale_up_down_with_stabilization():
     run(go())
 
 
+def test_autoscale_reconcile_lag_never_instant_downscales():
+    """Spec says N but fewer replicas are serving (reconcile lag /
+    placement cap): a desired between the two is NOT an immediate
+    scale-up write (that would cut the spec without the stabilization
+    streak) and NOT a scale-down streak tick (load demands more than is
+    serving)."""
+
+    async def go():
+        store = ResourceStore()
+        ctl = DeploymentController(store, runtime=InProcessRuntime(open_ports=False))
+        dep, _ = store.apply(hpa_dep(hi=10, replicas=6))
+        await ctl.reconcile(dep.clone())
+        # simulate lag: only 1 of the 6 is routable
+        engines = _engines(ctl)
+        for h in engines[1:]:
+            h.spec.routable = False
+        engines[0].app.inflight = 8  # desired ceil(8/4)=2: observed 1 < 2 < spec 6
+        for _ in range(5):  # never fires, in either direction
+            assert await ctl.autoscale_once() == {}
+        assert store.get("hdep").predictors[0].replicas == 6
+
+        # placement-capped variant: free=0 must not clamp desired down to
+        # the observed count (which would ratchet the spec down under
+        # sustained load via the streak)
+        class _CappedPlacement:
+            def capacity(self):
+                return {"free": 0, "total": 8, "used": 8}
+
+        ctl.placement = _CappedPlacement()
+        for pspec in store.get("hdep").predictors:
+            pspec.tpu_mesh = {"model": 1}
+        for h in engines:
+            h.spec.routable = False
+        engines[0].spec.routable = engines[1].spec.routable = True
+        engines[0].app.inflight = engines[1].app.inflight = 8
+        # total 16, target 4 -> desired 4: > observed 2, < spec 6 -> no-op
+        for _ in range(5):
+            assert await ctl.autoscale_once() == {}
+        assert store.get("hdep").predictors[0].replicas == 6
+        ctl.placement = None
+        for pspec in store.get("hdep").predictors:
+            pspec.tpu_mesh = None
+        # once lag clears (all serving), low load starts a real streak
+        for h in engines:
+            h.spec.routable = True
+            h.app.inflight = 0
+        assert await ctl.autoscale_once() == {}
+        assert await ctl.autoscale_once() == {}
+        assert await ctl.autoscale_once() == {"default/hdep/p0": 1}
+        await ctl.shutdown()
+
+    run(go())
+
+
 def test_autoscale_scale_event_keeps_existing_replicas():
     """Scaling must ADD replica components, not replace the running ones
     (the reference HPA scales the Deployment without a pod-template
