@@ -389,6 +389,9 @@ struct Engine {
   // engine's probe; reference preStop was a blind 10s sleep)
   std::atomic<int64_t> inflight{0};
   Metrics metrics;
+  // request-body cap: env default, overridable per spec via the
+  // seldon.io/rest-max-body annotation (parity with graph/service.py)
+  size_t max_body_bytes = 0;  // set in engine_start
   int port = 8000;
   int threads = 1;
   std::atomic<bool> stopping{false};
@@ -515,6 +518,14 @@ static int upstream_timeout_ms() {
   }();
   return ms;
 }
+
+// Request-body cap (413 above it), python twin http_server.py
+// DEFAULT_MAX_BODY_BYTES; same env knob as the wrapper's.
+static size_t g_max_body_bytes = [] {
+  const char* e = getenv("SELDON_REST_MAX_BODY");
+  long v = e ? atol(e) : 0;
+  return v > 0 ? (size_t)v : (size_t)(64u << 20);
+}();
 
 static void set_io_timeouts(int fd, int ms) {
   if (ms < 1) ms = 1;
@@ -845,6 +856,13 @@ struct Conn {
   size_t need_total = 0;  // 0 = headers not yet parsed
   bool close_after_flush = false;
   bool want_epollout = false;
+  // half-close drain: after a terminal error response (413 etc.) the
+  // request body may still be inbound; close(fd) with unread data RSTs
+  // the socket and can destroy the response before the client reads it.
+  // Instead: shutdown(SHUT_WR), discard inbound until FIN/deadline.
+  bool draining = false;
+  size_t drained = 0;
+  std::chrono::steady_clock::time_point drain_deadline{};
 };
 
 static std::atomic<uint64_t> g_puid_counter{1};
@@ -866,6 +884,7 @@ static std::string gen_puid(std::mt19937&) {
 static void http_response(std::string& out, int status, const std::string& body,
                           const char* ctype = "application/json") {
   const char* reason = status == 200 ? "OK" : status == 400 ? "Bad Request" : status == 404 ? "Not Found"
+                       : status == 413 ? "Payload Too Large"
                        : status == 503 ? "Service Unavailable" : "Internal Server Error";
   char head[256];
   int n = snprintf(head, sizeof head,
@@ -1460,7 +1479,12 @@ static bool process_buffer(Engine& eng, Conn& c, std::mt19937& rng,
         const char* cl = strcasestr(c.in.c_str(), "content-length:");
         if (cl && cl < c.in.c_str() + header_end) content_length = strtoul(cl + 15, nullptr, 10);
       }
-      if (content_length > (1u << 26)) { http_response(c.out, 400, error_json(400, "body too large")); return false; }
+      if (content_length > eng.max_body_bytes) {
+        // 413 before buffering: one Content-Length must not OOM the engine
+        // (python twin: http_server.py max_body_bytes)
+        http_response(c.out, 413, error_json(413, "body too large"));
+        return false;
+      }
       c.need_total = header_end + 4 + content_length;
     }
     if (c.in.size() < c.need_total) return true;  // need more bytes
@@ -1516,6 +1540,9 @@ static bool process_buffer(Engine& eng, Conn& c, std::mt19937& rng,
         if (binary) http_response(c.out, 503, proto_error_bytes(503, "paused"), "application/x-protobuf");
         else http_response(c.out, 503, error_json(503, "paused"));
       } else {
+        // feedback counts toward /inflight so rolling-update drain sees it,
+        // matching the Python engine (graph/service.py send_feedback gauge)
+        InflightGuard guard(eng.inflight);
         double reward = 0.0;
         if (binary) {
           seldontpu::Feedback fb;
@@ -1639,14 +1666,28 @@ static void event_loop(Engine* eng, int listen_fd, unsigned seed) {
       if (events[i].events & EPOLLIN) {
         for (;;) {
           ssize_t r = read(fd, buf, sizeof buf);
-          if (r > 0) c.in.append(buf, r);
+          if (r > 0) {
+            if (c.draining || c.close_after_flush) {
+              // terminal-error connection: discard the rest of the request
+              // instead of buffering it (and NEVER re-parse — the 413/400
+              // left the offending request unconsumed in c.in)
+              c.drained += (size_t)r;
+              // generous cap: the 1s drain_deadline is the real bound;
+              // a small byte cap would RST fast senders mid-upload and
+              // destroy the error response we just queued
+              if (c.drained > (256u << 20)) { closing = true; break; }
+            } else {
+              c.in.append(buf, r);
+            }
+          }
           else if (r == 0) { closing = true; break; }
           else {
             if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) closing = true;
             break;
           }
         }
-        if (!closing && !process_buffer(*eng, c, rng, upstreams)) c.close_after_flush = true;
+        if (!closing && !c.draining && !c.close_after_flush &&
+            !process_buffer(*eng, c, rng, upstreams)) c.close_after_flush = true;
       }
       // flush output; on short write, arm EPOLLOUT so the kernel wakes us
       // when the send buffer drains (a waiting HTTP client sends nothing
@@ -1669,9 +1710,23 @@ static void event_loop(Engine* eng, int listen_fd, unsigned seed) {
         mev.data.fd = fd;
         epoll_ctl(ep, EPOLL_CTL_MOD, fd, &mev);
       }
-      if (closing || (flushed && c.close_after_flush)) {
+      if (closing) {
         close(fd);
         conns.erase(it);
+      } else if (flushed && c.close_after_flush && !c.draining) {
+        shutdown(fd, SHUT_WR);
+        c.draining = true;
+        c.drain_deadline = std::chrono::steady_clock::now() + std::chrono::seconds(1);
+      }
+    }
+    // reap draining conns whose peer never sent FIN (rare; bounded scan)
+    for (auto it2 = conns.begin(); it2 != conns.end();) {
+      if (it2->second.draining &&
+          std::chrono::steady_clock::now() >= it2->second.drain_deadline) {
+        close(it2->first);
+        it2 = conns.erase(it2);
+      } else {
+        ++it2;
       }
     }
   }
@@ -1688,7 +1743,18 @@ static Engine* engine_start(const std::string& spec_json, int port, int threads)
   json::Value spec = p.parse();
   if (!p.ok) return nullptr;
   auto* eng = new Engine();
+  eng->max_body_bytes = g_max_body_bytes;
   if (auto* name = spec.find("name")) eng->deployment = name->str;
+  if (auto* ann = spec.find("annotations")) {
+    if (ann->type == json::Value::Obj) {
+      if (auto* mb = ann->find("seldon.io/rest-max-body")) {
+        long v = 0;
+        if (mb->type == json::Value::Num) v = (long)mb->num;
+        else if (mb->type == json::Value::Str) v = atol(mb->str.c_str());
+        if (v > 0) eng->max_body_bytes = (size_t)v;
+      }
+    }
+  }
   const json::Value* graph = spec.find("graph");
   if (!graph) { delete eng; return nullptr; }
   eng->root = parse_unit(*graph);

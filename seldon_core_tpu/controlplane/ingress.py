@@ -233,7 +233,11 @@ class Gateway:
         return await asyncio.get_running_loop().run_in_executor(None, do_post)
 
     def app(self) -> HTTPServer:
-        server = HTTPServer("gateway")
+        from ..http_server import max_body_from_env
+
+        # the gateway fronts every engine: its cap must be raisable too or
+        # a raised engine-side seldon.io/rest-max-body dies at the gateway
+        server = HTTPServer("gateway", max_body_bytes=max_body_from_env())
         gw = self
 
         async def token_endpoint(req: Request) -> Response:

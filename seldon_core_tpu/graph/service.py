@@ -168,7 +168,28 @@ class EngineApp:
     # -- REST front ---------------------------------------------------------
 
     def rest_app(self) -> HTTPServer:
-        app = HTTPServer("engine-rest")
+        from .executor import _ann_int, _ann_seconds
+
+        # request-size / read-timeout limits come off predictor annotations
+        # like the reference's message-size knobs
+        # (InternalPredictionService.java:82-91); the default cap stops a
+        # single Content-Length from OOMing the engine
+        ann = getattr(self.spec, "annotations", None) or {}
+        from ..http_server import max_body_from_env
+
+        max_body = _ann_int(ann, "seldon.io/rest-max-body")
+        if not max_body or max_body <= 0:  # junk/non-positive -> default
+            max_body = max_body_from_env()
+        # DEDICATED server-side knob: seldon.io/rest-read-timeout keeps its
+        # pre-existing meaning (client timeout on engine->unit hops,
+        # executor.py) — reusing it here would retune existing deployments'
+        # server front behind their backs
+        read_timeout = _ann_seconds(ann, "seldon.io/rest-server-read-timeout", 0.0)
+        if read_timeout <= 0:  # junk/negative/absent -> no server timeout
+            read_timeout = None
+        app = HTTPServer(
+            "engine-rest", max_body_bytes=max_body, read_timeout_s=read_timeout
+        )
 
         PROTO_TYPES = ("application/x-protobuf", "application/octet-stream")
 
@@ -317,8 +338,12 @@ class EngineApp:
     # -- gRPC front ---------------------------------------------------------
 
     def grpc_server(self, max_workers: int = 4, max_message_bytes: Optional[int] = None):
-        # the engine's own gRPC front honors seldon.io/grpc-max-message-size
-        # like the reference's SeldonGrpcServer (SeldonGrpcServer.java:40)
+        """grpc.aio server registering the Seldon service
+        (reference: SeldonGrpcServer.java:40-143).
+
+        Honors ``seldon.io/grpc-max-message-size`` like the reference's
+        SeldonGrpcServer (SeldonGrpcServer.java:40) when no explicit limit
+        is passed."""
         if max_message_bytes is None:
             from .executor import _ann_int
 
@@ -326,8 +351,6 @@ class EngineApp:
                 getattr(self.spec, "annotations", None) or {},
                 "seldon.io/grpc-max-message-size",
             )
-        """grpc.aio server registering the Seldon service
-        (reference: SeldonGrpcServer.java:40-143)."""
         import grpc
 
         options = []

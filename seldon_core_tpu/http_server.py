@@ -29,9 +29,30 @@ _STATUS_TEXT = {
     400: "Bad Request",
     404: "Not Found",
     405: "Method Not Allowed",
+    408: "Request Timeout",
+    413: "Payload Too Large",
     500: "Internal Server Error",
     503: "Service Unavailable",
 }
+
+# Request bodies are buffered in memory before dispatch, so an unbounded
+# Content-Length is an OOM vector; the reference caps engine payloads the
+# same way (InternalPredictionService.java:82-91 message-size annotations).
+# Overridable per server via ``seldon.io/rest-max-body``.
+DEFAULT_MAX_BODY_BYTES = 64 * 1024 * 1024
+
+
+def max_body_from_env(default: int = DEFAULT_MAX_BODY_BYTES) -> int:
+    """``SELDON_REST_MAX_BODY`` for servers with no predictor annotations
+    (wrapper, gateway, request logger). Non-positive or junk values fall
+    back to the default, matching the native engine's g_max_body_bytes."""
+    import os
+
+    try:
+        v = int(os.environ["SELDON_REST_MAX_BODY"])
+    except (KeyError, ValueError):
+        return default
+    return v if v > 0 else default
 
 
 class Request:
@@ -127,10 +148,19 @@ class StreamingResponse:
 class HTTPServer:
     """Exact-path router + asyncio serve loop."""
 
-    def __init__(self, name: str = "http"):
+    def __init__(
+        self,
+        name: str = "http",
+        max_body_bytes: Optional[int] = DEFAULT_MAX_BODY_BYTES,
+        read_timeout_s: Optional[float] = None,
+    ):
         self.name = name
         self.routes: Dict[str, Handler] = {}
         self.prefix_routes: Dict[str, Handler] = {}
+        self.max_body_bytes = max_body_bytes
+        # slowloris guard: cap the wall-clock wait for a request's bytes
+        # once the first header byte could have arrived
+        self.read_timeout_s = read_timeout_s
         self._server: Optional[asyncio.AbstractServer] = None
 
     def route(self, path: str):
@@ -164,21 +194,53 @@ class HTTPServer:
             logger.error("handler %s failed: %s\n%s", req.path, e, traceback.format_exc())
             return Response(error_body(500, f"{type(e).__name__}: {e}"), 500)
 
+    async def _bail(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter, resp: Response):
+        """Terminal error response on a connection that will close with
+        request bytes possibly still inbound (oversized/stalled body).
+        Flush the response, then absorb a bounded amount of the unread
+        body — closing with unread data in the kernel buffer RSTs the
+        socket and can destroy the response before the client reads it."""
+        writer.write(resp.encode(False))
+        try:
+            await writer.drain()
+            loop = asyncio.get_running_loop()
+            # wall-clock-bounded (not byte-capped) drain: chunks are
+            # discarded so memory is constant, the deadline bounds CPU,
+            # and a byte cap would reintroduce the RST for any fast
+            # sender past it (a real 64MB upload clears in well under 1s
+            # on loopback/datacenter links)
+            deadline = loop.time() + 1.0
+            while loop.time() < deadline:
+                chunk = await asyncio.wait_for(reader.read(65536), 0.5)
+                if not chunk:
+                    break
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass
+
     async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         try:
             while True:
                 try:
-                    header_blob = await reader.readuntil(b"\r\n\r\n")
-                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    if self.read_timeout_s:
+                        # slowloris guard doubling as the keep-alive idle
+                        # reaper: a connection that can't produce a full
+                        # header block in time is closed (silently — an
+                        # idle keep-alive conn isn't an error)
+                        header_blob = await asyncio.wait_for(
+                            reader.readuntil(b"\r\n\r\n"), self.read_timeout_s
+                        )
+                    else:
+                        header_blob = await reader.readuntil(b"\r\n\r\n")
+                except (asyncio.TimeoutError, asyncio.IncompleteReadError, ConnectionResetError):
                     break
                 except asyncio.LimitOverrunError:
-                    writer.write(Response(error_body(400, "headers too large"), 400).encode(False))
+                    await self._bail(reader, writer, Response(error_body(400, "headers too large"), 400))
                     break
                 lines = header_blob.decode("latin-1").split("\r\n")
                 try:
                     method, target, _version = lines[0].split(" ", 2)
                 except ValueError:
-                    writer.write(Response(error_body(400, "bad request line"), 400).encode(False))
+                    await self._bail(reader, writer, Response(error_body(400, "bad request line"), 400))
                     break
                 headers: Dict[str, str] = {}
                 for line in lines[1:]:
@@ -191,9 +253,37 @@ class HTTPServer:
                 except ValueError:
                     length = -1
                 if length < 0:
-                    writer.write(Response(error_body(400, "bad Content-Length"), 400).encode(False))
+                    await self._bail(reader, writer, Response(error_body(400, "bad Content-Length"), 400))
                     break
-                body = await reader.readexactly(length) if length else b""
+                if self.max_body_bytes is not None and length > self.max_body_bytes:
+                    # reject before reading: never buffer an oversized body
+                    await self._bail(
+                        reader,
+                        writer,
+                        Response(
+                            error_body(
+                                413,
+                                f"body {length} bytes exceeds limit "
+                                f"{self.max_body_bytes}",
+                            ),
+                            413,
+                        ),
+                    )
+                    break
+                try:
+                    if length and self.read_timeout_s:
+                        body = await asyncio.wait_for(
+                            reader.readexactly(length), self.read_timeout_s
+                        )
+                    else:
+                        body = await reader.readexactly(length) if length else b""
+                except asyncio.TimeoutError:
+                    await self._bail(
+                        reader, writer, Response(error_body(408, "body read timed out"), 408)
+                    )
+                    break
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    break
                 parts = urlsplit(target)
                 req = Request(method, unquote(parts.path), parts.query, headers, body)
                 keep = headers.get("connection", "keep-alive").lower() != "close"

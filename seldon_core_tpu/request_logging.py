@@ -204,7 +204,9 @@ class RequestLoggerApp:
         return docs
 
     def app(self) -> HTTPServer:
-        srv = HTTPServer("request-logger")
+        from .http_server import max_body_from_env
+
+        srv = HTTPServer("request-logger", max_body_bytes=max_body_from_env())
 
         async def index(req: Request) -> Response:
             body = req.json()

@@ -36,9 +36,18 @@ class ServerState:
 
 
 def get_rest_microservice(
-    user_object, state: Optional[ServerState] = None, hook_workers: int = 64
+    user_object,
+    state: Optional[ServerState] = None,
+    hook_workers: int = 64,
+    max_body_bytes: Optional[int] = None,
 ) -> HTTPServer:
-    app = HTTPServer("microservice-rest")
+    if max_body_bytes is None:
+        # env counterpart of the engine's seldon.io/rest-max-body
+        # annotation — the wrapper has no predictor spec to read
+        from .http_server import max_body_from_env
+
+        max_body_bytes = max_body_from_env()
+    app = HTTPServer("microservice-rest", max_body_bytes=max_body_bytes)
     state = state or ServerState()
     # Hooks run on a pool OWNED by this app, not the loop's default
     # executor: a long-blocking hook (e.g. generate() waiting minutes on

@@ -1,0 +1,188 @@
+"""Request-body limits: oversized POSTs get 413 (not an OOM) on all three
+fronts — Python engine, microservice wrapper, native C++ engine — and the
+read timeout turns a stalled body into 408.
+
+Reference counterpart: the engine's message-size annotations
+(InternalPredictionService.java:82-91); here the cap guards the server side.
+"""
+
+import shutil
+import socket
+
+import pytest
+
+from _net import free_port, serve_on_thread
+
+from seldon_core_tpu.graph.service import EngineApp
+from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
+from seldon_core_tpu.wrapper import get_rest_microservice
+
+
+def raw_http(port, blob, timeout=5.0):
+    """Send raw bytes, return the decoded status line + body text."""
+    s = socket.create_connection(("127.0.0.1", port), timeout)
+    try:
+        s.sendall(blob)
+        s.settimeout(timeout)
+        buf = b""
+        while True:  # read until the server closes (all limit paths close)
+            try:
+                chunk = s.recv(65536)
+            except socket.timeout:
+                break
+            if not chunk:
+                break
+            buf += chunk
+        return buf.decode("latin-1")
+    finally:
+        s.close()
+
+
+def oversized_post(port, claimed_len):
+    """POST claiming a huge Content-Length but sending only a few bytes —
+    a capped server must answer from the headers alone, without waiting
+    for (or buffering) the body."""
+    head = (
+        f"POST /api/v0.1/predictions HTTP/1.1\r\nHost: x\r\n"
+        f"Content-Type: application/json\r\nContent-Length: {claimed_len}\r\n\r\n"
+    ).encode()
+    return raw_http(port, head + b"{}")
+
+
+def engine_app(annotations):
+    spec = default_predictor(
+        PredictorSpec.from_dict(
+            {
+                "name": "cap",
+                "annotations": annotations,
+                "graph": {"name": "m", "implementation": "SIMPLE_MODEL"},
+            }
+        )
+    )
+    return EngineApp(spec)
+
+
+def test_engine_annotation_cap_413():
+    app = engine_app({"seldon.io/rest-max-body": "1024"})
+    port = free_port()
+    stop = serve_on_thread(app.rest_app().serve_forever("127.0.0.1", port), port)
+    try:
+        out = oversized_post(port, 10_000)
+        assert out.startswith("HTTP/1.1 413"), out[:200]
+        assert "exceeds limit 1024" in out
+    finally:
+        stop()
+
+
+def test_engine_default_cap_is_64mb():
+    app = engine_app({})
+    port = free_port()
+    stop = serve_on_thread(app.rest_app().serve_forever("127.0.0.1", port), port)
+    try:
+        out = oversized_post(port, 65 * 1024 * 1024)
+        assert out.startswith("HTTP/1.1 413"), out[:200]
+        # an in-cap request on the same server still works
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v0.1/predictions",
+            data=json.dumps({"data": {"ndarray": [[1.0]]}}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 200
+    finally:
+        stop()
+
+
+def test_wrapper_cap_413(monkeypatch):
+    monkeypatch.setenv("SELDON_REST_MAX_BODY", "2048")
+    import numpy as np
+
+    class M:
+        def predict(self, X, names, meta=None):
+            return np.asarray(X)
+
+    app = get_rest_microservice(M())
+    assert app.max_body_bytes == 2048
+    port = free_port()
+    stop = serve_on_thread(app.serve_forever("127.0.0.1", port), port)
+    try:
+        head = (
+            "POST /predict HTTP/1.1\r\nHost: x\r\n"
+            "Content-Type: application/json\r\nContent-Length: 9999\r\n\r\n"
+        ).encode()
+        out = raw_http(port, head + b"{}")
+        assert out.startswith("HTTP/1.1 413"), out[:200]
+    finally:
+        stop()
+
+
+def test_read_timeout_stalled_body_408():
+    from seldon_core_tpu.http_server import HTTPServer, Response
+
+    srv = HTTPServer("t", read_timeout_s=0.3)
+
+    async def ok(req):
+        return Response({"ok": True})
+
+    srv.add_route("/p", ok)
+    port = free_port()
+    stop = serve_on_thread(srv.serve_forever("127.0.0.1", port), port)
+    try:
+        head = (
+            "POST /p HTTP/1.1\r\nHost: x\r\n"
+            "Content-Type: application/json\r\nContent-Length: 10\r\n\r\n"
+        ).encode()
+        # body never arrives -> 408 after the 0.3s read timeout
+        out = raw_http(port, head + b"123", timeout=3.0)
+        assert out.startswith("HTTP/1.1 408"), out[:200]
+    finally:
+        stop()
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_native_engine_cap_413():
+    from seldon_core_tpu.native_engine import NativeEngine, build
+
+    build()
+    port = free_port()
+    spec = {"name": "cap", "graph": {"name": "m", "implementation": "SIMPLE_MODEL"}}
+    with NativeEngine(spec, port=port):
+        from _net import wait_port
+
+        wait_port(port)
+        out = oversized_post(port, 65 * 1024 * 1024)
+        assert out.startswith("HTTP/1.1 413"), out[:200]
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_native_engine_annotation_cap_parity():
+    """seldon.io/rest-max-body on the spec governs the native front too
+    (parity with the Python engine's rest_app)."""
+    import json
+    import urllib.request
+
+    from seldon_core_tpu.native_engine import NativeEngine, build
+
+    build()
+    port = free_port()
+    spec = {
+        "name": "cap2",
+        "annotations": {"seldon.io/rest-max-body": "4096"},
+        "graph": {"name": "m", "implementation": "SIMPLE_MODEL"},
+    }
+    with NativeEngine(spec, port=port):
+        from _net import wait_port
+
+        wait_port(port)
+        out = oversized_post(port, 10_000)  # over 4096, far under 64MB
+        assert out.startswith("HTTP/1.1 413"), out[:200]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v0.1/predictions",
+            data=json.dumps({"data": {"ndarray": [[1.0, 2.0]]}}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 200
