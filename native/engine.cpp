@@ -1004,6 +1004,13 @@ static bool b64_decode(const std::string& in, std::string& out) {
 
 // decode a RawTensor (rank 1 or 2) into internal numeric rows
 static bool raw_to_rows(const seldontpu::RawTensor& r, json::Value& ndarray, std::string& err) {
+  if (!r.encoding().empty()) {
+    // compressed raw (zlib/jpeg-rows) is decoded host-side by the Python
+    // model tier (payload.raw_to_array); builtin units on the native
+    // front take plain LE bytes only — fail loudly, never misparse
+    err = "raw encoding '" + r.encoding() + "' unsupported by native builtin units";
+    return false;
+  }
   int64_t rows = 1, cols = 1;
   if (r.shape_size() == 1) cols = r.shape(0);
   else if (r.shape_size() == 2) { rows = r.shape(0); cols = r.shape(1); }

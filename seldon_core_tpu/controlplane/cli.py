@@ -61,6 +61,15 @@ def main(argv=None) -> None:
     )
     p_status.add_argument("name")
 
+    p_render = sub.add_parser(
+        "render",
+        help="emit Kubernetes YAML (Deployments/Services/HPAs/VirtualService "
+        "with GKE TPU node-pool scheduling) for a SeldonDeployment",
+    )
+    p_render.add_argument("-f", "--filename", required=True)
+    p_render.add_argument("-o", "--output", default="-",
+                          help="output file (default stdout)")
+
     p_ctl = sub.add_parser("controller")
     p_ctl.add_argument("--gateway-port", type=int, default=int(os.environ.get("GATEWAY_PORT", 8003)))
     p_ctl.add_argument("--subprocess-runtime", action="store_true",
@@ -81,6 +90,24 @@ def main(argv=None) -> None:
             dep.namespace = args.namespace
         dep, event = store.apply(dep)
         print(f"seldondeployment.machinelearning.seldon.io/{dep.name} {event.lower()}")
+        return
+
+    if args.cmd == "render":
+        from .k8s import render, to_yaml, validate_manifests
+
+        with open(args.filename) as f:
+            dep = SeldonDeployment.from_dict(json.load(f))
+        if dep.namespace == "default" and args.namespace != "default":
+            dep.namespace = args.namespace
+        manifests = render(dep)
+        validate_manifests(manifests)
+        out = to_yaml(manifests)
+        if args.output == "-":
+            sys.stdout.write(out)
+        else:
+            with open(args.output, "w") as f:
+                f.write(out)
+            print(f"wrote {len(manifests)} objects to {args.output}", file=sys.stderr)
         return
 
     if args.cmd == "get":

@@ -227,6 +227,24 @@ def _mfu(rows_per_s: float, flops_per_row: Optional[float], peak: Optional[float
     return round(100.0 * rows_per_s * flops_per_row / peak, 2)
 
 
+def measure_h2d_mb_s(nbytes: int = 8 << 20, reps: int = 3) -> float:
+    """Measured host->device copy bandwidth (MB/s). On tunneled
+    environments this IS the wire tier's roofline: a serving bench that
+    moves uint8 images to HBM per request can never beat
+    h2d_bw / bytes_per_row rows/s, whatever the model does. Published
+    next to the wire-tier numbers so they are judged against the pipe."""
+    import jax
+
+    arr = np.random.RandomState(0).randint(0, 255, nbytes, dtype=np.uint8)
+    best = 0.0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.device_put(arr).block_until_ready()
+        dt = time.perf_counter() - t0
+        best = max(best, nbytes / dt / 1e6)
+    return best
+
+
 def _lat_summary(latencies: List[float]) -> Dict[str, float]:
     """p50/p99/mean (ms) with one percentile convention for every bench."""
     lat = np.sort(np.asarray(latencies, dtype=np.float64))
@@ -274,17 +292,29 @@ def bench_resnet50_rest(
     image_size: int = 224,
     max_batch: int = 128,
     peak: Optional[float] = None,
+    wire_encoding: str = "jpeg-rows",
+    jpeg_quality: int = 85,
+    h2d_mb_s: Optional[float] = None,
 ) -> Dict[str, Any]:
-    """ResNet-50 behind engine REST: binary SeldonMessage body carrying a
-    raw uint8 image tensor (no JSON text parse, no base64 on the wire).
+    """ResNet-50 behind engine REST: binary SeldonMessage body carrying an
+    image tensor — by default JPEG-per-row compressed (``RawTensor.encoding
+    = "jpeg-rows"``), decoded host-side before ``to_device``.
+
+    The wire tier is transport-bound, not compute-bound: on this
+    environment's ~35 MB/s host tunnel a raw 224x224x3 uint8 row is
+    ~150 KB, its JPEG ~10-25 KB, so compression moves the transport
+    roofline ~5-10x. The published entry includes that roofline
+    (``wire_bytes_per_row``, ``transport_bound_rows_per_s`` at the
+    measured pipe) so the number is judged against the pipe, not the
+    chip. Pass ``wire_encoding=""`` for the uncompressed baseline.
 
     MODEL-unit micro-batching is on (the framework's own engine-side
     dynamic batching): concurrent unary requests fuse into one XLA launch,
     so the per-request host->device round-trip amortises across the fused
-    group — the difference between ~1 transfer sync per request and one
-    per ``max_batch`` rows."""
+    group."""
     import http.client
 
+    from .payload import array_to_raw
     from .proto import prediction_pb2 as pb
     from .servers.jaxserver import JAXServer
 
@@ -300,11 +330,8 @@ def bench_resnet50_rest(
     img = np.random.RandomState(0).randint(
         0, 256, (batch, image_size, image_size, 3), dtype=np.uint8
     )
-    body = pb.SeldonMessage(
-        data=pb.DefaultData(
-            raw=pb.RawTensor(dtype="uint8", shape=list(img.shape), data=img.tobytes())
-        )
-    ).SerializeToString()
+    raw = array_to_raw(img, encoding=wire_encoding, jpeg_quality=jpeg_quality)
+    body = pb.SeldonMessage(data=pb.DefaultData(raw=raw)).SerializeToString()
     headers = {"Content-Type": "application/x-protobuf", "Connection": "keep-alive"}
     port = harness.http_port
 
@@ -326,16 +353,29 @@ def bench_resnet50_rest(
     finally:
         harness.stop()
     model = component._model
+    wire_bytes_per_row = len(body) / batch
     stats.update(
         {
             "model": "resnet50",
-            "transport": "engine REST, binary proto raw uint8",
+            "transport": "engine REST, binary proto "
+            + (f"raw uint8 ({wire_encoding})" if wire_encoding else "raw uint8"),
             "batch": batch,
             "microbatch_max": max_batch,
             "image_size": image_size,
             "mfu_pct": _mfu(stats["rows_per_s"], model.flops_per_row(), peak),
+            "wire_bytes_per_row": round(wire_bytes_per_row, 1),
         }
     )
+    if h2d_mb_s:
+        # transport roofline: decoded uint8 rows still cross H2D at full
+        # size — the pipe, not the model, bounds this tier
+        h2d_bytes_per_row = image_size * image_size * 3
+        bound = h2d_mb_s * 1e6 / h2d_bytes_per_row
+        stats["h2d_mb_s"] = round(h2d_mb_s, 1)
+        stats["transport_bound_rows_per_s"] = round(bound, 1)
+        stats["pct_of_transport_roofline"] = round(
+            100.0 * stats["rows_per_s"] / bound, 1
+        )
     return stats
 
 
@@ -619,14 +659,24 @@ def run_model_tier(
             )
         else:
             # the raw-image path is transfer-bound and the most sensitive
-            # to transient tunnel congestion: take the best of three runs
-            # (recorded as best_of so the number is honest about itself)
+            # to transient tunnel congestion: take the best of three runs,
+            # and publish the median alongside (best_of alone is a
+            # generous estimator)
+            import statistics
+
+            h2d = measure_h2d_mb_s()
             runs = [
-                bench_resnet50_rest(root, seconds=seconds, peak=peak)
+                bench_resnet50_rest(root, seconds=seconds, peak=peak, h2d_mb_s=h2d)
                 for _ in range(3)
             ]
             best = max(runs, key=lambda r: r["rows_per_s"])
             best["best_of"] = len(runs)
+            best["median_rows_per_s"] = round(
+                statistics.median(r["rows_per_s"] for r in runs), 2
+            )
+            best["median_p50_ms"] = round(
+                statistics.median(r["p50_ms"] for r in runs), 3
+            )
             results["resnet50_rest"] = best
             results["resnet50_device"] = bench_resnet50_device(
                 root, seconds=seconds, peak=peak
@@ -652,6 +702,9 @@ def run_model_tier(
             ]
             best_gen = max(gen_runs, key=lambda r: r["tokens_per_s"])
             best_gen["best_of"] = len(gen_runs)
+            best_gen["median_tokens_per_s"] = round(
+                statistics.median(r["tokens_per_s"] for r in gen_runs), 2
+            )
             results["llm_generate"] = best_gen
             # long-context serving: 1792-token prompts prefill through the
             # Pallas flash kernel, the decode read follows the live prefix

@@ -4,9 +4,12 @@ The reference bridged to an external TF-Serving container over gRPC/REST
 (reference: integrations/tfserving/TfServingProxy.py:21-60 and the
 TENSORFLOW_SERVER wiring in operator/controllers/
 seldondeployment_prepackaged_servers.go:30-107). TPU-native design: no
-sidecar — load the SavedModel and execute it with jax2tf round-trip or,
-when tensorflow is absent (this image), fail with a clear error telling
-users to export to the jaxserver format instead.
+sidecar — load the SavedModel with ``tf.saved_model.load`` and execute
+its serving signature directly. NOTE: tensorflow is absent from this
+image, so the real-loader branch has never executed here — it is
+exercised only through the injectable ``loader`` seam (tests inject a
+fake); when tensorflow is missing at runtime the server fails with a
+clear error telling users to export to the jaxserver format instead.
 """
 
 from __future__ import annotations

@@ -1,0 +1,90 @@
+"""Minimal helm-template expander for chart golden tests.
+
+The image has no ``helm`` binary, so tests expand the charts with this
+restricted gotpl subset — enough for the deliberately-simple templates in
+deploy/helm/ (plain ``{{ .path }}`` substitutions and possibly-nested
+``{{- if <.path|not .path> }} ... {{- end }}`` blocks). Anything fancier
+in a template is a test failure by design: it would mean the charts can
+no longer be validated in CI.
+"""
+
+import re
+from pathlib import Path
+
+_SUB = re.compile(r"\{\{-?\s*([^{}]+?)\s*-?\}\}")
+_IF = re.compile(r"^\s*\{\{-\s*if\s+(not\s+)?([.\w]+)\s*\}\}\s*$")
+_END = re.compile(r"^\s*\{\{-\s*end\s*\}\}\s*$")
+
+
+def _lookup(ctx: dict, path: str):
+    cur = ctx
+    for part in path.lstrip(".").split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            raise KeyError(f"template references unknown value {path}")
+        cur = cur[part]
+    return cur
+
+
+def _truthy(v) -> bool:
+    return bool(v) and v not in (0, "", "false", "False")
+
+
+def render_template(text: str, values: dict, release_name: str,
+                    namespace: str = "default") -> str:
+    ctx = {"Values": values, "Release": {"Name": release_name, "Namespace": namespace},
+           "Chart": {"Name": "chart"}}
+    out_lines = []
+    # stack of bools: are we emitting at this nesting level?
+    emit_stack = [True]
+    for line in text.splitlines():
+        m = _IF.match(line)
+        if m:
+            negate, path = bool(m.group(1)), m.group(2)
+            val = _truthy(_lookup(ctx, path)) if emit_stack[-1] else False
+            emit_stack.append((not val if negate else val) and emit_stack[-1])
+            continue
+        if _END.match(line):
+            if len(emit_stack) == 1:
+                raise ValueError("unbalanced {{- end }}")
+            emit_stack.pop()
+            continue
+        if not emit_stack[-1]:
+            continue
+
+        def sub(m2):
+            expr = m2.group(1).strip()
+            if not expr.startswith("."):
+                raise ValueError(f"unsupported template expression {expr!r}")
+            v = _lookup(ctx, expr)
+            return str(v)
+
+        out_lines.append(_SUB.sub(sub, line))
+    if len(emit_stack) != 1:
+        raise ValueError("unbalanced {{- if }}")
+    return "\n".join(out_lines) + "\n"
+
+
+def render_chart(chart_dir, values_overrides: dict | None = None,
+                 release_name: str = "rel", namespace: str = "default") -> str:
+    """Expand every template in the chart against values.yaml (+overrides).
+    Returns one multi-doc YAML string."""
+    import yaml
+
+    chart = Path(chart_dir)
+    values = yaml.safe_load((chart / "values.yaml").read_text())
+
+    def deep_merge(base, over):
+        for k, v in over.items():
+            if isinstance(v, dict) and isinstance(base.get(k), dict):
+                deep_merge(base[k], v)
+            else:
+                base[k] = v
+
+    if values_overrides:
+        deep_merge(values, values_overrides)
+    docs = []
+    for tpl in sorted((chart / "templates").glob("*.yaml")):
+        rendered = render_template(tpl.read_text(), values, release_name, namespace)
+        if rendered.strip():
+            docs.append(rendered.strip())
+    return "\n---\n".join(docs) + "\n"

@@ -1,0 +1,25 @@
+"""Regenerate golden files after an INTENDED renderer/chart change:
+    python tests/golden/regen.py
+Diff-review the result before committing."""
+
+import copy
+import sys
+from pathlib import Path
+
+HERE = Path(__file__).parent
+sys.path.insert(0, str(HERE.parent))          # tests/ (for _helm)
+sys.path.insert(0, str(HERE.parent.parent))   # repo root
+
+from _helm import render_chart  # noqa: E402
+from test_k8s_render import CANARY_DEP, HELM  # noqa: E402
+
+from seldon_core_tpu.controlplane.k8s import render, to_yaml  # noqa: E402
+from seldon_core_tpu.controlplane.resource import SeldonDeployment  # noqa: E402
+
+(HERE / "canary_render.yaml").write_text(
+    to_yaml(render(SeldonDeployment.from_dict(copy.deepcopy(CANARY_DEP))))
+)
+(HERE / "helm_model_defaults.yaml").write_text(
+    render_chart(HELM / "seldon-tpu-model", release_name="iris", namespace="serving")
+)
+print("regenerated", [p.name for p in HERE.glob("*.yaml")])

@@ -196,3 +196,71 @@ def test_json_to_proto_nested_bytes_not_corrupted():
     lst = payload.json_to_proto({"seldonMessages": [msg, msg]}, pb.SeldonMessageList)
     assert len(lst.seldon_messages) == 2
     assert lst.seldon_messages[1].data.raw.data == arr.tobytes()
+
+
+# -- compressed raw encodings (wire tier) ------------------------------------
+
+
+def test_raw_zlib_round_trip():
+    arr = np.arange(48, dtype=np.float32).reshape(4, 12)
+    raw = payload.array_to_raw(arr, encoding="zlib")
+    assert raw.encoding == "zlib"
+    assert len(raw.data) != arr.nbytes  # actually transformed
+    out = payload.raw_to_array(raw)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_raw_jpeg_rows_round_trip():
+    rng = np.random.default_rng(0)
+    # smooth gradient images compress well and survive JPEG closely
+    base = np.linspace(0, 255, 32 * 32 * 3).reshape(32, 32, 3)
+    arr = np.stack([
+        np.clip(base + rng.normal(0, 2, base.shape), 0, 255) for _ in range(3)
+    ]).astype(np.uint8)
+    raw = payload.array_to_raw(arr, encoding="jpeg-rows", jpeg_quality=95)
+    assert raw.encoding == "jpeg-rows"
+    assert len(raw.data) < arr.nbytes / 2  # the point: smaller on the wire
+    out = payload.raw_to_array(raw)
+    assert out.shape == arr.shape and out.dtype == np.uint8
+    # lossy but close
+    assert float(np.mean(np.abs(out.astype(int) - arr.astype(int)))) < 6.0
+
+
+def test_raw_jpeg_rows_error_paths():
+    arr = np.zeros((2, 8, 8, 3), np.uint8)
+    raw = payload.array_to_raw(arr, encoding="jpeg-rows")
+    # truncated blob
+    bad = pb.RawTensor(dtype="uint8", shape=[2, 8, 8, 3],
+                       data=raw.data[:-3], encoding="jpeg-rows")
+    with pytest.raises(payload.PayloadError, match="truncated|trailing"):
+        payload.raw_to_array(bad)
+    # wrong dtype
+    with pytest.raises(payload.PayloadError, match="uint8"):
+        payload.array_to_raw(arr.astype(np.float32), encoding="jpeg-rows")
+    # unknown encoding rejected both ways
+    with pytest.raises(payload.PayloadError, match="unknown raw encoding"):
+        payload.array_to_raw(arr, encoding="lz4")
+    weird = pb.RawTensor(dtype="uint8", shape=[1], data=b"x", encoding="lz4")
+    with pytest.raises(payload.PayloadError, match="unknown raw encoding"):
+        payload.raw_to_array(weird)
+
+
+def test_raw_zlib_garbage_rejected():
+    bad = pb.RawTensor(dtype="float32", shape=[2], data=b"notzlib",
+                       encoding="zlib")
+    with pytest.raises(payload.PayloadError, match="zlib"):
+        payload.raw_to_array(bad)
+
+
+def test_json_path_carries_raw_encoding():
+    arr = np.arange(8, dtype=np.float32).reshape(2, 4)
+    data = payload.array_to_json_data(arr, encoding="raw/zlib")
+    assert data["raw"]["encoding"] == "zlib"
+    out = payload.json_data_to_array(data)
+    np.testing.assert_array_equal(out, arr)
+    # proto round trip preserves the encoding through proto_to_json
+    msg = payload.json_to_proto({"data": data})
+    assert msg.data.raw.encoding == "zlib"
+    back = payload.proto_to_json(msg)
+    assert back["data"]["raw"]["encoding"] == "zlib"
+    np.testing.assert_array_equal(payload.json_data_to_array(back["data"]), arr)
