@@ -284,6 +284,29 @@ def _warm_buckets(
         component.predict(np.zeros((b, *shape), dtype=dtype), [])
 
 
+def _synthetic_images(batch: int, image_size: int) -> np.ndarray:
+    """Photo-like content: low-frequency structure + mild sensor noise.
+    Uniform random noise is JPEG's worst case (~60-100 KB/row at q85) and
+    would misrepresent the wire tier; real camera frames sit in the
+    10-40 KB range these synthetics land in."""
+    rs = np.random.RandomState(0)
+    y, x = np.mgrid[0:image_size, 0:image_size]
+    imgs = []
+    for _ in range(batch):
+        chans = []
+        for _c in range(3):
+            fx, fy = rs.uniform(0.5, 3.0, 2)
+            ph = rs.uniform(0, 2 * np.pi)
+            chans.append(
+                127.0
+                + 100.0 * np.sin(2 * np.pi * fx * x / image_size + ph)
+                * np.cos(2 * np.pi * fy * y / image_size)
+            )
+        img = np.stack(chans, -1) + rs.normal(0, 6.0, (image_size, image_size, 3))
+        imgs.append(np.clip(img, 0, 255))
+    return np.asarray(imgs, dtype=np.uint8)
+
+
 def bench_resnet50_rest(
     root: str,
     seconds: float = 8.0,
@@ -327,9 +350,7 @@ def bench_resnet50_rest(
     harness = EngineHarness(
         component, batching={"max_batch": max_batch, "timeout_ms": 25.0}
     ).start()
-    img = np.random.RandomState(0).randint(
-        0, 256, (batch, image_size, image_size, 3), dtype=np.uint8
-    )
+    img = _synthetic_images(batch, image_size)
     raw = array_to_raw(img, encoding=wire_encoding, jpeg_quality=jpeg_quality)
     body = pb.SeldonMessage(data=pb.DefaultData(raw=raw)).SerializeToString()
     headers = {"Content-Type": "application/x-protobuf", "Connection": "keep-alive"}
@@ -678,6 +699,12 @@ def run_model_tier(
                 statistics.median(r["p50_ms"] for r in runs), 3
             )
             results["resnet50_rest"] = best
+            # uncompressed baseline: comparability with earlier rounds and
+            # the honest view of the pipe without the codec
+            results["resnet50_rest_raw"] = bench_resnet50_rest(
+                root, seconds=seconds, peak=peak, wire_encoding="",
+                h2d_mb_s=h2d,
+            )
             results["resnet50_device"] = bench_resnet50_device(
                 root, seconds=seconds, peak=peak
             )

@@ -108,6 +108,8 @@ def _decode_jpeg_rows(data: bytes, shape, dtype: np.dtype) -> np.ndarray:
         raise PayloadError(f"jpeg-rows requires uint8, got {dtype.name}")
     if len(shape) < 3:
         raise PayloadError(f"jpeg-rows needs [N, H, W(, C)] shape, got {shape}")
+    if shape[0] <= 0:
+        raise PayloadError(f"jpeg-rows needs at least one row, got shape {shape}")
     try:
         import io
 
@@ -172,18 +174,26 @@ def raw_to_array(raw: pb.RawTensor) -> np.ndarray:
     encoding = getattr(raw, "encoding", "") or ""
     if encoding == "jpeg-rows":
         return _decode_jpeg_rows(raw.data, shape, dtype)
+    expected = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
     if encoding == "zlib":
         import zlib
 
+        # bounded decompress: cap at the shape-declared size so a few KB
+        # of 1000:1 zlib can't expand past the REST body cap into an OOM
+        # (the decompression-bomb twin of http_server's max_body_bytes)
+        d = zlib.decompressobj()
         try:
-            data = zlib.decompress(raw.data)
+            data = d.decompress(raw.data, expected + 1)
         except zlib.error as e:
             raise PayloadError(f"bad zlib raw tensor: {e}") from e
+        if len(data) > expected or d.unconsumed_tail or not d.eof:
+            raise PayloadError(
+                f"zlib raw tensor inflates past shape {shape} x {raw.dtype}"
+            )
     elif encoding == "":
         data = raw.data
     else:
         raise PayloadError(f"unknown raw encoding {encoding!r}")
-    expected = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
     if len(data) != expected:
         raise PayloadError(
             f"raw tensor: {len(data)} bytes != shape {shape} x {raw.dtype}"

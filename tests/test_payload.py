@@ -264,3 +264,22 @@ def test_json_path_carries_raw_encoding():
     back = payload.proto_to_json(msg)
     assert back["data"]["raw"]["encoding"] == "zlib"
     np.testing.assert_array_equal(payload.json_data_to_array(back["data"]), arr)
+
+
+def test_raw_zlib_bomb_bounded():
+    """A few KB of 1000:1 zlib declaring a tiny shape must not inflate
+    into host RAM past the declared size (decompression-bomb guard)."""
+    import zlib
+
+    bomb = zlib.compress(b"\x00" * (64 << 20), level=9)  # 64MB -> ~64KB
+    assert len(bomb) < 1 << 20
+    msg = pb.RawTensor(dtype="uint8", shape=[16], data=bomb, encoding="zlib")
+    with pytest.raises(payload.PayloadError, match="inflates past"):
+        payload.raw_to_array(msg)
+
+
+def test_raw_jpeg_rows_zero_rows_is_payload_error():
+    msg = pb.RawTensor(dtype="uint8", shape=[0, 8, 8, 3], data=b"",
+                       encoding="jpeg-rows")
+    with pytest.raises(payload.PayloadError, match="at least one row"):
+        payload.raw_to_array(msg)
