@@ -75,4 +75,33 @@ for i in $(seq 1 20); do
   [ "$i" = 20 ] && { echo "no logged pairs"; exit 1; }
 done
 
+say "converted-checkpoint export -> serve (convert.py path)"
+python - "$WORK" <<'PYEOF'
+import sys
+from seldon_core_tpu.models.llm import DecoderLM
+from seldon_core_tpu.convert import export_model
+cfg = dict(vocab_size=128, d_model=32, n_layers=2, n_heads=2, n_kv_heads=2,
+           d_ff=64, max_seq=64, dtype="float32")
+m = DecoderLM(**cfg)
+export_model("llm", cfg, m.init_params(0), sys.argv[1] + "/exported")
+print("exported ok")
+PYEOF
+cat > "$WORK/graph2.json" <<EOF
+{"name": "smoke2", "graph": {"name": "llm", "type": "MODEL",
+  "implementation": "GENERATE_SERVER", "modelUri": "$WORK/exported",
+  "parameters": [{"name": "slots", "type": "INT", "value": "2"}]}}
+EOF
+PORT2=$((PORT + 2))
+python -m seldon_core_tpu.engine_main --spec "$WORK/graph2.json" \
+    --http-port "$PORT2" --no-grpc >"$WORK/engine2.log" 2>&1 &
+for i in $(seq 1 120); do
+  curl -fsS "http://127.0.0.1:$PORT2/ready" >/dev/null 2>&1 && break
+  sleep 0.5
+  [ "$i" = 120 ] && { echo "exported engine never ready"; cat "$WORK/engine2.log"; exit 1; }
+done
+OUT=$(curl -fsS -X POST "http://127.0.0.1:$PORT2/api/v0.1/predictions" \
+  -H 'Content-Type: application/json' \
+  -d '{"jsonData": {"prompt_tokens": [[3, 9]], "max_new_tokens": 4}}')
+echo "$OUT" | python -c 'import json,sys; t=json.load(sys.stdin)["jsonData"]["tokens"][0]; assert t[:2]==[3,9] and len(t)==6, t; print("exported-serve tokens:", t)'
+
 say "SMOKE PASSED"
