@@ -45,6 +45,13 @@ class LLMConfig:
     aux_loss_weight: float = 0.01
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
+    # scale on the residual-writing projections (wo, w2) at init. < 1
+    # makes each block a small perturbation of the residual stream, so
+    # early-exit drafts (speculative decoding's draft_layers) agree with
+    # the full depth — the property trained nets exhibit (LayerSkip-style
+    # depth redundancy) that a plain random init lacks. Bench/synthetic
+    # checkpoints only; converted checkpoints never touch it.
+    residual_scale: float = 1.0
 
     @property
     def head_dim(self) -> int:
@@ -108,6 +115,31 @@ class DecoderLM(ServedModel):
         T = int(seq_len or self.example_input_shape[0])
         return T * self.flops_per_token(T / 2.0)
 
+    def n_params(self) -> int:
+        """Exact parameter count of ``init_params``' pytree (closed form)."""
+        cfg = self.cfg
+        D, F, L, V = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab_size
+        kv = cfg.n_kv_heads * cfg.head_dim
+        h = cfg.n_heads * cfg.head_dim
+        per_layer = 2 * D + D * h + 2 * D * kv + h * D  # norms + q,k,v,o
+        if cfg.n_experts > 0:
+            per_layer += D * cfg.n_experts + cfg.n_experts * 2 * D * F
+        else:
+            per_layer += 3 * D * F
+        return L * per_layer + 2 * V * D + D  # blocks + embed/unembed + ln_f
+
+    def decode_bytes_per_token(self, context_len: float, batch: int = 1,
+                               param_bytes: int = 2) -> float:
+        """HBM bytes touched per DECODED TOKEN at the given batch size:
+        params are read once per fused step (amortised over the batch),
+        plus each lane's KV-cache read for its context. The MBU lens —
+        decode is bandwidth-bound, so tok/s x this / measured HBM BW is
+        the honest utilisation number (MFU is uninformative here)."""
+        cfg = self.cfg
+        kv_bytes_per_tok_layer = 2 * cfg.n_kv_heads * cfg.head_dim * 2  # k+v, bf16
+        cache_read = cfg.n_layers * kv_bytes_per_tok_layer * context_len
+        return self.n_params() * param_bytes / max(1, batch) + cache_read
+
     # ------------------------------------------------------------------
     # params
     # ------------------------------------------------------------------
@@ -128,23 +160,24 @@ class DecoderLM(ServedModel):
             return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(jnp.float32)
 
         s = 1.0 / np.sqrt(D)
+        rs = float(cfg.residual_scale)
         blocks: Dict[str, Any] = {
             "ln1": jnp.ones((L, D), jnp.float32),
             "wq": init(keys[1], (L, D, H * Dh), s),
             "wk": init(keys[2], (L, D, KV * Dh), s),
             "wv": init(keys[3], (L, D, KV * Dh), s),
-            "wo": init(keys[4], (L, H * Dh, D), 1.0 / np.sqrt(H * Dh)),
+            "wo": init(keys[4], (L, H * Dh, D), rs / np.sqrt(H * Dh)),
             "ln2": jnp.ones((L, D), jnp.float32),
         }
         if cfg.n_experts > 0:
             E = cfg.n_experts
             blocks["router"] = init(keys[5], (L, D, E), s)
             blocks["w1e"] = init(keys[6], (L, E, D, F), s)
-            blocks["w2e"] = init(keys[7], (L, E, F, D), 1.0 / np.sqrt(F))
+            blocks["w2e"] = init(keys[7], (L, E, F, D), rs / np.sqrt(F))
         else:
             blocks["w1"] = init(keys[5], (L, D, F), s)
             blocks["w3"] = init(keys[6], (L, D, F), s)
-            blocks["w2"] = init(keys[7], (L, F, D), 1.0 / np.sqrt(F))
+            blocks["w2"] = init(keys[7], (L, F, D), rs / np.sqrt(F))
         return {
             "embed": init(keys[0], (V, D), 1.0),
             "blocks": blocks,

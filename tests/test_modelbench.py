@@ -59,3 +59,48 @@ def test_closed_loop_counts_rows():
     stats = modelbench.closed_loop(make_call, seconds=0.2, concurrency=2)
     assert stats["rows_per_s"] == pytest.approx(3 * stats["req_per_s"], rel=0.01)
     assert stats["requests"] > 0
+
+
+def test_bench_generate_speculation_and_mbu_fields(tmp_path):
+    """The flagship-entry extras: n_params, MBU against a supplied HBM BW,
+    and the speculation block with the device-true acceptance gauge."""
+    stats = modelbench.bench_generate(
+        str(tmp_path),
+        seconds=1.0,
+        concurrency=2,
+        prompt_len=4,
+        max_new_tokens=8,
+        slots=2,
+        config={
+            "vocab_size": 256, "d_model": 64, "n_layers": 4, "n_heads": 2,
+            "n_kv_heads": 2, "d_ff": 128, "max_seq": 64,
+            "residual_scale": 0.1,
+        },
+        speculate_tokens=3,
+        draft_layers=2,
+        hbm_gb_s=100.0,
+    )
+    assert stats["n_params"] > 0
+    # MBU is deliberately NOT published for speculative runs (the
+    # one-param-read-per-token model would overstate it by the speedup)
+    assert "mbu_pct" not in stats
+    spec = stats["speculation"]
+    assert spec["rounds"] > 0
+    assert 1.0 <= spec["tokens_per_round"] <= 4.0  # gamma+1 max
+
+
+def test_n_params_matches_pytree():
+    import jax
+
+    from seldon_core_tpu.models.llm import DecoderLM
+
+    for cfg in (
+        dict(vocab_size=128, d_model=32, n_layers=2, n_heads=2, n_kv_heads=2, d_ff=64),
+        dict(vocab_size=64, d_model=16, n_layers=2, n_heads=2, n_kv_heads=1,
+             d_ff=32, n_experts=2),
+    ):
+        m = DecoderLM(**cfg)
+        counted = sum(
+            np.prod(a.shape) for a in jax.tree_util.tree_leaves(m.init_params(0))
+        )
+        assert m.n_params() == counted, cfg
