@@ -1,0 +1,468 @@
+"""Literal FlatBuffers transport (reference: fbs/prediction.fbs:1-60 and
+the ``seldon-core-microservice <Class> FBS`` CLI choice,
+microservice.py:186).
+
+In the reference tree the FBS transport is vestigial — the schema ships
+but the Python implementation does not. Here it is real: a length-prefixed
+TCP framing carrying ``SeldonRPC { method, SeldonMessage }`` flatbuffers,
+hand-built against the schema with the ``flatbuffers`` runtime (no flatc
+codegen — the schema is 9 small tables, and generated code would be the
+only generated Python in the repo).
+
+Framing: 4-byte little-endian payload length, then the flatbuffer. The
+response is a ``SeldonRPC`` with ``method = RESPONSE``.
+
+This transport exists for wire parity; the TPU-native preferred encoding
+is binary protobuf with ``RawTensor`` (payload.py) — the fbs schema's
+``Tensor.values:[double]`` costs 4x the bytes of bf16 raw and cannot
+carry extended dtypes, which is why the reference's own successor
+abandoned it.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+try:
+    import flatbuffers
+    from flatbuffers.table import Table
+except ImportError:  # pragma: no cover - flatbuffers is in the image
+    flatbuffers = None
+    Table = None
+
+SELDON_PROTOCOL_V1 = 134361921  # fbs/prediction.fbs SeldonProtocolVersion.V1
+METHOD_PREDICT = 0
+METHOD_RESPONSE = 1
+STATUS_SUCCESS = 0
+STATUS_FAILURE = 1
+# union Data { DefaultData = 1, ByteData = 2, StrData = 3 } (union types
+# are 1-indexed in flatbuffers; 0 = NONE)
+DATA_DEFAULT = 1
+DATA_BYTES = 2
+DATA_STR = 3
+PAYLOAD_SELDON_MESSAGE = 1
+
+def _require():
+    if flatbuffers is None:
+        raise RuntimeError("flatbuffers runtime unavailable in this build")
+
+
+# ---------------------------------------------------------------------------
+# encode
+# ---------------------------------------------------------------------------
+
+
+def _build_tensor(b, arr: np.ndarray) -> int:
+    arr = np.ascontiguousarray(arr, dtype=np.float64)
+    # values vector (doubles) — schema Tensor.values:[double]
+    values = b.CreateNumpyVector(arr.ravel())
+    shape_list = list(arr.shape)
+    b.StartVector(4, len(shape_list), 4)
+    for s in reversed(shape_list):
+        b.PrependInt32(s)
+    shape = b.EndVector()
+    b.StartObject(2)
+    b.PrependUOffsetTRelativeSlot(0, shape, 0)
+    b.PrependUOffsetTRelativeSlot(1, values, 0)
+    return b.EndObject()
+
+
+def _build_default_data(b, arr: np.ndarray, names) -> int:
+    name_offs = [b.CreateString(str(n)) for n in (names or [])]
+    names_vec = 0
+    if name_offs:
+        b.StartVector(4, len(name_offs), 4)
+        for off in reversed(name_offs):
+            b.PrependUOffsetTRelative(off)
+        names_vec = b.EndVector()
+    tensor = _build_tensor(b, arr)
+    b.StartObject(2)
+    if names_vec:
+        b.PrependUOffsetTRelativeSlot(0, names_vec, 0)
+    b.PrependUOffsetTRelativeSlot(1, tensor, 0)
+    return b.EndObject()
+
+
+def _build_status(b, code: int, info: str, flag: int) -> int:
+    info_off = b.CreateString(info) if info else 0
+    b.StartObject(4)
+    b.PrependInt32Slot(0, code, 0)
+    if info_off:
+        b.PrependUOffsetTRelativeSlot(1, info_off, 0)
+    b.PrependInt8Slot(3, flag, 0)
+    return b.EndObject()
+
+
+def _build_meta(b, puid: str) -> int:
+    puid_off = b.CreateString(puid) if puid else 0
+    b.StartObject(3)
+    if puid_off:
+        b.PrependUOffsetTRelativeSlot(0, puid_off, 0)
+    return b.EndObject()
+
+
+def encode_message(
+    arr: Optional[np.ndarray] = None,
+    names=None,
+    *,
+    str_data: Optional[str] = None,
+    bin_data: Optional[bytes] = None,
+    puid: str = "",
+    status: Optional[Tuple[int, str, int]] = None,
+    method: int = METHOD_PREDICT,
+) -> bytes:
+    """numpy/str/bytes -> length-prefixed SeldonRPC flatbuffer."""
+    _require()
+    b = flatbuffers.Builder(1024)
+    data_off, data_type = 0, 0
+    if arr is not None:
+        data_off = _build_default_data(b, np.asarray(arr), names)
+        data_type = DATA_DEFAULT
+    elif str_data is not None:
+        s = b.CreateString(str_data)
+        b.StartObject(1)
+        b.PrependUOffsetTRelativeSlot(0, s, 0)
+        data_off = b.EndObject()
+        data_type = DATA_STR
+    elif bin_data is not None:
+        vec = b.CreateByteVector(bin_data)
+        b.StartObject(1)
+        b.PrependUOffsetTRelativeSlot(0, vec, 0)
+        data_off = b.EndObject()
+        data_type = DATA_BYTES
+    status_off = _build_status(b, *status) if status else 0
+    meta_off = _build_meta(b, puid)
+    # SeldonMessage: protocol s0, status s1, meta s2, data_type s3, data s4
+    b.StartObject(5)
+    b.PrependInt32Slot(0, SELDON_PROTOCOL_V1, 0)
+    if status_off:
+        b.PrependUOffsetTRelativeSlot(1, status_off, 0)
+    if meta_off:
+        b.PrependUOffsetTRelativeSlot(2, meta_off, 0)
+    if data_type:
+        b.PrependUint8Slot(3, data_type, 0)
+        b.PrependUOffsetTRelativeSlot(4, data_off, 0)
+    msg = b.EndObject()
+    # SeldonRPC: method s0, message_type s1, message s2
+    b.StartObject(3)
+    b.PrependInt8Slot(0, method, 0)
+    b.PrependUint8Slot(1, PAYLOAD_SELDON_MESSAGE, 0)
+    b.PrependUOffsetTRelativeSlot(2, msg, 0)
+    rpc = b.EndObject()
+    b.Finish(rpc)
+    payload = bytes(b.Output())
+    return struct.pack("<I", len(payload)) + payload
+
+
+# ---------------------------------------------------------------------------
+# decode
+# ---------------------------------------------------------------------------
+
+
+class _T:
+    """Thin reader over a flatbuffers table position."""
+
+    def __init__(self, tab: "Table"):
+        self.tab = tab
+
+    def _off(self, slot: int) -> int:
+        return self.tab.Offset(4 + 2 * slot)
+
+    def i32(self, slot: int, default: int = 0) -> int:
+        o = self._off(slot)
+        if not o:
+            return default
+        return self.tab.Get(flatbuffers.number_types.Int32Flags, o + self.tab.Pos)
+
+    def i8(self, slot: int, default: int = 0) -> int:
+        o = self._off(slot)
+        if not o:
+            return default
+        return self.tab.Get(flatbuffers.number_types.Int8Flags, o + self.tab.Pos)
+
+    def u8(self, slot: int, default: int = 0) -> int:
+        o = self._off(slot)
+        if not o:
+            return default
+        return self.tab.Get(flatbuffers.number_types.Uint8Flags, o + self.tab.Pos)
+
+    def string(self, slot: int) -> Optional[str]:
+        o = self._off(slot)
+        if not o:
+            return None
+        return self.tab.String(o + self.tab.Pos).decode("utf-8")
+
+    def table(self, slot: int) -> Optional["_T"]:
+        o = self._off(slot)
+        if not o:
+            return None
+        pos = self.tab.Indirect(o + self.tab.Pos)
+        return _T(Table(self.tab.Bytes, pos))
+
+    # a union value slot stores an offset to the member table, exactly
+    # like a table field — one reader serves both
+    union_table = table
+
+    def vector_len(self, slot: int) -> int:
+        o = self._off(slot)
+        return self.tab.VectorLen(o) if o else 0
+
+    def vector_np(self, slot: int, dtype) -> np.ndarray:
+        o = self._off(slot)
+        if not o:
+            return np.zeros((0,), dtype)
+        n = self.tab.VectorLen(o)
+        start = self.tab.Vector(o)
+        return np.frombuffer(self.tab.Bytes, dtype=dtype, count=n, offset=start)
+
+    def string_vector(self, slot: int):
+        o = self._off(slot)
+        if not o:
+            return []
+        n = self.tab.VectorLen(o)
+        start = self.tab.Vector(o)
+        out = []
+        for i in range(n):
+            out.append(
+                self.tab.String(start + i * 4).decode("utf-8")
+            )
+        return out
+
+
+def decode_message(blob: bytes) -> Dict[str, Any]:
+    """Length-prefixed (or bare) SeldonRPC flatbuffer -> dict with keys
+    method, data (np.ndarray | None), names, strData, binData, puid,
+    status {code, info, status}."""
+    _require()
+    if len(blob) >= 4:
+        (ln,) = struct.unpack_from("<I", blob)
+        if ln == len(blob) - 4:
+            blob = blob[4:]
+    root_pos = struct.unpack_from("<I", blob)[0]
+    rpc = _T(Table(bytearray(blob), root_pos))
+    out: Dict[str, Any] = {
+        "method": rpc.i8(0),
+        "data": None, "names": [], "strData": None, "binData": None,
+        "puid": "", "status": None,
+    }
+    if rpc.u8(1) != PAYLOAD_SELDON_MESSAGE:
+        return out
+    msg = rpc.union_table(2)
+    if msg is None:
+        return out
+    protocol = msg.i32(0)
+    if protocol and protocol != SELDON_PROTOCOL_V1:
+        raise ValueError(f"unknown fbs protocol version {protocol}")
+    st = msg.table(1)
+    if st is not None:
+        out["status"] = {
+            "code": st.i32(0), "info": st.string(1) or "",
+            "status": "FAILURE" if st.i8(3) == STATUS_FAILURE else "SUCCESS",
+        }
+    meta = msg.table(2)
+    if meta is not None:
+        out["puid"] = meta.string(0) or ""
+    dtype_tag = msg.u8(3)
+    data = msg.union_table(4)
+    if data is None:
+        return out
+    if dtype_tag == DATA_DEFAULT:
+        out["names"] = data.string_vector(0)
+        tensor = data.table(1)
+        if tensor is not None:
+            shape = tensor.vector_np(0, np.int32)
+            values = tensor.vector_np(1, np.float64)
+            arr = np.array(values, dtype=np.float64)
+            if shape.size:
+                arr = arr.reshape([int(s) for s in shape])
+            out["data"] = arr
+    elif dtype_tag == DATA_STR:
+        out["strData"] = data.string(0)
+    elif dtype_tag == DATA_BYTES:
+        out["binData"] = bytes(data.vector_np(0, np.int8).tobytes())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TCP server (the FBS microservice front)
+# ---------------------------------------------------------------------------
+
+
+class FBSServer:
+    """Length-prefixed FlatBuffers predict server: one SeldonRPC in, one
+    SeldonRPC (method=RESPONSE) out, connection kept alive. Runs the user
+    object's predict through the same dispatch the REST front uses."""
+
+    MAX_FRAME = 64 << 20  # same OOM guard as the HTTP fronts
+
+    def __init__(self, user_object, host: str = "0.0.0.0", port: int = 5000,
+                 reuse_port: bool = False):
+        self.user_object = user_object
+        self.host, self.port = host, port
+        self.reuse_port = reuse_port
+        self._srv: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+
+    def start(self) -> "FBSServer":
+        _require()
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if self.reuse_port:
+            # --workers multi-process contract: every worker binds the
+            # same port, the kernel load-balances accepts (microservice.py)
+            self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        self._srv.bind((self.host, self.port))
+        if self.port == 0:
+            self.port = self._srv.getsockname()[1]
+        self._srv.listen(16)
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="fbs-accept").start()
+        return self
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            with self._conns_lock:
+                self._conns.add(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="fbs-conn").start()
+
+    def _recv_exact(self, conn, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(min(65536, n - len(buf)))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _serve_conn(self, conn: socket.socket):
+        from .seldon_methods import predict
+
+        try:
+            while not self._stop.is_set():
+                head = self._recv_exact(conn, 4)
+                if head is None:
+                    return
+                (ln,) = struct.unpack("<I", head)
+                if ln > self.MAX_FRAME:
+                    conn.sendall(encode_message(
+                        status=(413, f"frame {ln} exceeds {self.MAX_FRAME}",
+                                STATUS_FAILURE),
+                        method=METHOD_RESPONSE,
+                    ))
+                    return
+                payload = self._recv_exact(conn, ln)
+                if payload is None:
+                    return
+                try:
+                    req = decode_message(head + payload)
+                    body: Dict[str, Any] = {}
+                    if req["data"] is not None:
+                        body["data"] = {"ndarray": req["data"].tolist(),
+                                        "names": req["names"]}
+                    elif req["strData"] is not None:
+                        body["strData"] = req["strData"]
+                    elif req["binData"] is not None:
+                        import base64
+
+                        body["binData"] = base64.b64encode(
+                            req["binData"]).decode("ascii")
+                    out = predict(self.user_object, body)
+                    data = out.get("data") or {}
+                    arr = None
+                    if "ndarray" in data:
+                        arr = np.asarray(data["ndarray"])
+                    elif "tensor" in data:
+                        t = data["tensor"]
+                        arr = np.asarray(t.get("values", [])).reshape(
+                            t.get("shape", [-1])
+                        )
+                    elif "raw" in data:
+                        from .payload import json_data_to_array
+
+                        arr = json_data_to_array(data)
+                    str_out = out.get("strData")
+                    bin_out = None
+                    if out.get("binData") is not None:
+                        import base64
+
+                        bin_out = base64.b64decode(out["binData"])
+                    elif str_out is None and out.get("jsonData") is not None:
+                        # the fbs schema predates jsonData; carry it as a
+                        # JSON string in StrData (documented deviation)
+                        import json as _json
+
+                        str_out = _json.dumps(out["jsonData"])
+                    resp = encode_message(
+                        arr,
+                        data.get("names"),
+                        str_data=str_out,
+                        bin_data=bin_out,
+                        puid=(out.get("meta") or {}).get("puid", ""),
+                        status=(200, "", STATUS_SUCCESS),
+                        method=METHOD_RESPONSE,
+                    )
+                except Exception as e:  # noqa: BLE001 - wire errors back
+                    resp = encode_message(
+                        status=(500, f"{type(e).__name__}: {e}", STATUS_FAILURE),
+                        method=METHOD_RESPONSE,
+                    )
+                conn.sendall(resp)
+        except OSError:
+            pass
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        """Stop accepting AND unblock live handlers (a bare listener close
+        would leave keep-alive connections parked in recv forever)."""
+        self._stop.set()
+        if self._srv is not None:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            live = list(self._conns)
+        for conn in live:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+
+def fbs_predict(host: str, port: int, arr, names=None, timeout: float = 10.0):
+    """Client helper: one predict round-trip over the FBS transport."""
+    _require()
+    with socket.create_connection((host, port), timeout) as conn:
+        conn.sendall(encode_message(np.asarray(arr), names))
+        head = b""
+        while len(head) < 4:
+            chunk = conn.recv(4 - len(head))
+            if not chunk:
+                raise ConnectionError("fbs server closed mid-response")
+            head += chunk
+        (ln,) = struct.unpack("<I", head)
+        payload = b""
+        while len(payload) < ln:
+            chunk = conn.recv(min(65536, ln - len(payload)))
+            if not chunk:
+                raise ConnectionError("fbs server closed mid-response")
+            payload += chunk
+    return decode_message(head + payload)

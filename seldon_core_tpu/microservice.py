@@ -129,9 +129,11 @@ def main(argv=None) -> None:
     parser = argparse.ArgumentParser("seldon-tpu-microservice")
     parser.add_argument("interface_name", help="module.Class of the user component")
     # FBS: the reference's third (zero-copy flatbuffers) transport
-    # (reference: microservice.py:186). Our zero-copy transport is binary
-    # protobuf ON the REST port (application/x-protobuf bodies), so FBS
-    # maps to REST — same port serves both encodings by content type.
+    # (reference: microservice.py:186, schema fbs/prediction.fbs). Serves
+    # the LITERAL length-prefixed flatbuffers protocol on service-port
+    # (fbs.py); note the TPU-native zero-copy encoding is binary protobuf
+    # on the REST port (application/x-protobuf), which also carries raw
+    # bf16/fp8 tensors the fbs schema cannot.
     parser.add_argument("api_type", nargs="?", default="BOTH",
                         choices=["REST", "GRPC", "BOTH", "FBS"])
     parser.add_argument("--service-port", type=int, default=DEFAULT_PORT)
@@ -215,7 +217,22 @@ def main(argv=None) -> None:
         grpc_server.start()
         logger.info("gRPC listening on %s:%d", args.host, args.grpc_port)
 
-    if args.api_type in ("REST", "BOTH", "FBS"):
+    fbs_server = None
+    if args.api_type == "FBS":
+        from . import fbs
+
+        fbs_server = fbs.FBSServer(
+            user_object, host=args.host, port=args.service_port,
+            reuse_port=args.reuse_port,
+        ).start()
+        logger.info("FBS listening on %s:%d", args.host, args.service_port)
+        try:
+            threading.Event().wait()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            fbs_server.close()
+    elif args.api_type in ("REST", "BOTH"):
         try:
             asyncio.run(
                 _serve_rest(user_object, args.host, args.service_port, state,

@@ -1,0 +1,164 @@
+"""Literal FlatBuffers transport (reference: fbs/prediction.fbs:1-60):
+codec round-trips and the length-prefixed TCP predict server."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("flatbuffers")
+
+from seldon_core_tpu import fbs
+from seldon_core_tpu.user_model import SeldonComponent
+
+
+def test_tensor_round_trip():
+    arr = np.arange(12, dtype=np.float64).reshape(3, 4)
+    blob = fbs.encode_message(arr, names=["a", "b", "c", "d"], puid="p-1")
+    out = fbs.decode_message(blob)
+    assert out["method"] == fbs.METHOD_PREDICT
+    np.testing.assert_array_equal(out["data"], arr)
+    assert out["names"] == ["a", "b", "c", "d"]
+    assert out["puid"] == "p-1"
+
+
+def test_str_and_bin_round_trip():
+    out = fbs.decode_message(fbs.encode_message(str_data="hello"))
+    assert out["strData"] == "hello" and out["data"] is None
+    out = fbs.decode_message(fbs.encode_message(bin_data=b"\x00\x01\xff"))
+    assert out["binData"] == b"\x00\x01\xff"
+
+
+def test_status_round_trip():
+    blob = fbs.encode_message(
+        status=(500, "boom", fbs.STATUS_FAILURE), method=fbs.METHOD_RESPONSE
+    )
+    out = fbs.decode_message(blob)
+    assert out["method"] == fbs.METHOD_RESPONSE
+    assert out["status"] == {"code": 500, "info": "boom", "status": "FAILURE"}
+
+
+def test_unknown_protocol_version_rejected():
+    import struct
+
+    blob = fbs.encode_message(np.zeros((1,)))
+    # flip the protocol constant somewhere in the payload
+    payload = bytearray(blob[4:])
+    idx = bytes(payload).find(struct.pack("<i", fbs.SELDON_PROTOCOL_V1))
+    assert idx >= 0
+    payload[idx:idx + 4] = struct.pack("<i", 99)
+    with pytest.raises(ValueError, match="protocol"):
+        fbs.decode_message(bytes(struct.pack("<I", len(payload))) + bytes(payload))
+
+
+class Tripler(SeldonComponent):
+    def predict(self, X, names, meta=None):
+        return np.asarray(X) * 3
+
+
+def test_fbs_server_predict_round_trip():
+    srv = fbs.FBSServer(Tripler(), host="127.0.0.1", port=0).start()
+    try:
+        out = fbs.fbs_predict("127.0.0.1", srv.port, [[1.0, 2.0]], ["x", "y"])
+        assert out["method"] == fbs.METHOD_RESPONSE
+        assert out["status"]["code"] == 200
+        np.testing.assert_array_equal(out["data"], [[3.0, 6.0]])
+        # keep-alive: second request on a fresh client (new conn) also works
+        out2 = fbs.fbs_predict("127.0.0.1", srv.port, [[5.0]])
+        np.testing.assert_array_equal(out2["data"], [[15.0]])
+    finally:
+        srv.close()
+
+
+def test_fbs_server_wires_errors_back():
+    class Boom(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            raise RuntimeError("nope")
+
+    srv = fbs.FBSServer(Boom(), host="127.0.0.1", port=0).start()
+    try:
+        out = fbs.fbs_predict("127.0.0.1", srv.port, [[1.0]])
+        assert out["status"]["status"] == "FAILURE"
+        assert "nope" in out["status"]["info"]
+    finally:
+        srv.close()
+
+
+def test_oversized_frame_rejected():
+    import socket
+    import struct
+
+    srv = fbs.FBSServer(Tripler(), host="127.0.0.1", port=0).start()
+    try:
+        with socket.create_connection(("127.0.0.1", srv.port), 5) as conn:
+            conn.sendall(struct.pack("<I", fbs.FBSServer.MAX_FRAME + 1))
+            head = conn.recv(4)
+            (ln,) = struct.unpack("<I", head)
+            payload = b""
+            while len(payload) < ln:
+                c = conn.recv(65536)
+                if not c:
+                    break
+                payload += c
+        out = fbs.decode_message(head + payload)
+        assert out["status"]["code"] == 413
+    finally:
+        srv.close()
+
+
+def test_fbs_server_bindata_and_jsondata_responses():
+    class BytesModel(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return b"\x01\x02\x03"
+
+    srv = fbs.FBSServer(BytesModel(), host="127.0.0.1", port=0).start()
+    try:
+        out = fbs.fbs_predict("127.0.0.1", srv.port, [[1.0]])
+        assert out["binData"] == b"\x01\x02\x03"
+    finally:
+        srv.close()
+
+    class DictModel(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            return {"answer": 42}
+
+    srv = fbs.FBSServer(DictModel(), host="127.0.0.1", port=0).start()
+    try:
+        out = fbs.fbs_predict("127.0.0.1", srv.port, [[1.0]])
+        import json
+
+        # schema predates jsonData: carried as a JSON string in StrData
+        assert json.loads(out["strData"]) == {"answer": 42}
+    finally:
+        srv.close()
+
+
+def test_fbs_close_unblocks_idle_connection():
+    import socket as _socket
+
+    srv = fbs.FBSServer(Tripler(), host="127.0.0.1", port=0).start()
+    conn = _socket.create_connection(("127.0.0.1", srv.port), 5)
+    try:
+        import time
+
+        time.sleep(0.1)  # let the accept loop register the connection
+        srv.close()  # must shut the idle keep-alive conn down, not leak it
+        conn.settimeout(5)
+        # EOF or RST both mean "terminated promptly", the anti-goal is a hang
+        try:
+            assert conn.recv(1) == b""
+        except ConnectionResetError:
+            pass
+    finally:
+        conn.close()
+
+
+def test_fbs_reuse_port_two_servers():
+    srv1 = fbs.FBSServer(Tripler(), host="127.0.0.1", port=0,
+                         reuse_port=True).start()
+    srv2 = fbs.FBSServer(Tripler(), host="127.0.0.1", port=srv1.port,
+                         reuse_port=True).start()
+    try:
+        out = fbs.fbs_predict("127.0.0.1", srv1.port, [[2.0]])
+        np.testing.assert_array_equal(out["data"], [[6.0]])
+    finally:
+        srv1.close()
+        srv2.close()
