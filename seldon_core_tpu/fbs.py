@@ -239,12 +239,17 @@ def decode_message(blob: bytes) -> Dict[str, Any]:
     method, data (np.ndarray | None), names, strData, binData, puid,
     status {code, info, status}."""
     _require()
+    # single pass, single buffer: when the 4-byte length prefix is present
+    # the root position is simply shifted by it — flatbuffer offsets are
+    # relative, so no slice/copy of the (possibly 64MB) frame is needed
+    base = 0
     if len(blob) >= 4:
         (ln,) = struct.unpack_from("<I", blob)
         if ln == len(blob) - 4:
-            blob = blob[4:]
-    root_pos = struct.unpack_from("<I", blob)[0]
-    rpc = _T(Table(bytearray(blob), root_pos))
+            base = 4
+    buf = blob if isinstance(blob, (bytes, bytearray)) else bytes(blob)
+    root_pos = base + struct.unpack_from("<I", buf, base)[0]
+    rpc = _T(Table(buf, root_pos))
     out: Dict[str, Any] = {
         "method": rpc.i8(0),
         "data": None, "names": [], "strData": None, "binData": None,
@@ -293,6 +298,17 @@ def decode_message(blob: bytes) -> Dict[str, Any]:
 # ---------------------------------------------------------------------------
 
 
+def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly n bytes or None on EOF (shared by server and client)."""
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(min(65536, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
 class FBSServer:
     """Length-prefixed FlatBuffers predict server: one SeldonRPC in, one
     SeldonRPC (method=RESPONSE) out, connection kept alive. Runs the user
@@ -337,36 +353,48 @@ class FBSServer:
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True, name="fbs-conn").start()
 
-    def _recv_exact(self, conn, n: int) -> Optional[bytes]:
-        buf = b""
-        while len(buf) < n:
-            chunk = conn.recv(min(65536, n - len(buf)))
-            if not chunk:
-                return None
-            buf += chunk
-        return buf
 
     def _serve_conn(self, conn: socket.socket):
         from .seldon_methods import predict
 
         try:
             while not self._stop.is_set():
-                head = self._recv_exact(conn, 4)
+                head = _recv_exact(conn, 4)
                 if head is None:
                     return
                 (ln,) = struct.unpack("<I", head)
                 if ln > self.MAX_FRAME:
+                    # drain (bounded) before responding: closing with the
+                    # frame still inbound RSTs the socket and destroys the
+                    # 413 before the client reads it (http_server._bail twin)
+                    conn.settimeout(1.0)
+                    remaining = ln
+                    try:
+                        while remaining > 0:
+                            chunk = conn.recv(min(65536, remaining))
+                            if not chunk:
+                                break
+                            remaining -= len(chunk)
+                    except socket.timeout:
+                        pass
                     conn.sendall(encode_message(
                         status=(413, f"frame {ln} exceeds {self.MAX_FRAME}",
                                 STATUS_FAILURE),
                         method=METHOD_RESPONSE,
                     ))
                     return
-                payload = self._recv_exact(conn, ln)
+                payload = _recv_exact(conn, ln)
                 if payload is None:
                     return
                 try:
                     req = decode_message(head + payload)
+                    if req["method"] != METHOD_PREDICT:
+                        conn.sendall(encode_message(
+                            status=(400, f"unsupported method {req['method']}"
+                                    " (only PREDICT is served)", STATUS_FAILURE),
+                            method=METHOD_RESPONSE,
+                        ))
+                        continue
                     body: Dict[str, Any] = {}
                     if req["data"] is not None:
                         body["data"] = {"ndarray": req["data"].tolist(),

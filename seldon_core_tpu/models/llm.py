@@ -237,40 +237,69 @@ class DecoderLM(ServedModel):
                 # full cache is still written above — only the read narrows.
                 k = lax.slice_in_dim(k, 0, attn_len, axis=2)
                 v = lax.slice_in_dim(v, 0, attn_len, axis=2)
-        if KVl < Hl:  # GQA: repeat kv groups
-            rep = Hl // KVl
-            k = jnp.repeat(k, rep, axis=1)
-            v = jnp.repeat(v, rep, axis=1)
         if kv_cache is not None:
-            # decode attention: q [B,H,1,Dh] over full cache with position
-            # mask. Scores run on the bf16 cache directly with f32
-            # ACCUMULATION (preferred_element_type) — casting the cache to
-            # f32 first would double the HBM read and materialise a full
-            # f32 copy per step, which dominates decode time at long cache
-            # lengths (the decode step is cache-bandwidth-bound).
-            import jax
-
-            Tc = k.shape[2]
-            s = lax.dot_general(
-                q, k, (((3,), (3,)), ((0, 1), (0, 1))),
-                preferred_element_type=jnp.float32,
-            ) / np.sqrt(cfg.head_dim)
-            mask = jnp.arange(Tc)[None, None, None, :] <= positions[:, None, None, None]
-            s = jnp.where(mask, s, -1e30)
-            w = jax.nn.softmax(s, -1).astype(dt)
-            o = lax.dot_general(
-                w, v, (((3,), (2,)), ((0, 1), (0, 1))),
-                preferred_element_type=jnp.float32,
-            ).astype(dt)
-        elif sp_axis is not None:
-            o = ring_attention(q, k, v, sp_axis, causal=True)
+            # decode attention over the (sliced) cache — see
+            # _cache_attention for why the GQA repeat must not happen here
+            o = self._cache_attention(q, k, v, positions, dt)
         else:
-            o = full_attention(q, k, v, causal=True)
+            if KVl < Hl:  # GQA: repeat kv groups (compute-bound prefill
+                # path only; the decode path reads grouped to keep the
+                # cache traffic at one copy)
+                rep = Hl // KVl
+                k = jnp.repeat(k, rep, axis=1)
+                v = jnp.repeat(v, rep, axis=1)
+            if sp_axis is not None:
+                o = ring_attention(q, k, v, sp_axis, causal=True)
+            else:
+                o = full_attention(q, k, v, causal=True)
         o = o.transpose(0, 2, 1, 3).reshape(B, T, Hl * cfg.head_dim)
         o = o @ p["wo"].astype(dt)  # row-parallel under tp
         if tp_axis is not None:
             o = lax.psum(o, tp_axis)
         return o, new_cache
+
+    @staticmethod
+    def _cache_attention(q, kc, vc, bound, dt):
+        """Attention over the (sliced) KV cache with a key_pos <= bound
+        mask, WITHOUT materialising a head-repeated cache copy.
+
+        ``jnp.repeat`` on the cache (the textbook GQA read) writes a
+        rep-times-larger copy to HBM and reads it back — at 16 lanes /
+        256-key windows that tripled the decode step's cache traffic and
+        ran the read path ~7x below the HBM roof (measured on v5e:
+        7.9 -> 5.7 ms/step at 256-key windows, 18.7 -> 9.2 at 1024, for a 1.26B model).
+        Instead q is viewed as [B, KV, rep, T, Dh] and both dots batch
+        over (B, KV), so the MXU consumes the grouped cache directly.
+
+        ``bound``: [B] (single-position decode — every query row masks to
+        its own prefix) or [B, T] (chunked decode — prefix + in-window
+        causality). Scores accumulate in f32 (preferred_element_type);
+        the bf16 cache is never cast or copied.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        B, Hl, T, Dh = q.shape
+        KVl, Ta = kc.shape[1], kc.shape[2]
+        rep = Hl // KVl
+        key_pos = jnp.arange(Ta, dtype=jnp.int32)
+        if getattr(bound, "ndim", 0) == 2:  # [B, T]
+            mask = key_pos[None, None, None, None, :] <= bound[:, None, None, :, None]
+        else:  # [B]
+            mask = key_pos[None, None, None, None, :] <= bound[:, None, None, None, None]
+        qg = q.reshape(B, KVl, rep, T, Dh)
+        s = lax.dot_general(
+            qg, kc, (((4,), (3,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32,
+        ) / np.sqrt(Dh)  # [B, KV, rep, T, Ta]
+        s = jnp.where(mask, s, -1e30)
+        w = jax.nn.softmax(s, -1).astype(dt)
+        o = lax.dot_general(
+            w, vc, (((4,), (2,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32,
+        ).astype(dt)  # [B, KV, rep, T, Dh]
+        return o.reshape(B, Hl, T, Dh)
 
     def _ffn(self, p, x, *, tp_axis=None, ep_axes=None):
         import jax
@@ -516,25 +545,9 @@ class DecoderLM(ServedModel):
             if attn_len is not None and attn_len < kc.shape[2]:
                 kc = lax.slice_in_dim(kc, 0, attn_len, axis=2)
                 vc = lax.slice_in_dim(vc, 0, attn_len, axis=2)
-            if KVl < Hl:
-                rep = Hl // KVl
-                kc = jnp.repeat(kc, rep, axis=1)
-                vc = jnp.repeat(vc, rep, axis=1)
-            Ta = kc.shape[2]
-            s = lax.dot_general(
-                q, kc, (((3,), (3,)), ((0, 1), (0, 1))),
-                preferred_element_type=jnp.float32,
-            ) / np.sqrt(cfg.head_dim)  # [B,H,W,Ta]
-            mask = (
-                jnp.arange(Ta, dtype=jnp.int32)[None, None, None, :]
-                <= positions[:, None, :, None]
-            )
-            s = jnp.where(mask, s, -1e30)
-            w_attn = jax.nn.softmax(s, -1).astype(dt)
-            o = lax.dot_general(
-                w_attn, vc, (((3,), (2,)), ((0, 1), (0, 1))),
-                preferred_element_type=jnp.float32,
-            ).astype(dt)  # [B,H,W,Dh]
+            # grouped cache read (prefix + in-window causality via the
+            # [B, W] bound) — no head-repeated cache copy
+            o = self._cache_attention(q, kc, vc, positions, dt)
             o = o.transpose(0, 2, 1, 3).reshape(B, W, Hl * cfg.head_dim)
             x = x + o @ p["wo"].astype(dt)
             ffn_out, _ = self._ffn(p, x)
