@@ -52,6 +52,7 @@
 #include <cstdarg>
 #include <cstdio>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <memory>
 #include <random>
@@ -1292,6 +1293,55 @@ struct InflightGuard {
   ~InflightGuard() { n.fetch_sub(1); }
 };
 
+// Binary-protobuf predict core: SeldonMessage bytes in -> SeldonMessage
+// bytes out, with the same walk/meta/metrics semantics as the HTTP front.
+// Used by the gRPC front (grpc_front.inc); handle_predictions keeps its
+// own flow because its error paths speak HTTP.
+static bool predict_proto(Engine& eng, RequestCtx& ctx, const std::string& in_pb,
+                          std::string& out_pb, std::string& err) {
+  auto t0 = std::chrono::steady_clock::now();
+  seldontpu::SeldonMessage pbmsg;
+  if (!pbmsg.ParseFromArray(in_pb.data(), int(in_pb.size()))) {
+    eng.metrics.errors.fetch_add(1, std::memory_order_relaxed);
+    err = "invalid SeldonMessage protobuf";
+    return false;
+  }
+  json::Value msg;
+  std::string reply_enc;
+  if (!proto_to_value(pbmsg, msg, reply_enc, err)) {
+    eng.metrics.errors.fetch_add(1, std::memory_order_relaxed);
+    err = "invalid " + err;
+    return false;
+  }
+  if (auto* meta = msg.find("meta"))
+    if (auto* p = meta->find("puid")) ctx.puid = p->str;
+  if (ctx.puid.empty()) ctx.puid = gen_puid(*ctx.rng);
+  if (auto* meta = msg.find("meta"))
+    if (auto* tags = meta->find("tags"))
+      if (tags->type == json::Value::Obj)
+        for (auto& kv : *tags->obj) ctx.tags.set(kv.first, kv.second);
+  json::Value result = walk(ctx, eng.root, std::move(msg));
+  if (!ctx.error.empty()) {
+    eng.metrics.errors.fetch_add(1, std::memory_order_relaxed);
+    err = ctx.error;
+    return false;
+  }
+  json::Value meta = json::Value::object();
+  meta.set("puid", json::Value::string(ctx.puid));
+  if (!ctx.tags.obj->empty()) meta.set("tags", std::move(ctx.tags));
+  if (!ctx.metrics_arr.arr->empty()) meta.set("metrics", std::move(ctx.metrics_arr));
+  if (!ctx.routing.obj->empty()) meta.set("routing", std::move(ctx.routing));
+  meta.set("requestPath", std::move(ctx.request_path));
+  result.set("meta", std::move(meta));
+  seldontpu::SeldonMessage resp;
+  result_to_proto(result, reply_enc, resp);
+  resp.SerializeToString(&out_pb);
+  auto us = std::chrono::duration_cast<std::chrono::microseconds>(
+      std::chrono::steady_clock::now() - t0).count();
+  eng.metrics.observe_us(uint64_t(us));
+  return true;
+}
+
 static void handle_predictions(Engine& eng, RequestCtx& ctx, const std::string& body,
                                std::string& out, bool binary = false) {
   InflightGuard guard(eng.inflight);
@@ -1745,7 +1795,10 @@ static void event_loop(Engine* eng, int listen_fd, unsigned seed) {
 
 static void engine_stop(Engine* eng);
 
-static Engine* engine_start(const std::string& spec_json, int port, int threads) {
+#include "grpc_front.inc"
+
+static Engine* engine_start(const std::string& spec_json, int port, int threads,
+                            int grpc_port = 0) {
   json::Parser p(spec_json);
   json::Value spec = p.parse();
   if (!p.ok) return nullptr;
@@ -1767,6 +1820,12 @@ static Engine* engine_start(const std::string& spec_json, int port, int threads)
   eng->root = parse_unit(*graph);
   eng->port = port;
   eng->threads = threads;
+  if (grpc_port > 0) {
+    int gfd = make_listener(grpc_port);
+    if (gfd < 0) { delete eng; return nullptr; }
+    eng->listen_fds.push_back(gfd);
+    eng->loops.emplace_back(grpc_loop, eng, gfd, 4242u);
+  }
   for (int t = 0; t < threads; t++) {
     int lfd = make_listener(port);
     if (lfd < 0) {
@@ -1797,6 +1856,11 @@ extern "C" {
 void* sce_start(const char* spec_json, int port, int threads) {
   signal(SIGPIPE, SIG_IGN);
   return engine_start(spec_json, port, threads <= 0 ? 1 : threads);
+}
+
+void* sce_start_grpc(const char* spec_json, int port, int grpc_port, int threads) {
+  signal(SIGPIPE, SIG_IGN);
+  return engine_start(spec_json, port, threads <= 0 ? 1 : threads, grpc_port);
 }
 
 void sce_stop(void* handle) {
@@ -1925,6 +1989,7 @@ int main(int argc, char** argv) {
   signal(SIGPIPE, SIG_IGN);
   std::string spec_json = R"({"name":"bench","graph":{"name":"stub","implementation":"SIMPLE_MODEL"}})";
   int port = 8000;
+  int grpc_port = 0;
   int threads = 1;
   bool bench = false;
   bool bench_binary = false;
@@ -1943,6 +2008,7 @@ int main(int argc, char** argv) {
       fclose(f);
     } else if (a == "--spec") spec_json = next();
     else if (a == "--port") port = atoi(next());
+    else if (a == "--grpc-port") grpc_port = atoi(next());
     else if (a == "--threads") threads = atoi(next());
     else if (a == "--bench") bench = true;
     else if (a == "--bench-binary") { bench = true; bench_binary = true; }
@@ -1950,7 +2016,7 @@ int main(int argc, char** argv) {
     else if (a == "--seconds") seconds = atof(next());
     else { fprintf(stderr, "unknown arg %s\n", a.c_str()); return 1; }
   }
-  Engine* eng = engine_start(spec_json, port, threads);
+  Engine* eng = engine_start(spec_json, port, threads, grpc_port);
   if (!eng) { fprintf(stderr, "bad spec\n"); return 1; }
   fprintf(stderr, "seldon-tpu-engine listening on :%d (%d threads)\n", port, threads);
   if (bench) {

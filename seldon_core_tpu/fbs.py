@@ -480,17 +480,11 @@ def fbs_predict(host: str, port: int, arr, names=None, timeout: float = 10.0):
     _require()
     with socket.create_connection((host, port), timeout) as conn:
         conn.sendall(encode_message(np.asarray(arr), names))
-        head = b""
-        while len(head) < 4:
-            chunk = conn.recv(4 - len(head))
-            if not chunk:
-                raise ConnectionError("fbs server closed mid-response")
-            head += chunk
+        head = _recv_exact(conn, 4)
+        if head is None:
+            raise ConnectionError("fbs server closed mid-response")
         (ln,) = struct.unpack("<I", head)
-        payload = b""
-        while len(payload) < ln:
-            chunk = conn.recv(min(65536, ln - len(payload)))
-            if not chunk:
-                raise ConnectionError("fbs server closed mid-response")
-            payload += chunk
+        payload = _recv_exact(conn, ln)
+        if payload is None:
+            raise ConnectionError("fbs server closed mid-response")
     return decode_message(head + payload)

@@ -22,8 +22,21 @@ BIN_PATH = os.path.join(_NATIVE_DIR, "build", "seldon-tpu-engine")
 
 
 def build(force: bool = False) -> str:
-    """Build the native engine via make; returns the shared-lib path."""
-    if force or not (os.path.exists(LIB_PATH) and os.path.exists(BIN_PATH)):
+    """Build the native engine via make; returns the shared-lib path.
+
+    Rebuilds when any source is newer than the artifacts — a stale
+    pre-change .so would be missing newer ABI symbols (sce_start_grpc)
+    and break ctypes binding."""
+    sources = [
+        os.path.join(_NATIVE_DIR, f)
+        for f in ("engine.cpp", "grpc_front.inc", "hpack_tables.inc", "Makefile")
+    ]
+    stale = force or not (os.path.exists(LIB_PATH) and os.path.exists(BIN_PATH))
+    if not stale:
+        newest_src = max(os.path.getmtime(f) for f in sources if os.path.exists(f))
+        oldest_out = min(os.path.getmtime(LIB_PATH), os.path.getmtime(BIN_PATH))
+        stale = newest_src > oldest_out
+    if stale:
         subprocess.run(["make", "-C", _NATIVE_DIR], check=True, capture_output=True)
     return LIB_PATH
 
@@ -42,6 +55,10 @@ def _load():
         lib = ctypes.CDLL(build(), mode=mode)
         lib.sce_start.restype = ctypes.c_void_p
         lib.sce_start.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        lib.sce_start_grpc.restype = ctypes.c_void_p
+        lib.sce_start_grpc.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
         lib.sce_stop.argtypes = [ctypes.c_void_p]
         lib.sce_version.restype = ctypes.c_char_p
         _lib = lib
@@ -61,16 +78,25 @@ class NativeEngine:
     >>> eng.stop()
     """
 
-    def __init__(self, spec, port: int = 8000, threads: int = 1):
+    def __init__(self, spec, port: int = 8000, threads: int = 1,
+                 grpc_port: int = 0):
         self.spec = spec.to_dict() if hasattr(spec, "to_dict") else spec
         self.port = port
         self.threads = threads
+        # 0 = REST only; >0 additionally serves the hand-rolled h2c gRPC
+        # front (grpc_front.inc) on that port
+        self.grpc_port = grpc_port
         self._handle: Optional[int] = None
 
     def start(self) -> "NativeEngine":
         lib = _load()
         blob = json.dumps(self.spec).encode()
-        self._handle = lib.sce_start(blob, self.port, self.threads)
+        if self.grpc_port:
+            self._handle = lib.sce_start_grpc(
+                blob, self.port, self.grpc_port, self.threads
+            )
+        else:
+            self._handle = lib.sce_start(blob, self.port, self.threads)
         if not self._handle:
             raise RuntimeError(f"native engine failed to start on :{self.port} (bad spec or bind failure)")
         return self
