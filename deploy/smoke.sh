@@ -104,4 +104,15 @@ OUT=$(curl -fsS -X POST "http://127.0.0.1:$PORT2/api/v0.1/predictions" \
   -d '{"jsonData": {"prompt_tokens": [[3, 9]], "max_new_tokens": 4}}')
 echo "$OUT" | python -c 'import json,sys; t=json.load(sys.stdin)["jsonData"]["tokens"][0]; assert t[:2]==[3,9] and len(t)==6, t; print("exported-serve tokens:", t)'
 
+say "kubernetes render (sdctl render)"
+cat > "$WORK/dep.json" <<K8SEOF
+{"name": "smoke-k8s", "predictors": [
+  {"name": "main", "replicas": 1, "traffic": 100,
+   "tpuMesh": {"model": 4},
+   "graph": {"name": "m", "type": "MODEL", "implementation": "JAX_SERVER",
+             "modelUri": "$WORK/model"}}]}
+K8SEOF
+python -m seldon_core_tpu.controlplane render -f "$WORK/dep.json" -o "$WORK/k8s.yaml"
+grep -q "kind: Deployment" "$WORK/k8s.yaml" && grep -q "google.com/tpu" "$WORK/k8s.yaml" && echo "render ok"
+
 say "SMOKE PASSED"
