@@ -1993,6 +1993,7 @@ int main(int argc, char** argv) {
   int threads = 1;
   bool bench = false;
   bool bench_binary = false;
+  bool bench_grpc = false;
   int clients = 16;
   double seconds = 5.0;
   for (int i = 1; i < argc; i++) {
@@ -2012,6 +2013,7 @@ int main(int argc, char** argv) {
     else if (a == "--threads") threads = atoi(next());
     else if (a == "--bench") bench = true;
     else if (a == "--bench-binary") { bench = true; bench_binary = true; }
+    else if (a == "--bench-grpc") { bench = true; bench_grpc = true; }
     else if (a == "--clients") clients = atoi(next());
     else if (a == "--seconds") seconds = atof(next());
     else { fprintf(stderr, "unknown arg %s\n", a.c_str()); return 1; }
@@ -2020,8 +2022,9 @@ int main(int argc, char** argv) {
   if (!eng) { fprintf(stderr, "bad spec\n"); return 1; }
   fprintf(stderr, "seldon-tpu-engine listening on :%d (%d threads)\n", port, threads);
   if (bench) {
-    if (bench_binary) {
-      // protobuf front: raw float32 tensor, no JSON/base64 anywhere
+    // ONE payload for both binary tiers so REST-binary and gRPC numbers
+    // measure the identical request shape
+    auto bench_payload = [] {
       seldontpu::SeldonMessage m;
       auto* pd = m.mutable_data();
       for (const char* n : {"a", "b", "c", "d", "e"}) pd->add_names(n);
@@ -2033,7 +2036,14 @@ int main(int argc, char** argv) {
       raw->set_data(std::string(reinterpret_cast<const char*>(vals), sizeof vals));
       std::string payload;
       m.SerializeToString(&payload);
-      run_bench(port, clients, seconds, payload, "application/x-protobuf");
+      return payload;
+    };
+    if (bench_grpc) {
+      if (grpc_port <= 0) { fprintf(stderr, "--bench-grpc needs --grpc-port\n"); return 1; }
+      run_grpc_bench(grpc_port, clients, seconds, bench_payload());
+    } else if (bench_binary) {
+      // protobuf front: raw float32 tensor, no JSON/base64 anywhere
+      run_bench(port, clients, seconds, bench_payload(), "application/x-protobuf");
     } else {
       // payload mirrors the reference benchmark notebook's request
       std::string payload = R"({"data":{"names":["a","b","c","d","e"],"tensor":{"shape":[1,5],"values":[1.0,2.0,3.0,4.0,5.0]}}})";

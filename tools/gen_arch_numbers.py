@@ -64,7 +64,9 @@ def rows_from(bench):
             # scalars that survive in the tail
             payload = {"model_tier": _extract_obj(line, "model_tier"),
                        "binary_front": _extract_obj(line, "binary_front")
-                       or _extract_obj(line, "ary_front")}
+                       or _extract_obj(line, "ary_front"),
+                       "grpc_front": _extract_obj(line, "grpc_front")
+                       or _extract_obj(line, "rpc_front")}
             m = re.search(r'"unit": "req/s", "vs_baseline": ([0-9.]+)', line)
             if m:
                 payload["vs_baseline"] = float(m.group(1))
@@ -87,6 +89,14 @@ def rows_from(bench):
             "Binary protobuf front",
             f"{fmt(b.get('value'))} req/s",
             f"{b.get('vs_grpc_baseline', '—')}x the reference's gRPC headline",
+        ))
+    g = payload.get("grpc_front") or {}
+    if g:
+        rows.append((
+            "Native gRPC front",
+            f"{fmt(g.get('value'))} req/s",
+            f"{g.get('vs_grpc_baseline', '—')}x the reference's gRPC headline "
+            "(hand-rolled h2c + HPACK)",
         ))
     r = mt.get("resnet50_rest") or {}
     if r:
