@@ -785,12 +785,23 @@ def run_model_tier(
 
             h2d = measure_h2d_mb_s()
             hbm = measure_hbm_gb_s()
-            results["device"]["h2d_mb_s"] = round(h2d, 1)
-            results["device"]["hbm_gb_s"] = round(hbm, 1)
             runs = [
                 bench_resnet50_rest(root, seconds=seconds, peak=peak, h2d_mb_s=h2d)
                 for _ in range(3)
             ]
+            # the shared tunnel's H2D swings minute-to-minute: re-sample
+            # after the wire runs and keep the max, else a pessimistic
+            # pre-sample publishes a roofline the window then "exceeds"
+            h2d = max(h2d, measure_h2d_mb_s())
+            results["device"]["h2d_mb_s"] = round(h2d, 1)
+            results["device"]["hbm_gb_s"] = round(hbm, 1)
+            for r in runs:
+                bound = h2d * 1e6 / (224 * 224 * 3)
+                r["h2d_mb_s"] = round(h2d, 1)
+                r["transport_bound_rows_per_s"] = round(bound, 1)
+                r["pct_of_transport_roofline"] = round(
+                    100.0 * r["rows_per_s"] / bound, 1
+                )
             best = max(runs, key=lambda r: r["rows_per_s"])
             best["best_of"] = len(runs)
             best["median_rows_per_s"] = round(
