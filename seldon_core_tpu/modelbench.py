@@ -895,6 +895,15 @@ def run_model_tier(
                 results["llm_1b_latency"]["p50_ms"] / spec["p50_ms"], 3
             )
             results["llm_1b_spec"] = spec
+            # long-context at flagship scale: 1792-token prompts through
+            # flash prefill, decode reads walking a ~2k-key grouped cache
+            # (the regime where the no-repeat GQA read is worth 2x)
+            results["llm_1b_long"] = bench_generate(
+                root, label="llm-1.26b-long",
+                seconds=max(seconds, 10.0), concurrency=8, prompt_len=1792,
+                max_new_tokens=128, slots=8, steps_per_poll=8,
+                config={**big_cfg, "max_seq": 2048}, peak=peak, hbm_gb_s=hbm,
+            )
             # long-context serving: 1792-token prompts prefill through the
             # Pallas flash kernel, the decode read follows the live prefix
             # buckets, 8 lanes share a 2048-length sharded-layout cache

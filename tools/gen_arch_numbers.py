@@ -163,6 +163,14 @@ def rows_from(bench):
             f"{fmt(gl.get('tokens_per_s'))} tok/s",
             "flash prefill + live-prefix decode reads",
         ))
+    g1l = mt.get("llm_1b_long") or {}
+    if g1l:
+        mbu = f", MBU {g1l['mbu_pct']}%" if g1l.get("mbu_pct") is not None else ""
+        rows.append((
+            f"generate(), 1.26B x {fmt(g1l.get('prompt_len'))}-token prompts",
+            f"{fmt(g1l.get('tokens_per_s'))} tok/s{mbu}",
+            "long context at flagship scale (grouped ~2k-key cache reads)",
+        ))
     return rows
 
 
