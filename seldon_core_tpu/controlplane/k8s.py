@@ -198,7 +198,15 @@ def _workload(dep: SeldonDeployment, p: PredictorSpec) -> List[Dict[str, Any]]:
     name = f"{dep.name}-{p.name}"
     labels = _labels(dep, p)
     pod = _pod_spec(dep, p)
-    template = {"metadata": {"labels": {**labels, **p.labels}}, "spec": pod}
+    # seldon-traffic rides only on the POD template (not the per-predictor
+    # selector, which predates it): it lets the deployment-wide Service
+    # backing the VirtualService host select live pods while excluding
+    # shadow predictors from default routing.
+    traffic = {"seldon-traffic": "shadow" if _is_shadow(p) else "live"}
+    template = {
+        "metadata": {"labels": {**labels, **traffic, **p.labels}},
+        "spec": pod,
+    }
     ann = {**dep.annotations, **p.annotations}
     multihost = False
     if p.tpu_mesh:
@@ -269,6 +277,17 @@ def _workload(dep: SeldonDeployment, p: PredictorSpec) -> List[Dict[str, Any]]:
     ]
 
 
+def _engine_service_ports() -> List[Dict[str, Any]]:
+    """The one place the engine Service ports live — per-predictor and
+    deployment-wide Services must stay in lockstep."""
+    return [
+        {"name": "http", "port": ENGINE_HTTP_PORT,
+         "targetPort": ENGINE_HTTP_PORT, "protocol": "TCP"},
+        {"name": "grpc", "port": ENGINE_GRPC_PORT,
+         "targetPort": ENGINE_GRPC_PORT, "protocol": "TCP"},
+    ]
+
+
 def _service(dep: SeldonDeployment, p: PredictorSpec) -> Dict[str, Any]:
     """Per-predictor Service (reference: createServices
     seldondeployment_controller.go:747-803)."""
@@ -278,12 +297,7 @@ def _service(dep: SeldonDeployment, p: PredictorSpec) -> Dict[str, Any]:
         "metadata": _meta(f"{dep.name}-{p.name}", dep, p),
         "spec": {
             "selector": _labels(dep, p),
-            "ports": [
-                {"name": "http", "port": ENGINE_HTTP_PORT,
-                 "targetPort": ENGINE_HTTP_PORT, "protocol": "TCP"},
-                {"name": "grpc", "port": ENGINE_GRPC_PORT,
-                 "targetPort": ENGINE_GRPC_PORT, "protocol": "TCP"},
-            ],
+            "ports": _engine_service_ports(),
         },
     }
 
@@ -331,48 +345,83 @@ def _hpa(dep: SeldonDeployment, p: PredictorSpec) -> Optional[Dict[str, Any]]:
     }
 
 
+def _is_shadow(p: PredictorSpec) -> bool:
+    return p.annotations.get("seldon.io/shadow", "false") == "true"
+
+
+def _deployment_service(dep: SeldonDeployment) -> Dict[str, Any]:
+    """ClusterIP Service named after the DEPLOYMENT, backing the
+    VirtualService host: without it '<dep>.<ns>.svc.cluster.local' has no
+    DNS entry and in-mesh clients can never reach the canary weights
+    (the reference instead binds its VS to an Istio gateway with
+    hosts:["*"], seldondeployment_controller.go:126-148). Selector spans
+    every LIVE predictor via the pod-template-only seldon-traffic label,
+    so shadows receive mirrored traffic only."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": _meta(dep.name, dep),
+        "spec": {
+            "selector": {
+                "app.kubernetes.io/managed-by": "seldon-core-tpu",
+                "seldon-deployment-id": dep.name,
+                "seldon-traffic": "live",
+            },
+            "ports": _engine_service_ports(),
+        },
+    }
+
+
 def _virtual_service(dep: SeldonDeployment) -> Optional[Dict[str, Any]]:
     """Istio VirtualService carrying the canary weights and shadow mirror
     (reference: createIstioResources seldondeployment_controller.go:113-224;
     shadow == Gateway mirroring in ingress.py)."""
-    def is_shadow(p):
-        return p.annotations.get("seldon.io/shadow", "false") == "true"
+    is_shadow = _is_shadow
 
     live = [p for p in dep.predictors if not is_shadow(p)]
     shadows = [p for p in dep.predictors if is_shadow(p)]
     if len(live) < 2 and not shadows:
         return None
     total = sum(p.traffic for p in live)
-    routes = []
-    for p in live:
-        # no explicit weights -> even split (webhook-default parity)
-        weight = p.traffic if total else 100 // len(live)
-        routes.append({
-            "destination": {
-                "host": f"{dep.name}-{p.name}.{dep.namespace}.svc.cluster.local",
-                "port": {"number": ENGINE_HTTP_PORT},
-            },
-            "weight": weight,
-        })
-    # weights must sum to 100 for Istio; pad the first route
-    pad = 100 - sum(r["weight"] for r in routes)
-    if routes and pad:
-        routes[0]["weight"] += pad
-    http: Dict[str, Any] = {"route": routes}
-    if shadows:
-        s = shadows[0]
-        http["mirror"] = {
-            "host": f"{dep.name}-{s.name}.{dep.namespace}.svc.cluster.local",
-            "port": {"number": ENGINE_HTTP_PORT},
-        }
-        http["mirrorPercentage"] = {"value": 100.0}
+
+    def rule_for_port(port: int) -> Dict[str, Any]:
+        routes = []
+        for p in live:
+            # no explicit weights -> even split (webhook-default parity)
+            weight = p.traffic if total else 100 // len(live)
+            routes.append({
+                "destination": {
+                    "host": (f"{dep.name}-{p.name}.{dep.namespace}"
+                             ".svc.cluster.local"),
+                    "port": {"number": port},
+                },
+                "weight": weight,
+            })
+        # weights must sum to 100 for Istio; pad the first route
+        pad = 100 - sum(r["weight"] for r in routes)
+        if routes and pad:
+            routes[0]["weight"] += pad
+        # port-scoped match: a port-free http rule would apply to EVERY
+        # HTTP/gRPC port of the host, sending grpc:5001 traffic to the
+        # REST port's destination
+        rule: Dict[str, Any] = {"match": [{"port": port}], "route": routes}
+        if shadows:
+            s = shadows[0]
+            rule["mirror"] = {
+                "host": f"{dep.name}-{s.name}.{dep.namespace}.svc.cluster.local",
+                "port": {"number": port},
+            }
+            rule["mirrorPercentage"] = {"value": 100.0}
+        return rule
+
     return {
         "apiVersion": "networking.istio.io/v1beta1",
         "kind": "VirtualService",
         "metadata": _meta(dep.name, dep),
         "spec": {
             "hosts": [f"{dep.name}.{dep.namespace}.svc.cluster.local"],
-            "http": [http],
+            "http": [rule_for_port(ENGINE_HTTP_PORT),
+                     rule_for_port(ENGINE_GRPC_PORT)],
         },
     }
 
@@ -398,6 +447,8 @@ def render(dep: SeldonDeployment) -> List[Dict[str, Any]]:
             manifests.append(hpa)
     vs = _virtual_service(dep)
     if vs:
+        # the deployment-wide Service must exist for the VS host to resolve
+        manifests.append(_deployment_service(dep))
         manifests.append(vs)
     return manifests
 

@@ -234,19 +234,30 @@ class _T:
         return out
 
 
-def decode_message(blob: bytes) -> Dict[str, Any]:
-    """Length-prefixed (or bare) SeldonRPC flatbuffer -> dict with keys
-    method, data (np.ndarray | None), names, strData, binData, puid,
-    status {code, info, status}."""
+def decode_message(blob: bytes, *, prefixed: bool = True) -> Dict[str, Any]:
+    """SeldonRPC flatbuffer -> dict with keys method, data
+    (np.ndarray | None), names, strData, binData, puid,
+    status {code, info, status}.
+
+    Framing is explicit: ``prefixed=True`` (the default — everything
+    in-repo, including ``encode_message``, uses 4-byte length-prefixed
+    frames) reads the root after the prefix; ``prefixed=False`` parses a
+    bare flatbuffer. The prefix is never guessed from the first word — a
+    bare buffer whose root offset happens to equal len-4 must not be
+    silently misparsed from the wrong base."""
     _require()
-    # single pass, single buffer: when the 4-byte length prefix is present
-    # the root position is simply shifted by it — flatbuffer offsets are
-    # relative, so no slice/copy of the (possibly 64MB) frame is needed
     base = 0
-    if len(blob) >= 4:
+    if prefixed:
+        if len(blob) < 4:
+            raise ValueError("length-prefixed flatbuffer shorter than 4 bytes")
         (ln,) = struct.unpack_from("<I", blob)
-        if ln == len(blob) - 4:
-            base = 4
+        if ln != len(blob) - 4:
+            raise ValueError(
+                f"flatbuffer length prefix {ln} != payload size {len(blob) - 4}"
+            )
+        # offsets are relative, so the root is simply shifted by the prefix —
+        # no slice/copy of the (possibly 64MB) frame is needed
+        base = 4
     buf = blob if isinstance(blob, (bytes, bytearray)) else bytes(blob)
     root_pos = base + struct.unpack_from("<I", buf, base)[0]
     rpc = _T(Table(buf, root_pos))

@@ -50,6 +50,49 @@ class PayloadError(ValueError):
     """Malformed wire payload (maps to HTTP 400 / gRPC INVALID_ARGUMENT)."""
 
 
+DEFAULT_MAX_DECODED_BYTES = 512 * 1024 * 1024
+
+
+def max_decoded_bytes(default: int = DEFAULT_MAX_DECODED_BYTES) -> int:
+    """Server-side ceiling on the *decoded* size of compressed tensor
+    encodings (``zlib``, ``jpeg-rows``). The REST/gRPC body caps bound the
+    wire bytes, but the decoded size is declared by the client in
+    ``RawTensor.shape`` — a <=64MB zlib body can legally inflate ~1000:1,
+    so the shape-declared size must be checked against a server-side limit
+    *before* any decompression happens. ``SELDON_MAX_DECODED_BYTES`` env
+    overrides the 512MiB default."""
+    import os
+
+    try:
+        v = int(os.environ["SELDON_MAX_DECODED_BYTES"])
+        if v > 0:
+            return v
+    except (KeyError, ValueError):
+        pass
+    return default
+
+
+def _declared_nbytes(shape, dtype: np.dtype) -> int:
+    """Byte size a client-declared shape claims, in exact Python ints —
+    np.prod wraps at int64, which would let a huge shape slip past the
+    cap below and surface as an uncaught OverflowError downstream."""
+    import math
+
+    dims = [int(s) for s in shape]
+    if any(s < 0 for s in dims):
+        raise PayloadError(f"negative dimension in shape {tuple(shape)}")
+    return math.prod(dims) * dtype.itemsize if dims else dtype.itemsize
+
+
+def _check_decoded_size(expected: int, shape, dtype_str: str) -> None:
+    cap = max_decoded_bytes()
+    if expected > cap:
+        raise PayloadError(
+            f"decoded tensor shape {tuple(shape)} x {dtype_str} is "
+            f"{expected} bytes, over the SELDON_MAX_DECODED_BYTES cap {cap}"
+        )
+
+
 # ---------------------------------------------------------------------------
 # dtype helpers
 # ---------------------------------------------------------------------------
@@ -110,6 +153,7 @@ def _decode_jpeg_rows(data: bytes, shape, dtype: np.dtype) -> np.ndarray:
         raise PayloadError(f"jpeg-rows needs [N, H, W(, C)] shape, got {shape}")
     if shape[0] <= 0:
         raise PayloadError(f"jpeg-rows needs at least one row, got shape {shape}")
+    _check_decoded_size(_declared_nbytes(shape, dtype), shape, dtype.name)
     try:
         import io
 
@@ -174,13 +218,15 @@ def raw_to_array(raw: pb.RawTensor) -> np.ndarray:
     encoding = getattr(raw, "encoding", "") or ""
     if encoding == "jpeg-rows":
         return _decode_jpeg_rows(raw.data, shape, dtype)
-    expected = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
+    expected = _declared_nbytes(shape, dtype)
     if encoding == "zlib":
         import zlib
 
-        # bounded decompress: cap at the shape-declared size so a few KB
-        # of 1000:1 zlib can't expand past the REST body cap into an OOM
-        # (the decompression-bomb twin of http_server's max_body_bytes)
+        # Two-stage bomb defence: the shape-declared size itself is checked
+        # against SELDON_MAX_DECODED_BYTES (shape is attacker-declared, so
+        # capping at expected+1 alone would still allow a multi-GB inflate),
+        # then decompression is bounded at that declared size.
+        _check_decoded_size(expected, shape, raw.dtype)
         d = zlib.decompressobj()
         try:
             data = d.decompress(raw.data, expected + 1)

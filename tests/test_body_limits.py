@@ -186,3 +186,56 @@ def test_native_engine_annotation_cap_parity():
         )
         with urllib.request.urlopen(req, timeout=5) as r:
             assert r.status == 200
+
+
+# -- decoded-size caps (decompression bombs) --------------------------------
+# The body caps above bound WIRE bytes; RawTensor zlib / jpeg-rows declare
+# their decoded size client-side in `shape`, so a small body can legally
+# inflate by orders of magnitude. payload.max_decoded_bytes() is the
+# server-side ceiling checked BEFORE any decompression.
+
+
+def test_zlib_decoded_size_capped(monkeypatch):
+    import zlib
+
+    import numpy as np
+
+    from seldon_core_tpu import payload
+    from seldon_core_tpu.proto import prediction_pb2 as pb
+
+    monkeypatch.setenv("SELDON_MAX_DECODED_BYTES", str(1 << 20))
+    # ~1KB of zlib declaring a 64MB decode: rejected on shape alone
+    raw = pb.RawTensor(
+        dtype="float64", shape=[8 * 1024 * 1024],
+        data=zlib.compress(b"\x00" * (64 << 20), level=9), encoding="zlib",
+    )
+    with pytest.raises(payload.PayloadError, match="SELDON_MAX_DECODED_BYTES"):
+        payload.raw_to_array(raw)
+    # under the cap still works
+    arr = np.arange(16, dtype=np.float64)
+    ok = pb.RawTensor(dtype="float64", shape=[16],
+                      data=zlib.compress(arr.tobytes()), encoding="zlib")
+    np.testing.assert_array_equal(payload.raw_to_array(ok), arr)
+
+
+def test_jpeg_rows_decoded_size_capped(monkeypatch):
+    from seldon_core_tpu import payload
+
+    monkeypatch.setenv("SELDON_MAX_DECODED_BYTES", str(1 << 20))
+    # shape declares 3GB of decoded uint8 rows; must be rejected before
+    # any JPEG blob is even parsed
+    with pytest.raises(payload.PayloadError, match="SELDON_MAX_DECODED_BYTES"):
+        payload._decode_jpeg_rows(
+            b"", [1024, 1024, 1024, 3], __import__("numpy").dtype("uint8"))
+
+
+def test_huge_shape_overflow_is_payload_error():
+    """int64-wrapping shapes (prod(shape) overflows) must surface as the
+    PayloadError 400 contract, not an uncaught OverflowError."""
+    from seldon_core_tpu import payload
+    from seldon_core_tpu.proto import prediction_pb2 as pb
+
+    raw = pb.RawTensor(dtype="float64", shape=[2 ** 21] * 3,
+                       data=b"x", encoding="zlib")
+    with pytest.raises(payload.PayloadError, match="SELDON_MAX_DECODED_BYTES"):
+        payload.raw_to_array(raw)

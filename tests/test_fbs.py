@@ -162,3 +162,20 @@ def test_fbs_reuse_port_two_servers():
     finally:
         srv1.close()
         srv2.close()
+
+
+def test_framing_is_explicit_not_guessed():
+    """decode_message never guesses the length prefix: a prefixed frame with
+    a wrong prefix is rejected, and a bare buffer parses only via
+    prefixed=False (ADVICE r3: a bare buffer whose root offset happens to
+    equal len-4 must not be misparsed from the wrong base)."""
+    import struct
+
+    blob = fbs.encode_message(str_data="x")  # prefixed frame
+    bare = blob[4:]
+    out = fbs.decode_message(bare, prefixed=False)
+    assert out["strData"] == "x"
+    with pytest.raises(ValueError, match="length prefix"):
+        fbs.decode_message(struct.pack("<I", 999) + bare)
+    with pytest.raises(ValueError, match="shorter"):
+        fbs.decode_message(b"\x01")

@@ -116,8 +116,23 @@ def test_render_round_trips_canary_semantics():
     assert weights == {"mnist-main": 90, "mnist-canary": 10}
     assert vs["http"][0]["mirror"]["host"].startswith("mnist-shadow.")
 
-    services = {s["metadata"]["name"] for s in by_kind["Service"]}
-    assert {"mnist-main", "mnist-canary", "mnist-shadow"} <= services
+    services = {s["metadata"]["name"]: s for s in by_kind["Service"]}
+    assert {"mnist-main", "mnist-canary", "mnist-shadow"} <= set(services)
+
+    # the VirtualService host resolves: a deployment-wide Service named
+    # "mnist" exists and its selector picks LIVE pods only (shadow pods
+    # carry seldon-traffic=shadow so mirrored traffic is their only input)
+    assert "mnist" in services
+    dep_svc = services["mnist"]["spec"]
+    assert dep_svc["selector"]["seldon-traffic"] == "live"
+    assert dep_svc["selector"]["seldon-deployment-id"] == "mnist"
+    tmpl_traffic = {
+        name: d["spec"]["template"]["metadata"]["labels"]["seldon-traffic"]
+        for name, d in deps.items()
+    }
+    assert tmpl_traffic == {
+        "mnist-main": "live", "mnist-canary": "live", "mnist-shadow": "shadow",
+    }
 
 
 def test_render_multihost_statefulset():
