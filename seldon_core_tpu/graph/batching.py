@@ -112,6 +112,8 @@ class MicroBatchingClient(UnitClient):
         self._queue: List[Tuple[np.ndarray, Dict, asyncio.Future]] = []
         self._flusher: Optional[asyncio.Task] = None
         self._lock = asyncio.Lock()
+        self._device_path: Optional[bool] = None  # lazily probed, sticky True
+        self._pad_cache: Dict[Tuple, Any] = {}  # (rows, trailing, dtype) -> dev zeros
 
     def _gauge_depth(self) -> None:
         if self.metrics is not None:
@@ -125,20 +127,46 @@ class MicroBatchingClient(UnitClient):
         if self.metrics is not None:
             self.metrics.counter_inc(name, self._labels, value)
 
+    def _use_device_path(self) -> bool:
+        """Probe (once true, sticky) whether the inner unit takes device
+        arrays in-process. Not cached while False: the component may not
+        have compiled yet at the first requests."""
+        if self._device_path:
+            return True
+        probe = getattr(self.inner, "accepts_device_arrays", None)
+        if probe is not None and probe():
+            self._device_path = True
+            return True
+        return False
+
     async def call(self, method: str, message: Dict[str, Any]) -> Dict[str, Any]:
         if method != "predict":
             return await self.inner.call(method, message)
         data = message.get("data")
         if not data:
             return await self.inner.call(method, message)
+        loop = asyncio.get_running_loop()
         try:
-            arr = payload_mod.json_data_to_array(data)
+            # decode OFF the event loop: jpeg-rows/zlib unpacking of a
+            # 32-row request is tens of ms of host CPU — on the loop it
+            # would serialize the whole engine behind one request's body
+            arr = await loop.run_in_executor(
+                None, payload_mod.json_data_to_array, data
+            )
         except payload_mod.PayloadError:
             return await self.inner.call(method, message)
         if arr.ndim < 2:
             arr = arr.reshape(1, -1)
+        if self._use_device_path():
+            # stream this slab into HBM NOW: per-arrival H2D overlaps the
+            # in-flight batches' compute + D2H, which is what keeps the
+            # host->device pipe (the wire tier's roofline) continuously busy
+            try:
+                arr = await loop.run_in_executor(None, self.inner.device_put, arr)
+            except Exception:  # noqa: BLE001 - fall back to the host path
+                logger.debug("device prefetch failed; host fuse", exc_info=True)
 
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        fut: asyncio.Future = loop.create_future()
         async with self._lock:
             self._queue.append((arr, message, fut))
             n_rows = sum(a.shape[0] for a, _, _ in self._queue)
@@ -168,10 +196,49 @@ class MicroBatchingClient(UnitClient):
                 self._gauge_depth()
                 asyncio.ensure_future(self._flush(batch))
 
+    def _dev_pad(self, rows: int, trailing, dtype):
+        key = (rows, tuple(trailing), str(dtype))
+        pad = self._pad_cache.get(key)
+        if pad is None:
+            import jax.numpy as jnp
+
+            pad = jnp.zeros((rows, *trailing), dtype=dtype)
+            self._pad_cache[key] = pad
+        return pad
+
+    def _fuse_device(self, arrays, rows: int):
+        """Concatenate HBM-resident slabs (+ bucket padding) on device.
+        Dispatch is async — this enqueues XLA work and returns; nothing
+        here waits on the device."""
+        import jax.numpy as jnp
+
+        if len(arrays) > 1:
+            fused = jnp.concatenate(arrays, axis=0)
+        else:
+            fused = arrays[0]
+        if self.pad_to_bucket and rows <= self.max_batch:
+            padded_rows = _bucket(rows, self.max_batch)
+            if padded_rows > rows:
+                fused = jnp.concatenate(
+                    [fused, self._dev_pad(padded_rows - rows, fused.shape[1:],
+                                          fused.dtype)],
+                    axis=0,
+                )
+                self._count(
+                    "seldon_engine_microbatch_padded_rows",
+                    float(padded_rows - rows),
+                )
+        return fused
+
     async def _flush(self, batch):
         if not batch:
             return
-        if len(batch) == 1:
+        # device path only when EVERY slab made it to HBM: a mixed batch
+        # (one prefetch failed, or a request raced the compile) must fall
+        # back whole — a device concatenate over mixed host/device slabs
+        # would promote dtypes and retrace the executable
+        device_batch = all(not isinstance(a, np.ndarray) for a, _, _ in batch)
+        if len(batch) == 1 and not device_batch:
             arr, message, fut = batch[0]
             try:
                 result = await self.inner.call("predict", message)
@@ -183,35 +250,58 @@ class MicroBatchingClient(UnitClient):
             return
         try:
             arrays = [a for a, _, _ in batch]
-            trailing = {a.shape[1:] for a in arrays}
-            dtype = np.result_type(*(a.dtype for a in arrays))
+            trailing = {tuple(a.shape[1:]) for a in arrays}
             if len(trailing) != 1:
                 raise ValueError(f"mismatched feature shapes {sorted(map(str, trailing))}")
-            fused = np.concatenate([a.astype(dtype, copy=False) for a in arrays], axis=0)
-            rows = fused.shape[0]
+            rows = sum(a.shape[0] for a in arrays)
             self._count("seldon_engine_microbatch_flushes")
             self._count("seldon_engine_microbatch_rows", float(rows))
-            if self.pad_to_bucket and rows <= self.max_batch:
-                # padding is capped at max_batch; an oversized flush (one
-                # request carrying > max_batch rows) passes through unpadded
-                padded_rows = _bucket(rows, self.max_batch)
-                if padded_rows > rows:
-                    pad = np.zeros((padded_rows - rows, *fused.shape[1:]), dtype=fused.dtype)
-                    fused = np.concatenate([fused, pad], axis=0)
-                    self._count(
-                        "seldon_engine_microbatch_padded_rows", float(padded_rows - rows)
-                    )
             names = (batch[0][1].get("data") or {}).get("names", [])
-            # raw keeps bytes end-to-end on the fused hop for every numeric
-            # dtype, bf16/fp8 included (kind 'V') — ndarray would round-trip
-            # through Python lists (and upcast the extended dtypes)
-            enc = (
-                "raw"
-                if fused.dtype.kind in "fiub"
-                or payload_mod.is_extended_dtype(fused.dtype)
-                else "ndarray"
-            )
-            fused_msg = {"data": payload_mod.array_to_json_data(fused, names, enc)}
+            if device_batch:
+                # slabs are already in HBM (prefetched at arrival, uniform
+                # dtype via the component's _to_dev); fuse + pad on device
+                # and hand the executable the device array directly —
+                # singleton flushes take this path too (the slab is already
+                # resident; re-sending the wire message would decode twice)
+                loop = asyncio.get_running_loop()
+                fused = await loop.run_in_executor(
+                    None, self._fuse_device, arrays, rows
+                )
+                fused_msg = {"data": {"__jax__": fused, "names": list(names)}}
+            else:
+                arrays = [np.asarray(a) for a in arrays]  # mixed: spill to host
+                try:
+                    dtype = np.result_type(*(a.dtype for a in arrays))
+                except TypeError:
+                    # extended dtypes (bf16 slab from a partial prefetch)
+                    # have no numpy promotion rule vs float64
+                    dtype = np.dtype(np.float32)
+                fused = np.concatenate(
+                    [a.astype(dtype, copy=False) for a in arrays], axis=0
+                )
+                if self.pad_to_bucket and rows <= self.max_batch:
+                    # padding is capped at max_batch; an oversized flush (one
+                    # request carrying > max_batch rows) passes through unpadded
+                    padded_rows = _bucket(rows, self.max_batch)
+                    if padded_rows > rows:
+                        pad = np.zeros(
+                            (padded_rows - rows, *fused.shape[1:]), dtype=fused.dtype
+                        )
+                        fused = np.concatenate([fused, pad], axis=0)
+                        self._count(
+                            "seldon_engine_microbatch_padded_rows",
+                            float(padded_rows - rows),
+                        )
+                # raw keeps bytes end-to-end on the fused hop for every numeric
+                # dtype, bf16/fp8 included (kind 'V') — ndarray would round-trip
+                # through Python lists (and upcast the extended dtypes)
+                enc = (
+                    "raw"
+                    if fused.dtype.kind in "fiub"
+                    or payload_mod.is_extended_dtype(fused.dtype)
+                    else "ndarray"
+                )
+                fused_msg = {"data": payload_mod.array_to_json_data(fused, names, enc)}
             meta = batch[0][1].get("meta")
             if meta:
                 fused_msg["meta"] = meta

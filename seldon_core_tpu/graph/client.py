@@ -62,6 +62,24 @@ class InProcessClient(UnitClient):
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(self._executor, fn, self.user_object, message)
 
+    def accepts_device_arrays(self) -> bool:
+        """True when this unit is an in-process JAXComponent with a compiled
+        executable: the micro-batcher can then stream request slabs into HBM
+        at arrival (H2D overlaps earlier batches' compute) and hand the
+        fused hop a device-resident array via the ``__jax__`` message key."""
+        from ..user_model import JAXComponent
+
+        return (
+            isinstance(self.user_object, JAXComponent)
+            and self.user_object._apply is not None
+        )
+
+    def device_put(self, arr):
+        """Host slab -> device, using the component's own input transform
+        (sharding + compute-dtype downcast) so the fused executable sees
+        exactly the dtype/layout it was compiled for."""
+        return self.user_object._to_dev(arr)
+
     async def ready(self) -> bool:
         from ..user_model import client_health_status
 

@@ -107,6 +107,15 @@ class EngineApp:
         self.inflight = 0
         self._inflight_lock = threading.Lock()
         self._ready_task: Optional[asyncio.Task] = None
+        # admission control: seldon.io/max-inflight caps concurrent predict
+        # calls — excess gets a fast 429 (REST, with Retry-After) /
+        # RESOURCE_EXHAUSTED (gRPC) instead of queueing behind the device.
+        # Off (0) by default: unbounded queueing is the reference's behavior.
+        from .executor import _ann_int
+
+        self.max_inflight = _ann_int(
+            getattr(spec, "annotations", None) or {}, "seldon.io/max-inflight"
+        ) or 0
 
     def _inflight_add(self, n: int) -> None:
         with self._inflight_lock:
@@ -120,6 +129,14 @@ class EngineApp:
 
         t0 = time.perf_counter()
         labels = {"deployment": self.spec.name}
+        if self.max_inflight and self.inflight >= self.max_inflight:
+            # bounded admission: reject NOW so client-visible latency tracks
+            # service time, not queue depth; clients back off and retry
+            self.metrics.counter_inc("seldon_api_engine_server_rejected", labels)
+            raise UnitCallError(
+                429, f"over capacity: {self.inflight} in-flight "
+                f"(seldon.io/max-inflight={self.max_inflight})"
+            )
         self._inflight_add(1)
         try:
             with get_tracer().span(
@@ -191,6 +208,34 @@ class EngineApp:
             "engine-rest", max_body_bytes=max_body, read_timeout_s=read_timeout
         )
 
+        if self.max_inflight:
+            labels = {"deployment": self.spec.name}
+
+            def admission_gate(method: str, path: str, headers) -> Optional[Response]:
+                # shed load from the HEADERS: a rejected request's body is
+                # discarded unparsed (see HTTPServer.early_gate). predict()
+                # re-checks, so gate races only cost a parse, not capacity.
+                if (
+                    method == "POST"
+                    and path == "/api/v0.1/predictions"
+                    and self.inflight >= self.max_inflight
+                ):
+                    self.metrics.counter_inc(
+                        "seldon_api_engine_server_rejected", labels
+                    )
+                    return Response(
+                        error_body(
+                            429,
+                            f"over capacity: {self.inflight} in-flight "
+                            f"(seldon.io/max-inflight={self.max_inflight})",
+                        ),
+                        429,
+                        headers={"Retry-After": "1"},
+                    )
+                return None
+
+            app.early_gate = admission_gate
+
         PROTO_TYPES = ("application/x-protobuf", "application/octet-stream")
 
         async def predictions(req: Request) -> Response:
@@ -213,7 +258,8 @@ class EngineApp:
             try:
                 out = await self.predict(body, headers=req.headers)
             except UnitCallError as e:
-                return Response(error_body(e.status, e.info), e.status)
+                hdrs = {"Retry-After": "1"} if e.status == 429 else None
+                return Response(error_body(e.status, e.info), e.status, headers=hdrs)
             if binary:
                 return Response(
                     json_to_proto(out).SerializeToString(),
@@ -369,7 +415,11 @@ class EngineApp:
                 out = await app.predict(proto_to_json(request))
                 return json_to_proto(out)
             except UnitCallError as e:
-                await context.abort(grpc.StatusCode.INTERNAL, e.info)
+                code = (
+                    grpc.StatusCode.RESOURCE_EXHAUSTED
+                    if e.status == 429 else grpc.StatusCode.INTERNAL
+                )
+                await context.abort(code, e.info)
 
         async def feedback_rpc(request: pb.Feedback, context):
             if app.paused:

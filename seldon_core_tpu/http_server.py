@@ -93,9 +93,10 @@ def _json_default(obj):
 
 
 class Response:
-    __slots__ = ("status", "body", "content_type")
+    __slots__ = ("status", "body", "content_type", "headers")
 
-    def __init__(self, body, status: int = 200, content_type: str = "application/json"):
+    def __init__(self, body, status: int = 200, content_type: str = "application/json",
+                 headers: Optional[Dict[str, str]] = None):
         if isinstance(body, (dict, list)):
             body = json.dumps(body, separators=(",", ":"), default=_json_default).encode()
         elif isinstance(body, str):
@@ -103,14 +104,19 @@ class Response:
         self.body = body or b""
         self.status = status
         self.content_type = content_type
+        self.headers = headers
 
     def encode(self, keep_alive: bool) -> bytes:
         reason = _STATUS_TEXT.get(self.status, "Unknown")
         conn = "keep-alive" if keep_alive else "close"
+        extra = ""
+        if self.headers:
+            extra = "".join(f"{k}: {v}\r\n" for k, v in self.headers.items())
         head = (
             f"HTTP/1.1 {self.status} {reason}\r\n"
             f"Content-Type: {self.content_type}\r\n"
             f"Content-Length: {len(self.body)}\r\n"
+            f"{extra}"
             f"Connection: {conn}\r\n\r\n"
         )
         return head.encode() + self.body
@@ -161,6 +167,12 @@ class HTTPServer:
         # slowloris guard: cap the wall-clock wait for a request's bytes
         # once the first header byte could have arrived
         self.read_timeout_s = read_timeout_s
+        # optional admission hook, called with (method, path, headers) BEFORE
+        # the body is read: returning a Response answers immediately and the
+        # body is chunk-discarded unparsed. An overloaded server must shed
+        # load from the headers — receiving + parsing a few-hundred-KB body
+        # per rejected retry turns the 429 path itself into the bottleneck.
+        self.early_gate: Optional[Any] = None
         self._server: Optional[asyncio.AbstractServer] = None
 
     def route(self, path: str):
@@ -270,6 +282,41 @@ class HTTPServer:
                         ),
                     )
                     break
+                if self.early_gate is not None:
+                    parts0 = urlsplit(target)
+                    gate_resp = self.early_gate(
+                        method, unquote(parts0.path), headers
+                    )
+                    if gate_resp is not None:
+                        keep = headers.get("connection", "keep-alive").lower() != "close"
+                        try:
+                            remaining = length
+                            # discard, never buffer — under the same
+                            # slowloris guard as the real body read (a
+                            # trickled body must not hold the fd open)
+                            deadline = (
+                                asyncio.get_running_loop().time()
+                                + (self.read_timeout_s or 30.0)
+                            )
+                            while remaining > 0:
+                                budget = deadline - asyncio.get_running_loop().time()
+                                if budget <= 0:
+                                    keep = False
+                                    break
+                                chunk = await asyncio.wait_for(
+                                    reader.read(min(65536, remaining)), budget
+                                )
+                                if not chunk:
+                                    keep = False
+                                    break
+                                remaining -= len(chunk)
+                            writer.write(gate_resp.encode(keep))
+                            await writer.drain()
+                        except (asyncio.TimeoutError, ConnectionError, OSError):
+                            break
+                        if not keep:
+                            break
+                        continue
                 try:
                     if length and self.read_timeout_s:
                         body = await asyncio.wait_for(

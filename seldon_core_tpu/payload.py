@@ -186,13 +186,27 @@ def _decode_jpeg_rows(data: bytes, shape, dtype: np.dtype) -> np.ndarray:
     if len(blobs) > 4:
         # libjpeg releases the GIL: pooled decode keeps a 32-row batch from
         # serializing ~100ms of host CPU in front of the device step
-        from concurrent.futures import ThreadPoolExecutor
-
-        with ThreadPoolExecutor(max_workers=min(8, len(blobs))) as pool:
-            rows = list(pool.map(decode, blobs))
+        rows = list(decode_pool().map(decode, blobs))
     else:
         rows = [decode(b) for b in blobs]
     return np.stack(rows).astype(np.uint8, copy=False)
+
+
+_DECODE_POOL = None
+
+
+def decode_pool():
+    """Shared host-side decode pool (JPEG rows, request unpacking). One
+    persistent pool for the process: creating a ThreadPoolExecutor per
+    request costs ~ms of thread spawn/teardown on the serving hot path."""
+    global _DECODE_POOL
+    if _DECODE_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _DECODE_POOL = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="seldon-decode"
+        )
+    return _DECODE_POOL
 
 
 def encode_jpeg_rows(arr: np.ndarray, quality: int = 90) -> bytes:
@@ -331,6 +345,16 @@ def array_to_proto_data(
 
 
 def json_data_to_array(data: JsonDict) -> np.ndarray:
+    if "__jax__" in data:
+        # device-resident interior fast path: the micro-batcher hands fused
+        # HBM arrays straight to an in-process JAXComponent — no host copy,
+        # no re-encode. Untrusted wire JSON can only put a list/str here
+        # (it has no codec for array objects), so require a real array —
+        # a client smuggling the key gets the 400 contract, not a 500.
+        v = data["__jax__"]
+        if not (hasattr(v, "shape") and hasattr(v, "dtype") and hasattr(v, "ndim")):
+            raise PayloadError("__jax__ is an interior-only encoding")
+        return v
     if "raw" in data:
         raw = data["raw"]
         if not isinstance(raw, dict):
@@ -459,7 +483,13 @@ def extract_parts_json(body: JsonDict) -> Parts:
     meta = body.get("meta") or {}
     if "data" in body:
         data = body["data"]
-        datadef_type = next((k for k in TENSOR_KEYS if k in data), "ndarray")
+        # __jax__ (device-resident interior hop) responds raw: its results
+        # are re-encoded per original caller by the micro-batch splitter,
+        # and tolist()-ing a fused logits matrix would dwarf the forward
+        datadef_type = (
+            "raw" if "__jax__" in data
+            else next((k for k in TENSOR_KEYS if k in data), "ndarray")
+        )
         return Parts(
             array=json_data_to_array(data),
             names=list(data.get("names", [])),

@@ -260,3 +260,141 @@ def test_micro_batching_int_requests_mirror_requester_encoding():
     for i, out in enumerate(outs):
         assert "ndarray" in out["data"], out["data"].keys()
         np.testing.assert_allclose(out["data"]["ndarray"], [[2 * i, 2 * (i + 1)]])
+
+
+def test_micro_batching_device_path_fuses_in_hbm():
+    """In-process JAXComponent units take the device fast path: request
+    slabs are prefetched into device memory at arrival, fused with an
+    on-device concatenate, and the executable is handed a jax.Array via
+    the __jax__ interior key — per-caller responses still mirror each
+    requester's encoding."""
+    import jax
+
+    from seldon_core_tpu.user_model import JAXComponent
+
+    seen_types = []
+
+    class Doubler(JAXComponent):
+        warmup_shape = (2,)
+
+        def build(self):
+            def apply(params, x):
+                return x * 2.0
+            return apply, {}
+
+        def predict(self, X, names, meta=None):
+            seen_types.append(type(X).__name__)
+            return super().predict(X, names, meta)
+
+    model = Doubler()
+    model.load()
+    spec = default_predictor(
+        PredictorSpec.from_dict({"name": "d", "graph": {"name": "m", "type": "MODEL"}})
+    )
+    app = EngineApp(
+        spec,
+        registry={"m": model},
+        metrics=MetricsRegistry(),
+        batching={"m": {"max_batch": 8, "timeout_ms": 20.0}},
+    )
+
+    async def fire():
+        reqs = [
+            app.predict({"data": {"ndarray": [[float(i), 1.0]]}}) for i in range(6)
+        ]
+        return await asyncio.gather(*reqs)
+
+    from seldon_core_tpu import payload as payload_mod
+
+    outs = asyncio.run(fire())
+    for i, out in enumerate(outs):
+        # bf16 compute dtype forces the raw encoding on the way back (the
+        # documented effective_encoding rule); values survive exactly here
+        got = np.asarray(
+            payload_mod.json_data_to_array(out["data"]), dtype=np.float64
+        )
+        np.testing.assert_allclose(got, [[2.0 * i, 2.0]])
+    # the executable saw device arrays, not numpy (prefetch + device fuse)
+    assert seen_types and all(t != "ndarray" for t in seen_types)
+    assert all(not t.startswith("np") for t in seen_types)
+    assert len(seen_types) < 6  # fused
+
+
+def test_micro_batching_device_path_singleton_no_redecode():
+    """A singleton flush whose slab was already prefetched to device goes
+    through the device hop (not a re-decode of the wire message)."""
+    import jax
+
+    from seldon_core_tpu.user_model import JAXComponent
+
+    class Tripler(JAXComponent):
+        warmup_shape = (3,)
+
+        def build(self):
+            return (lambda p, x: x * 3.0), {}
+
+    model = Tripler()
+    model.load()
+    spec = default_predictor(
+        PredictorSpec.from_dict({"name": "d", "graph": {"name": "m", "type": "MODEL"}})
+    )
+    app = EngineApp(
+        spec,
+        registry={"m": model},
+        metrics=MetricsRegistry(),
+        batching={"m": {"max_batch": 8, "timeout_ms": 1.0}},
+    )
+    from seldon_core_tpu import payload as payload_mod
+
+    out = asyncio.run(app.predict({"data": {"ndarray": [[1.0, 2.0, 3.0]]}}))
+    np.testing.assert_allclose(
+        np.asarray(payload_mod.json_data_to_array(out["data"]), dtype=np.float64),
+        [[3.0, 6.0, 9.0]],
+    )
+
+
+def test_admission_control_429():
+    """seldon.io/max-inflight bounds concurrent predicts: excess requests
+    get a fast UnitCallError(429) (REST adds Retry-After; gRPC maps it to
+    RESOURCE_EXHAUSTED) instead of queueing behind the device."""
+    from seldon_core_tpu.graph.client import UnitCallError
+
+    class Slow(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            import time as _t
+
+            _t.sleep(0.3)
+            return np.asarray(X)
+
+    spec = default_predictor(
+        PredictorSpec.from_dict(
+            {
+                "name": "d",
+                "annotations": {"seldon.io/max-inflight": "2"},
+                "graph": {"name": "m", "type": "MODEL"},
+            }
+        )
+    )
+    app = EngineApp(spec, registry={"m": Slow()}, metrics=MetricsRegistry())
+
+    async def fire():
+        async def one(i):
+            try:
+                return await app.predict({"data": {"ndarray": [[float(i)]]}})
+            except UnitCallError as e:
+                return e
+
+        # stagger so the first two are in flight before the rest arrive
+        a = asyncio.ensure_future(one(0))
+        b = asyncio.ensure_future(one(1))
+        await asyncio.sleep(0.05)
+        rest = await asyncio.gather(*(one(i) for i in range(2, 6)))
+        return [await a, await b] + list(rest)
+
+    outs = asyncio.run(fire())
+    ok = [o for o in outs if isinstance(o, dict)]
+    rejected = [o for o in outs if isinstance(o, UnitCallError)]
+    assert len(ok) == 2
+    assert len(rejected) == 4
+    assert all(e.status == 429 for e in rejected)
+    assert "max-inflight" in rejected[0].info
