@@ -78,6 +78,17 @@ def main(argv=None) -> None:
                        help="enable TPU device placement (needs jax)")
     p_ctl.add_argument("--poll-s", type=float, default=1.0,
                        help="store re-scan period (picks up sdctl writes from other processes)")
+    p_ctl.add_argument("--kube", action="store_true",
+                       help="operator mode: install the SeldonDeployment CRD "
+                       "on a live cluster, watch CRs and converge rendered "
+                       "objects (instead of the self-hosted runtime)")
+    p_ctl.add_argument("--kube-server", default=None,
+                       help="kube-apiserver URL (default: in-cluster; use "
+                       "`kubectl proxy` + http://127.0.0.1:8001 from a laptop)")
+    p_ctl.add_argument("--kube-token", default=None,
+                       help="bearer token (default: in-cluster service account)")
+    p_ctl.add_argument("--resync-s", type=float, default=30.0,
+                       help="kube mode: level-triggered reconcile period")
 
     args = parser.parse_args(argv)
     logging.basicConfig(level="INFO", format="%(asctime)s %(name)s %(levelname)s %(message)s")
@@ -182,6 +193,18 @@ def main(argv=None) -> None:
                 f"  {p.name}\treplicas {avail}\ttraffic {p.traffic}%"
                 + ("\t" + ", ".join(extras) if extras else "")
             )
+        return
+
+    if args.cmd == "controller" and args.kube:
+        from .kube import HttpKubeApi, KubeController
+
+        api = HttpKubeApi(server=args.kube_server, token=args.kube_token)
+        ns = args.namespace if args.namespace != "default" else None
+        ctl = KubeController(api, namespace=ns, resync_s=args.resync_s)
+        try:
+            ctl.run()
+        except KeyboardInterrupt:
+            pass
         return
 
     if args.cmd == "controller":

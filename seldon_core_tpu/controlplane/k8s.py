@@ -94,6 +94,11 @@ def _meta(name: str, dep: SeldonDeployment, p: Optional[PredictorSpec] = None,
         labels.update(p.labels)
     if extra_labels:
         labels.update(extra_labels)
+    # every rendered object is findable by owner: the kube controller prunes
+    # orphans via these two labels (reference does it with ownerReferences +
+    # the GC, seldondeployment_controller.go:1129-1199 owner-indexed watches)
+    labels.setdefault("app.kubernetes.io/managed-by", "seldon-core-tpu")
+    labels.setdefault("seldon-deployment-id", dep.name)
     meta: Dict[str, Any] = {"name": name, "namespace": dep.namespace}
     if labels:
         meta["labels"] = labels

@@ -273,6 +273,10 @@ def parse_hpa_spec(hpa: Dict[str, Any], who: str = "?") -> "tuple[int, int, floa
 
 def validate_predictor(spec: PredictorSpec) -> None:
     """Reference checks: seldondeployment_webhook.go:388-411."""
+    if spec.replicas < 0:
+        raise GraphSpecError(
+            f"predictor {spec.name!r}: negative replicas {spec.replicas}"
+        )
     names = [u.name for u in spec.graph.walk()]
     if len(names) != len(set(names)):
         raise GraphSpecError(f"duplicate unit names in graph: {names}")
