@@ -37,14 +37,16 @@ from ..user_model import SeldonComponent
 logger = logging.getLogger(__name__)
 
 WHITE_BOX_TYPES = ("integrated_gradients", "saliency")
-BLACK_BOX_TYPES = ("ablation",)
-# alibi names the reference wires (seldondeployment_explainers.go:54-56)
-# that we serve with the closest native method
+# anchor_tabular / anchor_text are REAL implementations (components/
+# anchors.py) — the reference's default explainer family
+# (seldondeployment_explainers.go:54-56 wires alibi anchors); they are
+# what gives the non-differentiable servers (sklearn/xgboost/TRT) a
+# working /explain.
+BLACK_BOX_TYPES = ("ablation", "anchor_tabular", "anchor_text")
+# anchor_images stays aliased: pixel-anchors need a segmenter; occlusion
+# attribution is the nearest native method for images
 ALIAS_TYPES = {
-    "anchor_tabular": "ablation",
     "anchor_images": "ablation",
-    # anchor_text is NOT aliased: string features can't ride numeric
-    # occlusion; rejecting at construction beats a 500 on first /explain
 }
 
 
@@ -57,6 +59,11 @@ class Explainer(SeldonComponent):
         predictor_path: str = "/api/v0.1/predictions",
         n_steps: int = 32,
         mesh=None,
+        train_data_uri: str = "",
+        feature_names: Optional[List[str]] = None,
+        precision_threshold: float = 0.95,
+        n_bins: int = 4,
+        anchor_seed: int = 0,
         **_kw,
     ):
         requested = (explainer_type or "integrated_gradients").lower()
@@ -75,6 +82,18 @@ class Explainer(SeldonComponent):
         self._explain_fn = None  # jitted white-box attribution
         self._apply = None
         self._params = None
+        # anchors config
+        self.train_data_uri = train_data_uri or ""
+        self.feature_names = list(feature_names) if feature_names else None
+        self.precision_threshold = float(precision_threshold)
+        self.n_bins = int(n_bins)
+        self.anchor_seed = int(anchor_seed)
+        self._anchor_tabular = None  # built lazily from train data
+        if self.explainer_type == "anchor_tabular" and not self.train_data_uri:
+            raise ValueError(
+                "anchor_tabular needs train_data_uri (background data is the "
+                "perturbation distribution and coverage denominator)"
+            )
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -151,6 +170,83 @@ class Explainer(SeldonComponent):
 
         return explain
 
+    # -- anchors (components/anchors.py behind the predictor endpoint) -------
+
+    def _load_train_data(self) -> np.ndarray:
+        import os
+
+        from ..storage import Storage
+
+        path = Storage.download(self.train_data_uri)
+        if os.path.isdir(path):
+            cands = [
+                f for f in sorted(os.listdir(path))
+                if f.endswith((".npy", ".csv", ".json"))
+            ]
+            if not cands:
+                raise ValueError(f"no .npy/.csv/.json under {self.train_data_uri}")
+            path = os.path.join(path, cands[0])
+        if path.endswith(".npy"):
+            return np.load(path)
+        if path.endswith(".json"):
+            with open(path) as f:
+                return np.asarray(json.load(f), dtype=np.float64)
+        return np.loadtxt(path, delimiter=",", skiprows=0)
+
+    def _anchor_explainer(self):
+        if self._anchor_tabular is None:
+            from .anchors import AnchorTabular
+
+            self._anchor_tabular = AnchorTabular(
+                predict_fn=self._query_predictor,
+                train_data=self._load_train_data(),
+                feature_names=self.feature_names,
+                n_bins=self.n_bins,
+                precision_threshold=self.precision_threshold,
+                seed=self.anchor_seed,
+            )
+        return self._anchor_tabular
+
+    def _explain_anchor_tabular(self, x: np.ndarray) -> Dict:
+        exp = self._anchor_explainer()
+        if self.feature_names is None:
+            self.feature_names = exp.feature_names
+        anchors = [dict(exp.explain(row)) for row in x]
+        return {
+            "explainer": "anchor_tabular",
+            "anchors": anchors,
+            # top-level convenience mirrors single-instance callers
+            **{k: anchors[0][k] for k in
+               ("anchor", "precision", "coverage", "prediction")},
+        }
+
+    def _explain_anchor_text(self, text: str) -> Dict:
+        from .anchors import AnchorText
+
+        def predict_texts(texts):
+            body = json.dumps({"data": {"ndarray": list(texts)}}).encode()
+            req = urllib.request.Request(
+                f"http://{self.predictor_endpoint}{self.predictor_path}",
+                data=body,
+                headers={"content-type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30.0) as r:
+                out = json.loads(r.read())
+            data = out.get("data") or {}
+            arr = data.get("ndarray", data.get("tensor", {}).get("values"))
+            if arr is None:
+                raise ValueError(f"predictor response carries no tensor: {out}")
+            return np.asarray(arr, dtype=np.float32)
+
+        exp = AnchorText(
+            predict_fn=predict_texts,
+            precision_threshold=self.precision_threshold,
+            seed=self.anchor_seed,
+        )
+        out = dict(exp.explain(text))
+        out["explainer"] = "anchor_text"
+        return out
+
     # -- black-box attribution (one batched predict round-trip) --------------
 
     def _query_predictor(self, batch: np.ndarray) -> np.ndarray:
@@ -198,6 +294,25 @@ class Explainer(SeldonComponent):
     # -- SeldonComponent -----------------------------------------------------
 
     def explain(self, X, names: Iterable[str], meta: Optional[Dict] = None) -> Dict:
+        if self.explainer_type == "anchor_text":
+            if isinstance(X, (bytes, bytearray)):
+                X = bytes(X).decode("utf-8", "replace")
+            if not isinstance(X, str):
+                raise ValueError("anchor_text explains strData payloads")
+            return self._explain_anchor_text(X)
+        if self.explainer_type == "anchor_tabular":
+            arr = np.asarray(X, dtype=np.float64)
+            if arr.ndim == 1:
+                arr = arr[None, :]
+            # bind request names only when they actually fit: a wrong-width
+            # names list must fail THIS request, not poison the explainer
+            if (
+                self.feature_names is None
+                and names
+                and len(list(names)) == arr.shape[1]
+            ):
+                self.feature_names = list(names)
+            return self._explain_anchor_tabular(arr)
         x = np.asarray(X, dtype=np.float32)
         if x.ndim == 1:
             x = x[None, :]  # responses stay batched, like predict

@@ -43,8 +43,12 @@ def test_unknown_type_rejected():
 
 
 def test_alias_maps_to_ablation():
-    e = Explainer(explainer_type="anchor_tabular", predictor_endpoint="x:1")
+    # anchor_images still aliases to occlusion; anchor_tabular is real now
+    # (components/anchors.py) and requires background data up front
+    e = Explainer(explainer_type="anchor_images", predictor_endpoint="x:1")
     assert e.explainer_type == "ablation"
+    with pytest.raises(ValueError, match="train_data_uri"):
+        Explainer(explainer_type="anchor_tabular", predictor_endpoint="x:1")
 
 
 def test_integrated_gradients_completeness(tmp_path):
