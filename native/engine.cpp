@@ -13,7 +13,8 @@
 //   * in-process builtin units (SIMPLE_MODEL / AVERAGE_COMBINER /
 //     SIMPLE_ROUTER / RANDOM_ABTEST, parity with reference
 //     predictors/SimpleModelUnit.java:33-57 etc.)
-//   * REMOTE units forwarded over keep-alive HTTP (one upstream
+//   * REMOTE units forwarded over keep-alive HTTP, or h2c gRPC when the
+//     endpoint declares transport GRPC (grpc_remote_call) (one upstream
 //     connection per loop thread) — e.g. Python/TPU microservices
 //   * meta merge: puid, requestPath, routing, tags
 //     (reference: PredictiveUnitBean.java:354-372)
@@ -292,9 +293,10 @@ struct Unit {
   std::string name;
   std::string type;  // MODEL / ROUTER / COMBINER / TRANSFORMER / OUTPUT_TRANSFORMER
   std::string impl;  // SIMPLE_MODEL / ... / empty
-  std::string host;  // remote host (REST transport)
+  std::string host;  // remote host
   int port = 0;
   bool remote = false;
+  bool grpc_transport = false;  // endpoint.transport == GRPC: h2c upstream
   double ratio_a = 0.5;  // RANDOM_ABTEST
   std::vector<Unit> children;
 };
@@ -317,8 +319,9 @@ static Unit parse_unit(const json::Value& v) {
     const json::Value* tr = ep->find("transport");
     const json::Value* host = ep->find("service_host");
     const json::Value* port = ep->find("service_port");
-    if (tr && (tr->str == "REST" || tr->str == "HTTP")) {
+    if (tr && (tr->str == "REST" || tr->str == "HTTP" || tr->str == "GRPC")) {
       u.remote = true;
+      u.grpc_transport = tr->str == "GRPC";
       u.host = host ? host->str : "127.0.0.1";
       u.port = port ? int(port->num) : 9000;
     }
@@ -644,8 +647,15 @@ static void result_to_proto(const json::Value& result, const std::string& reply_
                             seldontpu::SeldonMessage& m);
 static bool proto_to_value(const seldontpu::SeldonMessage& m, json::Value& out,
                            std::string& reply_enc, std::string& err);
+// gRPC upstream client (defined in grpc_front.inc, same TU): h2c unary call
+// to a REMOTE unit whose endpoint.transport is GRPC — the stub-per-type
+// dispatch the reference engine does via Netty channels
+// (InternalPredictionService.java:186-350)
+static json::Value grpc_remote_call(RequestCtx& ctx, const Unit& u,
+                                    const char* path, const json::Value& msg);
 
 static json::Value remote_call(RequestCtx& ctx, const Unit& u, const char* path, const json::Value& msg) {
+  if (u.grpc_transport) return grpc_remote_call(ctx, u, path, msg);
   std::string key = u.host + ":" + std::to_string(u.port);
   UpstreamConn& conn = (*ctx.upstreams)[key];
   // binary inbound -> binary upstream (except /aggregate: the list shape

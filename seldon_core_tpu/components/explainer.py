@@ -18,9 +18,13 @@ wrapping alibi:
   network round-trip regardless of feature count.
 
 Explainer type names accepted: ``integrated_gradients``, ``saliency``
-(white-box) and ``ablation`` (black-box). ``anchor_tabular`` — the
-reference's alibi default — maps to ``ablation`` (nearest available
-attribution method) with a tag recording the substitution.
+(white-box); ``ablation``, ``anchor_tabular``, ``anchor_text``
+(black-box). The anchors family — the reference's alibi default — is a
+real implementation (components/anchors.py): ``anchor_tabular`` requires
+``train_data_uri`` (background data is the perturbation distribution and
+coverage denominator) and returns rules with precision/coverage;
+``anchor_images`` still aliases to ``ablation`` (pixel anchors need a
+segmenter).
 """
 
 from __future__ import annotations

@@ -93,12 +93,30 @@ def test_feedback(engine):
 
 def test_unimplemented_method(engine):
     _, _, gport = engine
-    chan, stub = stub_for(gport, "/seldontpu.Seldon/GenerateStream")
+    chan, stub = stub_for(gport, "/seldontpu.Router/Route")
     try:
         with pytest.raises(grpc.RpcError) as e:
             stub(raw_req(np.asarray([[1.0]], np.float64)), timeout=10)
         assert e.value.code() == grpc.StatusCode.UNIMPLEMENTED
-        assert "Python engine" in e.value.details()
+    finally:
+        chan.close()
+
+
+def test_generate_stream_without_remote_root_unimplemented(engine):
+    """GenerateStream on a builtin (non-remote) graph: clean UNIMPLEMENTED
+    explaining the bridge requirement, not a hang or a connection error."""
+    _, _, gport = engine
+    chan = grpc.insecure_channel(f"127.0.0.1:{gport}")
+    rpc = chan.unary_stream(
+        "/seldontpu.Seldon/GenerateStream",
+        request_serializer=pb.SeldonMessage.SerializeToString,
+        response_deserializer=pb.SeldonMessage.FromString,
+    )
+    try:
+        with pytest.raises(grpc.RpcError) as e:
+            list(rpc(raw_req(np.asarray([[1.0]], np.float64)), timeout=10))
+        assert e.value.code() == grpc.StatusCode.UNIMPLEMENTED
+        assert "REMOTE" in e.value.details()
     finally:
         chan.close()
 
@@ -183,3 +201,246 @@ def test_concurrent_channels(engine):
     for t in threads:
         t.join(timeout=30)
     assert not errs, errs
+
+
+# -- gRPC upstream client (REMOTE units with transport GRPC) ----------------
+# Reference counterpart: stub-per-type dispatch over cached Netty channels,
+# InternalPredictionService.java:186-350.
+
+
+class TenX:
+    def predict(self, X, names, meta=None):
+        return np.asarray(X) * 10.0
+
+
+@pytest.fixture
+def grpc_only_leaf():
+    """A Python microservice serving ONLY gRPC — if the native engine fell
+    back to HTTP the call would fail outright."""
+    from seldon_core_tpu.wrapper import get_grpc_server
+
+    port = free_port()
+    server = get_grpc_server(TenX())
+    server.add_insecure_port(f"127.0.0.1:{port}")
+    server.start()
+    yield port
+    server.stop(grace=0)
+
+
+def test_native_engine_grpc_upstream(grpc_only_leaf):
+    """The native engine serves a graph whose leaf speaks ONLY gRPC
+    (endpoint.transport == GRPC): REST in, h2c gRPC hop upstream, REST out."""
+    import json
+    import urllib.request
+
+    build()
+    port = free_port()
+    spec = {
+        "name": "grpcup",
+        "graph": {
+            "name": "leaf",
+            "type": "MODEL",
+            "endpoint": {
+                "service_host": "127.0.0.1",
+                "service_port": grpc_only_leaf,
+                "transport": "GRPC",
+            },
+        },
+    }
+    with NativeEngine(spec, port=port):
+        wait_port(port)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v0.1/predictions",
+            data=json.dumps({"data": {"ndarray": [[1.5, -2.0]]}}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            out = json.loads(r.read())
+        got = out["data"].get("ndarray") or out["data"]["tensor"]["values"]
+        flat = np.asarray(got, dtype=np.float64).reshape(-1)
+        np.testing.assert_allclose(flat, [15.0, -20.0])
+        # repeat on the same engine: the upstream h2c connection is
+        # keep-alive (stream ids advance, HPACK state persists)
+        for i in range(4):
+            with urllib.request.urlopen(req, timeout=10) as r:
+                json.loads(r.read())
+
+
+def test_native_engine_grpc_upstream_error_surfaces(grpc_only_leaf):
+    """Upstream grpc-status != 0 must surface as an engine error, not a
+    mangled 200."""
+    import json
+    import urllib.request
+
+    build()
+    port = free_port()
+    spec = {
+        "name": "grpcup2",
+        "graph": {
+            "name": "leaf",
+            "type": "MODEL",
+            "endpoint": {
+                "service_host": "127.0.0.1",
+                "service_port": free_port(),  # nothing listens here
+                "transport": "GRPC",
+            },
+        },
+    }
+    with NativeEngine(spec, port=port):
+        wait_port(port)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v0.1/predictions",
+            data=json.dumps({"data": {"ndarray": [[1.0]]}}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code >= 500
+
+
+# -- GenerateStream bridge ---------------------------------------------------
+
+
+@pytest.fixture
+def sse_upstream():
+    """Chunked SSE server standing in for a Python engine's /generate route
+    (graph/service.py generate_stream): three token events, then done."""
+    import socket
+    import threading
+
+    port = free_port()
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", port))
+    srv.listen(4)
+    stop = threading.Event()
+
+    def chunk(data: bytes) -> bytes:
+        return f"{len(data):x}\r\n".encode() + data + b"\r\n"
+
+    def serve():
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            try:
+                buf = b""
+                while b"\r\n\r\n" not in buf:
+                    b_ = conn.recv(65536)
+                    if not b_:
+                        raise ConnectionError
+                    buf += b_
+                head, _, rest = buf.partition(b"\r\n\r\n")
+                clen = 0
+                for line in head.split(b"\r\n"):
+                    if line.lower().startswith(b"content-length:"):
+                        clen = int(line.split(b":")[1])
+                while len(rest) < clen:
+                    rest += conn.recv(65536)
+                conn.sendall(
+                    b"HTTP/1.1 200 OK\r\nContent-Type: text/event-stream\r\n"
+                    b"Transfer-Encoding: chunked\r\nConnection: close\r\n\r\n"
+                )
+                import time as _t
+
+                for i in range(3):
+                    ev = f'data: {{"tokens": [{i}]}}\n\n'.encode()
+                    conn.sendall(chunk(ev))
+                    _t.sleep(0.03)  # genuinely incremental
+                conn.sendall(chunk(b'data: {"done": true}\n\n'))
+                conn.sendall(b"0\r\n\r\n")
+            except (ConnectionError, OSError):
+                pass
+            finally:
+                conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    yield port
+    stop.set()
+    srv.close()
+
+
+def test_generate_stream_bridges_sse_to_grpc(sse_upstream):
+    """VERDICT r3 #5 acceptance: the native front streams tokens to a real
+    grpcio client — each upstream SSE event arrives as one SeldonMessage
+    (jsonData), then a clean OK termination."""
+    import json
+
+    build()
+    port, gport = free_port(), free_port()
+    spec = {
+        "name": "gen",
+        "graph": {
+            "name": "llm",
+            "type": "MODEL",
+            "endpoint": {
+                "service_host": "127.0.0.1",
+                "service_port": sse_upstream,
+                "transport": "REST",
+            },
+        },
+    }
+    with NativeEngine(spec, port=port, grpc_port=gport):
+        wait_port(gport)
+        chan = grpc.insecure_channel(f"127.0.0.1:{gport}")
+        rpc = chan.unary_stream(
+            "/seldontpu.Seldon/GenerateStream",
+            request_serializer=pb.SeldonMessage.SerializeToString,
+            response_deserializer=pb.SeldonMessage.FromString,
+        )
+        try:
+            req = pb.SeldonMessage(json_data=json.dumps({"prompt": "hi", "max_new_tokens": 3}))
+            msgs = list(rpc(req, timeout=15))
+        finally:
+            chan.close()
+    chunks = [json.loads(m.json_data) for m in msgs]
+    assert chunks[:3] == [{"tokens": [0]}, {"tokens": [1]}, {"tokens": [2]}]
+    assert chunks[-1] == {"done": True}
+
+
+def test_generate_stream_concurrent_with_unary(sse_upstream):
+    """A long-lived stream must not block unary predicts multiplexed on the
+    same engine (the bridge rides the epoll loop, no thread per stream)."""
+    import json
+    import threading
+
+    build()
+    port, gport = free_port(), free_port()
+    spec = {
+        "name": "gen2",
+        "graph": {
+            "name": "llm",
+            "type": "MODEL",
+            "endpoint": {
+                "service_host": "127.0.0.1",
+                "service_port": sse_upstream,
+                "transport": "REST",
+            },
+        },
+    }
+    with NativeEngine(spec, port=port, grpc_port=gport):
+        wait_port(gport)
+        chan = grpc.insecure_channel(f"127.0.0.1:{gport}")
+        rpc = chan.unary_stream(
+            "/seldontpu.Seldon/GenerateStream",
+            request_serializer=pb.SeldonMessage.SerializeToString,
+            response_deserializer=pb.SeldonMessage.FromString,
+        )
+        got = {}
+
+        def consume():
+            req = pb.SeldonMessage(json_data=json.dumps({"prompt": "x"}))
+            got["msgs"] = list(rpc(req, timeout=15))
+
+        t = threading.Thread(target=consume)
+        t.start()
+        # while the stream is live, a ping on the HTTP front must answer
+        import urllib.request
+
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/ping", timeout=5) as r:
+            assert r.status == 200
+        t.join(timeout=15)
+        chan.close()
+    assert len(got["msgs"]) == 4
