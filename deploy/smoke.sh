@@ -115,4 +115,24 @@ K8SEOF
 python -m seldon_core_tpu.controlplane render -f "$WORK/dep.json" -o "$WORK/k8s.yaml"
 grep -q "kind: Deployment" "$WORK/k8s.yaml" && grep -q "google.com/tpu" "$WORK/k8s.yaml" && echo "render ok"
 
+say "async ingest tier (file queue -> engine -> results sink)"
+mkdir -p "$WORK/queue"
+python - <<INGEOF
+import json
+with open("$WORK/recs.jsonl", "w") as f:
+    for i in range(6):
+        f.write(json.dumps({"id": f"s{i}",
+                            "request": {"jsonData": {"prompt_tokens": [[2, 4]],
+                                                     "max_new_tokens": 2}}}) + "\n")
+INGEOF
+python -m seldon_core_tpu.ingest enqueue --queue-dir "$WORK/queue" --file "$WORK/recs.jsonl"
+python -m seldon_core_tpu.ingest consume --queue-dir "$WORK/queue" \
+  --engine "127.0.0.1:$PORT" --out "$WORK/ingest-results.jsonl" --drain
+python - <<INGEOF
+from seldon_core_tpu.ingest import read_results
+res = read_results("$WORK/ingest-results.jsonl")
+assert len(res) == 6, res
+print("ingest ok:", sorted(res))
+INGEOF
+
 say "SMOKE PASSED"

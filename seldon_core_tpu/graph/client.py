@@ -158,6 +158,19 @@ class RestClient(UnitClient):
             if not pooled:
                 writer.close()
 
+    async def engine_predict(self, message: Dict[str, Any]) -> Dict[str, Any]:
+        """POST to an ENGINE's external predictions route (the ingest tier
+        and batch scorers talk to engines, not bare units). Deadline-bound
+        like call(): a wedged engine must surface as an error the caller's
+        retry/dead-letter path can act on, not an eternal hang."""
+        return await asyncio.wait_for(
+            self._request(
+                "/api/v0.1/predictions",
+                json.dumps(message, separators=(",", ":")).encode(),
+            ),
+            self.timeout,
+        )
+
     async def call(self, method: str, message: Dict[str, Any]) -> Dict[str, Any]:
         from ..payload import has_raw_bytes, json_to_proto, jsonable
 

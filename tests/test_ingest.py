@@ -1,0 +1,204 @@
+"""Async ingestion tier (ingest.py): durable file queue, at-least-once
+consumer with bounded concurrency and a dead-letter path — the capability
+counterpart of the reference's Kafka request plane (kafka/kafka.json:1-25,
+helm-charts/seldon-core-kafka)."""
+
+import asyncio
+import json
+
+import pytest
+
+from _net import free_port, serve_on_thread
+
+from seldon_core_tpu.graph.service import EngineApp
+from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
+from seldon_core_tpu.ingest import FileQueue, IngestConsumer, read_results
+
+
+def records(n):
+    return [{"id": f"r{i}", "data": [[float(i), 1.0]]} for i in range(n)]
+
+
+def test_file_queue_roundtrip_and_rotation(tmp_path, monkeypatch):
+    import seldon_core_tpu.ingest as ingest
+
+    monkeypatch.setattr(ingest, "SEGMENT_MAX_RECORDS", 5)
+    q = FileQueue(str(tmp_path / "q"))
+    offs = [q.append({"id": f"r{i}"}) for i in range(12)]
+    assert offs == list(range(12))
+    assert q.end_offset() == 12
+    # rotation happened: several segment files
+    assert len(q._segments()) >= 2
+    got = q.poll(0, 100)
+    assert [o for o, _ in got] == list(range(12))
+    # offset-addressed poll crosses segment boundaries
+    got = q.poll(4, 3)
+    assert [o for o, _ in got] == [4, 5, 6]
+    # commits are per-group and durable
+    q.commit("g1", 7)
+    assert q.committed("g1") == 7
+    assert q.committed("g2") == 0
+    q2 = FileQueue(str(tmp_path / "q"))  # reopen (restart)
+    assert q2.committed("g1") == 7
+    assert q2.end_offset() == 12
+
+
+def test_torn_tail_record_is_ignored(tmp_path):
+    q = FileQueue(str(tmp_path / "q"))
+    q.append({"id": "ok"})
+    # simulate a producer crash mid-append
+    with open(q._segment_path(0), "a") as f:
+        f.write('{"id": "to')
+    got = q.poll(0, 10)
+    assert [r["id"] for _, r in got] == ["ok"]
+
+
+@pytest.fixture
+def engine_port():
+    spec = default_predictor(
+        PredictorSpec.from_dict(
+            {"name": "ing", "graph": {"name": "m", "implementation": "SIMPLE_MODEL"}}
+        )
+    )
+    app = EngineApp(spec)
+    port = free_port()
+    stop = serve_on_thread(app.rest_app().serve_forever("127.0.0.1", port), port)
+    yield port
+    stop()
+
+
+def test_drain_scores_everything_exactly_once_observable(tmp_path, engine_port):
+    q = FileQueue(str(tmp_path / "q"))
+    for r in records(25):
+        q.append(r)
+    out = str(tmp_path / "results.jsonl")
+    consumer = IngestConsumer(q, "127.0.0.1", engine_port, out_path=out,
+                              concurrency=4)
+    stats = asyncio.run(consumer.run(drain=True))
+    assert stats["scored"] == 25
+    assert stats["dead_lettered"] == 0
+    results = read_results(out)
+    assert set(results) == {f"r{i}" for i in range(25)}
+    assert results["r3"]["response"]["data"]["ndarray"] == [[0.9, 0.05, 0.05]]
+    assert q.committed("default") == 25
+
+
+def test_kill_and_restart_mid_stream(tmp_path, engine_port):
+    """VERDICT r3 #6 acceptance: enqueue N, kill the consumer mid-stream,
+    restart — all N scored, exactly-once-observable in the sink."""
+    N = 40
+    q = FileQueue(str(tmp_path / "q"))
+    for r in records(N):
+        q.append(r)
+    out = str(tmp_path / "results.jsonl")
+
+    async def first_life():
+        consumer = IngestConsumer(q, "127.0.0.1", engine_port, out_path=out,
+                                  concurrency=2, poll_batch=4)
+        task = asyncio.ensure_future(consumer.run())
+        # let it process part of the queue, then kill it ungracefully
+        while consumer.stats["scored"] < 10:
+            await asyncio.sleep(0.01)
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+        return consumer.stats["scored"]
+
+    scored_before = asyncio.run(first_life())
+    assert 0 < scored_before < N
+    committed = q.committed("default")
+    assert committed <= scored_before + 2  # only contiguous handled offsets
+
+    # restart: a NEW consumer in the same group picks up from the commit
+    consumer2 = IngestConsumer(q, "127.0.0.1", engine_port, out_path=out,
+                               concurrency=4)
+    stats2 = asyncio.run(consumer2.run(drain=True))
+    results = read_results(out)
+    assert set(results) == {f"r{i}" for i in range(N)}  # nothing lost
+    assert q.committed("default") == N
+    # at-least-once: replays allowed, but the keyed sink dedups them
+    assert stats2["scored"] >= N - committed
+
+
+def test_poison_record_dead_letters_and_does_not_wedge(tmp_path, engine_port):
+    q = FileQueue(str(tmp_path / "q"))
+    q.append({"id": "good-1", "data": [[1.0, 2.0]]})
+    q.append({"id": "bad", "request": {"data": {"raw":
+        {"dtype": "no-such-dtype", "shape": [1], "data": ""}}}})
+    q.append({"id": "good-2", "data": [[3.0, 4.0]]})
+    out = str(tmp_path / "results.jsonl")
+    dl = str(tmp_path / "dead.jsonl")
+    consumer = IngestConsumer(q, "127.0.0.1", engine_port, out_path=out,
+                              dead_letter_path=dl, retries=2,
+                              retry_backoff_s=0.01)
+    stats = asyncio.run(consumer.run(drain=True))
+    assert stats["scored"] == 2
+    assert stats["dead_lettered"] == 1
+    assert set(read_results(out)) == {"good-1", "good-2"}
+    with open(dl) as f:
+        rows = [json.loads(x) for x in f]
+    assert len(rows) == 1 and rows[0]["record"]["id"] == "bad"
+    assert rows[0]["error"]
+    # the queue is fully committed despite the poison record
+    assert q.committed("default") == 3
+
+
+def test_bounded_concurrency_backpressure(tmp_path):
+    """A slow engine must see at most `concurrency` simultaneous calls."""
+    import threading
+
+    peak = [0]
+    live = [0]
+    lock = threading.Lock()
+
+    spec = default_predictor(
+        PredictorSpec.from_dict(
+            {"name": "slow", "graph": {"name": "m", "type": "MODEL"}}
+        )
+    )
+
+    class SlowModel:
+        def predict(self, X, names, meta=None):
+            import time as _t
+
+            with lock:
+                live[0] += 1
+                peak[0] = max(peak[0], live[0])
+            _t.sleep(0.05)
+            with lock:
+                live[0] -= 1
+            return [[1.0]]
+
+    app = EngineApp(spec, registry={"m": SlowModel()})
+    port = free_port()
+    stop = serve_on_thread(app.rest_app().serve_forever("127.0.0.1", port), port)
+    try:
+        q = FileQueue(str(tmp_path / "q"))
+        for r in records(12):
+            q.append(r)
+        consumer = IngestConsumer(q, "127.0.0.1", port,
+                                  out_path=str(tmp_path / "r.jsonl"),
+                                  concurrency=3)
+        stats = asyncio.run(consumer.run(drain=True))
+    finally:
+        stop()
+    assert stats["scored"] == 12
+    assert peak[0] <= 3
+
+
+def test_cli_enqueue_and_consume(tmp_path, engine_port, capsys):
+    from seldon_core_tpu.ingest import main
+
+    recs = tmp_path / "recs.jsonl"
+    recs.write_text("\n".join(json.dumps(r) for r in records(5)) + "\n")
+    main(["enqueue", "--queue-dir", str(tmp_path / "q"), "--file", str(recs)])
+    out = capsys.readouterr().out
+    assert "enqueued 5" in out
+    main([
+        "consume", "--queue-dir", str(tmp_path / "q"),
+        "--engine", f"127.0.0.1:{engine_port}",
+        "--out", str(tmp_path / "results.jsonl"), "--drain",
+    ])
+    assert len(read_results(str(tmp_path / "results.jsonl"))) == 5
