@@ -1,14 +1,18 @@
-"""Distributed tracing: per-hop spans + JAX device-trace hooks.
+"""Distributed tracing: per-hop spans, Jaeger agent export, JAX hooks.
 
 Parity with the reference's Jaeger/OpenTracing wiring (reference: engine
 TracingProvider + span re-activation across async graph hops
 PredictiveUnitBean.java:85-118, outbound header injection
 InternalPredictionService.java:141-144, Python wrapper jaeger setup
 python/seldon_core/microservice.py:116-151). The image has no jaeger
-client, so spans are collected in-process and exported in Jaeger-JSON
-shape (loadable in the Jaeger UI); propagation uses the Jaeger
-``uber-trace-id`` header format so traces stitch across engine →
-microservice process hops.
+client, so the agent protocol is implemented directly: finished spans are
+pushed to the Jaeger agent over UDP in thrift-compact ``emitBatch``
+datagrams (``JAEGER_AGENT_HOST``/``JAEGER_AGENT_PORT`` env, the
+reference's exact knobs), with per-request probabilistic sampling
+(``JAEGER_SAMPLER_TYPE``/``JAEGER_SAMPLER_PARAM``). Spans are also kept
+in-process and served in Jaeger HTTP-API JSON shape at the engine's
+``/traces`` route; propagation uses the ``uber-trace-id`` header format
+so traces stitch across engine → microservice process hops.
 
 TPU deltas: ``device_trace`` wraps ``jax.profiler.TraceAnnotation`` so a
 span's name shows up inside XLA device profiles, and
@@ -63,14 +67,26 @@ class Span:
 
 
 class Tracer:
-    """In-process span collector with contextvar activation."""
+    """In-process span collector with contextvar activation and optional
+    UDP push to a Jaeger agent."""
 
     def __init__(self, service_name: str = "seldon-tpu", max_spans: int = 4096,
-                 enabled: bool = True):
+                 enabled: bool = True, exporter: Optional["JaegerUdpExporter"] = None,
+                 sample_rate: float = 1.0):
         self.service_name = service_name
         self.enabled = enabled
+        self.exporter = exporter
+        self.sample_rate = float(sample_rate)
         self._spans: deque = deque(maxlen=max_spans)
+        self._pending: List[Span] = []  # awaiting export
         self._lock = threading.Lock()
+        self._flusher: Optional[threading.Thread] = None
+        self._closed = threading.Event()
+        if exporter is not None:
+            self._flusher = threading.Thread(
+                target=self._flush_loop, daemon=True, name="jaeger-flush"
+            )
+            self._flusher.start()
 
     # -- span lifecycle -----------------------------------------------------
 
@@ -83,6 +99,21 @@ class Tracer:
             yield _NOOP_SPAN
             return
         parent = self.extract(headers) if headers and TRACE_HEADER in headers else _current_span.get()
+        if parent is _UNSAMPLED:
+            # inside an unsampled request: children must not re-roll the
+            # dice (they would export orphan fragments of dropped traces)
+            yield _NOOP_SPAN
+            return
+        if parent is None and self.sample_rate < 1.0:
+            # per-request head sampling: the ROOT decides; the decision is
+            # pinned in the context so every nested span inherits it
+            if random.random() >= self.sample_rate:
+                token = _current_span.set(_UNSAMPLED)
+                try:
+                    yield _NOOP_SPAN
+                finally:
+                    _current_span.reset(token)
+                return
         s = Span(
             operation=operation,
             trace_id=parent.trace_id if parent else _rand_id(),
@@ -104,6 +135,39 @@ class Tracer:
             _current_span.reset(token)
             with self._lock:
                 self._spans.append(s)
+                if self.exporter is not None:
+                    self._pending.append(s)
+                    do_flush = len(self._pending) >= 64
+            if self.exporter is not None and do_flush:
+                self.flush()
+
+    def flush(self) -> int:
+        """Push pending spans to the agent now; returns spans exported."""
+        if self.exporter is None:
+            return 0
+        with self._lock:
+            batch, self._pending = self._pending, []
+        if batch:
+            try:
+                self.exporter.emit(self.service_name, batch)
+            except OSError:  # agent away: tracing must never break serving
+                pass
+        return len(batch)
+
+    def _flush_loop(self) -> None:
+        while not self._closed.wait(0.5):
+            self.flush()
+
+    def close(self) -> None:
+        """Stop the flusher thread and export what's left. init_tracer
+        closes any replaced tracer, so re-init cannot leak threads."""
+        self._closed.set()
+        self.flush()
+        if self.exporter is not None:
+            try:
+                self.exporter._sock.close()
+            except OSError:
+                pass
 
     def active_span(self) -> Optional[Span]:
         return _current_span.get()
@@ -112,7 +176,7 @@ class Tracer:
 
     def inject(self, headers: Dict[str, str]) -> Dict[str, str]:
         s = _current_span.get()
-        if s is not None and self.enabled:
+        if s is not None and s is not _UNSAMPLED and self.enabled:
             headers[TRACE_HEADER] = s.context_header()
         return headers
 
@@ -174,6 +238,146 @@ class Tracer:
         return {"data": data}
 
 
+class JaegerUdpExporter:
+    """Jaeger agent client: thrift-compact ``Agent.emitBatch`` oneway
+    messages over UDP :6831 — the exact wire protocol jaeger-client's
+    UDPSender speaks, implemented directly (no thrift dependency in the
+    image). Batches are split to fit the agent's 65KB datagram limit."""
+
+    # thrift compact type nibbles
+    _T_BOOL_TRUE, _T_BOOL_FALSE = 1, 2
+    _T_I32, _T_I64, _T_DOUBLE, _T_STR, _T_LIST, _T_STRUCT = 5, 6, 7, 8, 9, 12
+
+    def __init__(self, host: str, port: int = 6831, max_packet: int = 65000):
+        import socket
+
+        self.addr = (host, int(port))
+        self.max_packet = max_packet
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    # -- thrift compact primitives ------------------------------------------
+
+    @staticmethod
+    def _varint(n: int) -> bytes:
+        out = bytearray()
+        while True:
+            if n < 0x80:
+                out.append(n)
+                return bytes(out)
+            out.append((n & 0x7F) | 0x80)
+            n >>= 7
+
+    @classmethod
+    def _zigzag(cls, n: int, bits: int = 64) -> bytes:
+        return cls._varint(((n << 1) ^ (n >> (bits - 1))) & ((1 << bits) - 1))
+
+    @classmethod
+    def _field(cls, out: bytearray, last_id: int, fid: int, ftype: int) -> int:
+        delta = fid - last_id
+        if 0 < delta <= 15:
+            out.append((delta << 4) | ftype)
+        else:
+            out.append(ftype)
+            out += cls._zigzag(fid, 16)
+        return fid
+
+    @classmethod
+    def _string(cls, s: str) -> bytes:
+        b = s.encode("utf-8")
+        return cls._varint(len(b)) + b
+
+    @classmethod
+    def _list_header(cls, size: int, etype: int) -> bytes:
+        if size < 15:
+            return bytes([(size << 4) | etype])
+        return bytes([0xF0 | etype]) + cls._varint(size)
+
+    @staticmethod
+    def _i64_of_hex(h: str) -> int:
+        v = int(h, 16) & 0xFFFFFFFFFFFFFFFF
+        return v - (1 << 64) if v >= (1 << 63) else v
+
+    # -- jaeger.thrift structs ----------------------------------------------
+
+    def _tag(self, key: str, value: Any) -> bytes:
+        out = bytearray()
+        last = self._field(out, 0, 1, self._T_STR)          # key
+        out += self._string(key)
+        last = self._field(out, last, 2, self._T_I32)       # vType = STRING(0)
+        out += self._zigzag(0, 32)
+        last = self._field(out, last, 3, self._T_STR)       # vStr
+        out += self._string(str(value))
+        out.append(0)  # stop
+        return bytes(out)
+
+    def _span(self, s: Span) -> bytes:
+        out = bytearray()
+        last = self._field(out, 0, 1, self._T_I64)          # traceIdLow
+        out += self._zigzag(self._i64_of_hex(s.trace_id))
+        last = self._field(out, last, 2, self._T_I64)       # traceIdHigh
+        out += self._zigzag(0)
+        last = self._field(out, last, 3, self._T_I64)       # spanId
+        out += self._zigzag(self._i64_of_hex(s.span_id))
+        last = self._field(out, last, 4, self._T_I64)       # parentSpanId
+        out += self._zigzag(self._i64_of_hex(s.parent_id) if s.parent_id else 0)
+        last = self._field(out, last, 5, self._T_STR)       # operationName
+        out += self._string(s.operation)
+        last = self._field(out, last, 7, self._T_I32)       # flags = sampled
+        out += self._zigzag(1, 32)
+        last = self._field(out, last, 8, self._T_I64)       # startTime us
+        out += self._zigzag(s.start_us)
+        last = self._field(out, last, 9, self._T_I64)       # duration us
+        out += self._zigzag(s.duration_us)
+        if s.tags:
+            last = self._field(out, last, 10, self._T_LIST)  # tags
+            out += self._list_header(len(s.tags), self._T_STRUCT)
+            for k, v in s.tags.items():
+                out += self._tag(k, v)
+        out.append(0)  # stop
+        return bytes(out)
+
+    def _batch(self, service_name: str, spans: List[Span]) -> bytes:
+        process = bytearray()
+        plast = self._field(process, 0, 1, self._T_STR)
+        process += self._string(service_name)
+        process.append(0)
+
+        batch = bytearray()
+        blast = self._field(batch, 0, 1, self._T_STRUCT)    # process
+        batch += process
+        blast = self._field(batch, blast, 2, self._T_LIST)  # spans
+        batch += self._list_header(len(spans), self._T_STRUCT)
+        for s in spans:
+            batch += self._span(s)
+        batch.append(0)
+
+        # message: protocol 0x82, ONEWAY(4)<<5 | version 1, seqid, name,
+        # then the args struct {1: Batch}
+        msg = bytearray(b"\x82\x81")
+        msg += self._varint(0)                               # seqid
+        msg += self._string("emitBatch")
+        alast = self._field(msg, 0, 1, self._T_STRUCT)
+        msg += batch
+        msg.append(0)
+        return bytes(msg)
+
+    def emit(self, service_name: str, spans: List[Span]) -> None:
+        # split so each datagram stays under the agent's packet limit
+        chunk: List[Span] = []
+        size = 0
+        for s in spans:
+            est = 128 + len(s.operation) + sum(
+                len(str(k)) + len(str(v)) + 16 for k, v in s.tags.items()
+            )
+            if chunk and size + est > self.max_packet:
+                self._sock.sendto(self._batch(service_name, chunk), self.addr)
+                chunk, size = [], 0
+            chunk.append(s)
+            size += est
+        if chunk:
+            self._sock.sendto(self._batch(service_name, chunk), self.addr)
+
+
 class _NoopSpan(Span):
     def __init__(self):
         super().__init__("noop", "0", "0")
@@ -186,6 +390,9 @@ class _NoopSpan(Span):
 
 
 _NOOP_SPAN = _NoopSpan()
+# context marker for "this request lost the sampling coin flip": children
+# and injected headers must follow the root's decision, not re-roll
+_UNSAMPLED = _NoopSpan()
 
 # -- global tracer (the reference reads JAEGER_* env in both wrapper and
 # engine; TRACING=1 gates setup — microservice.py:116-151) ------------------
@@ -194,12 +401,34 @@ _GLOBAL: Optional[Tracer] = None
 
 
 def init_tracer(service_name: Optional[str] = None, enabled: Optional[bool] = None) -> Tracer:
+    """Env parity with the reference's jaeger setup (microservice.py:116-151):
+    TRACING gates it, JAEGER_AGENT_HOST/PORT select the UDP agent,
+    JAEGER_SAMPLER_TYPE const|probabilistic + JAEGER_SAMPLER_PARAM set the
+    per-request head-sampling rate."""
     global _GLOBAL
+    if _GLOBAL is not None:
+        _GLOBAL.close()
     if enabled is None:
         enabled = os.environ.get("TRACING", "0") not in ("0", "false", "")
+    exporter = None
+    agent_host = os.environ.get("JAEGER_AGENT_HOST", "")
+    if enabled and agent_host:
+        exporter = JaegerUdpExporter(
+            agent_host, int(os.environ.get("JAEGER_AGENT_PORT", "6831"))
+        )
+    sampler_type = os.environ.get("JAEGER_SAMPLER_TYPE", "const")
+    try:
+        param = float(os.environ.get("JAEGER_SAMPLER_PARAM", "1"))
+    except ValueError:
+        param = 1.0
+    sample_rate = param if sampler_type == "probabilistic" else (
+        1.0 if param else 0.0
+    )
     _GLOBAL = Tracer(
         service_name or os.environ.get("JAEGER_SERVICE_NAME", "seldon-tpu"),
         enabled=enabled,
+        exporter=exporter,
+        sample_rate=sample_rate,
     )
     return _GLOBAL
 

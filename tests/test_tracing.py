@@ -161,3 +161,120 @@ def test_device_trace_annotation_smoke():
     with tracing.device_trace("matmul"):
         x = jnp.ones((4, 4))
         (x @ x).block_until_ready()
+
+
+# -- out-of-process export (VERDICT r3 #9) ----------------------------------
+
+
+def _udp_collector():
+    """Fake jaeger agent: bound UDP socket + drained datagrams."""
+    import socket
+
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.settimeout(2.0)
+    return sock, sock.getsockname()[1]
+
+
+def test_jaeger_udp_export_reaches_agent(monkeypatch):
+    """Spans land in a fake agent as thrift-compact emitBatch datagrams —
+    the wire jaeger-client's UDPSender speaks (reference env parity:
+    JAEGER_AGENT_HOST/PORT, microservice.py:116-151)."""
+    sock, port = _udp_collector()
+    monkeypatch.setenv("TRACING", "1")
+    monkeypatch.setenv("JAEGER_AGENT_HOST", "127.0.0.1")
+    monkeypatch.setenv("JAEGER_AGENT_PORT", str(port))
+    monkeypatch.setenv("JAEGER_SERVICE_NAME", "svc-under-test")
+    tracer = init_tracer()
+    try:
+        with tracer.span("score-request", tags={"deployment": "dep-1"}):
+            pass
+        assert tracer.flush() == 1
+        pkt, _ = sock.recvfrom(65536)
+    finally:
+        sock.close()
+        init_tracer(enabled=False)
+    # thrift compact message header: protocol id 0x82, ONEWAY<<5|version
+    assert pkt[0] == 0x82 and pkt[1] == 0x81
+    assert b"emitBatch" in pkt
+    # strings ride verbatim in thrift compact
+    assert b"svc-under-test" in pkt
+    assert b"score-request" in pkt
+    assert b"deployment" in pkt and b"dep-1" in pkt
+
+
+def test_engine_and_wrapper_spans_land_in_collector(monkeypatch):
+    """End-to-end: engine graph spans AND the microservice wrapper's
+    server-side spans both push to the same fake agent."""
+    import asyncio
+
+    from _net import free_port, serve_on_thread
+
+    from seldon_core_tpu.graph.service import EngineApp
+    from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
+    from seldon_core_tpu.wrapper import get_rest_microservice
+
+    sock, aport = _udp_collector()
+    monkeypatch.setenv("TRACING", "1")
+    monkeypatch.setenv("JAEGER_AGENT_HOST", "127.0.0.1")
+    monkeypatch.setenv("JAEGER_AGENT_PORT", str(aport))
+    tracer = init_tracer()
+
+    class M:
+        def predict(self, X, names, meta=None):
+            import numpy as np
+
+            return np.asarray(X)
+
+    mport = free_port()
+    stop = serve_on_thread(
+        get_rest_microservice(M()).serve_forever("127.0.0.1", mport), mport
+    )
+    try:
+        spec = default_predictor(
+            PredictorSpec.from_dict(
+                {
+                    "name": "d",
+                    "graph": {
+                        "name": "m", "type": "MODEL",
+                        "endpoint": {"service_host": "127.0.0.1",
+                                     "service_port": mport, "transport": "REST"},
+                    },
+                }
+            )
+        )
+        engine = EngineApp(spec)
+        asyncio.run(engine.predict({"data": {"ndarray": [[1.0]]}}))
+        tracer.flush()
+        blob = b""
+        for _ in range(4):
+            try:
+                pkt, _ = sock.recvfrom(65536)
+                blob += pkt
+            except TimeoutError:
+                break
+    finally:
+        stop()
+        sock.close()
+        init_tracer(enabled=False)
+    assert b"predictions" in blob  # engine root span
+    assert b"predict" in blob      # wrapper server-side span (same process
+    # tracer here, but it crossed the REST hop via uber-trace-id)
+
+
+def test_probabilistic_sampling_gates_root_spans(monkeypatch):
+    monkeypatch.setenv("TRACING", "1")
+    monkeypatch.delenv("JAEGER_AGENT_HOST", raising=False)
+    monkeypatch.setenv("JAEGER_SAMPLER_TYPE", "probabilistic")
+    monkeypatch.setenv("JAEGER_SAMPLER_PARAM", "0.0")
+    tracer = init_tracer()
+    for _ in range(20):
+        with tracer.span("never-sampled"):
+            pass
+    assert tracer.finished_spans() == []
+    monkeypatch.setenv("JAEGER_SAMPLER_PARAM", "1.0")
+    tracer = init_tracer()
+    with tracer.span("always-sampled"):
+        pass
+    assert len(tracer.finished_spans()) == 1
+    init_tracer(enabled=False)
