@@ -66,7 +66,11 @@ class Request:
         self.body = body
 
     def json(self):
-        """Decode the payload: JSON body, form-encoded ``json=``, or query ``json=``."""
+        """Decode the payload: JSON body, form-encoded ``json=``, query
+        ``json=``, or multipart/form-data (reference: the engine accepts
+        multipart predictions, RestClientController.java:136-206 — parts
+        named after SeldonMessage fields: json, jsonData, strData,
+        binData)."""
         ctype = self.headers.get("content-type", "")
         if self.body:
             if ctype.startswith("application/x-www-form-urlencoded"):
@@ -74,12 +78,57 @@ class Request:
                 if "json" in form:
                     return json.loads(form["json"][0])
                 raise ValueError("form body missing json field")
+            if ctype.startswith("multipart/form-data"):
+                return self._multipart_message(ctype)
             return json.loads(self.body)
         if self.query:
             q = parse_qs(self.query)
             if "json" in q:
                 return json.loads(q["json"][0])
         return None
+
+    def _multipart_message(self, ctype: str):
+        import base64
+        import re
+
+        m = re.search(r'boundary="?([^";]+)"?', ctype)
+        if not m:
+            raise ValueError("multipart body missing boundary")
+        delim = b"\r\n--" + m.group(1).encode()
+        parts: Dict[str, bytes] = {}
+        # a part's payload ends EXACTLY at the CRLF preceding the next
+        # boundary — splitting on that delimiter keeps payloads byte-exact
+        # (strip()-style trimming would eat a binData's own trailing \n).
+        # Prepending CRLF makes the first boundary match the same pattern.
+        for chunk in (b"\r\n" + self.body).split(delim)[1:]:
+            if chunk.startswith(b"--"):
+                break  # closing boundary
+            if chunk.startswith(b"\r\n"):
+                chunk = chunk[2:]
+            head, sep, payload = chunk.partition(b"\r\n\r\n")
+            if not sep:
+                continue  # malformed part (no header/body separator)
+            nm = re.search(rb'name="([^"]+)"', head)
+            if nm:
+                parts[nm.group(1).decode("latin-1")] = payload
+        if "json" in parts:  # a whole SeldonMessage as one part
+            return json.loads(parts["json"])
+        msg: Dict[str, object] = {}
+        if "jsonData" in parts:
+            msg["jsonData"] = json.loads(parts["jsonData"])
+        elif "strData" in parts:
+            msg["strData"] = parts["strData"].decode("utf-8")
+        elif "binData" in parts:
+            msg["binData"] = base64.b64encode(parts["binData"]).decode("ascii")
+        elif "data" in parts:
+            msg["data"] = json.loads(parts["data"])
+        if not msg:
+            raise ValueError(
+                "multipart body has no json/jsonData/strData/binData/data part"
+            )
+        if "meta" in parts:
+            msg["meta"] = json.loads(parts["meta"])
+        return msg
 
 
 def _json_default(obj):

@@ -398,3 +398,78 @@ def test_admission_control_429():
     assert len(rejected) == 4
     assert all(e.status == 429 for e in rejected)
     assert "max-inflight" in rejected[0].info
+
+
+def test_multipart_form_predictions(rest_client):
+    """Multipart predictions parity (reference: RestClientController
+    accepts multipart, RestClientController.java:136-206): parts named
+    after SeldonMessage fields."""
+    app = make_app()
+    client = rest_client(app.rest_app())
+    boundary = "XbOuNdArYx"
+    body = (
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="data"; filename="d.json"\r\n'
+        "Content-Type: application/json\r\n\r\n"
+        '{"ndarray": [[1.0, 2.0]]}\r\n'
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="meta"\r\n\r\n'
+        '{"puid": "mp-1"}\r\n'
+        f"--{boundary}--\r\n"
+    ).encode()
+    import asyncio as _a
+
+    from seldon_core_tpu.http_server import Request
+
+    req = Request(
+        "POST", "/api/v0.1/predictions", "",
+        {"content-type": f"multipart/form-data; boundary={boundary}"}, body,
+    )
+    resp = _a.run(app.rest_app()._dispatch(req))
+    assert resp.status == 200, resp.body
+    out = json.loads(resp.body)
+    assert out["data"]["ndarray"] == [[0.9, 0.05, 0.05]]
+    assert out["meta"]["puid"] == "mp-1"
+
+
+def test_multipart_whole_message_part(rest_client):
+    app = make_app()
+    boundary = "bb"
+    body = (
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="json"\r\n\r\n'
+        '{"data": {"ndarray": [[3.0]]}}\r\n'
+        f"--{boundary}--\r\n"
+    ).encode()
+    import asyncio as _a
+
+    from seldon_core_tpu.http_server import Request
+
+    req = Request(
+        "POST", "/api/v0.1/predictions", "",
+        {"content-type": f'multipart/form-data; boundary="{boundary}"'}, body,
+    )
+    resp = _a.run(app.rest_app()._dispatch(req))
+    assert resp.status == 200, resp.body
+    assert json.loads(resp.body)["data"]["ndarray"] == [[0.9, 0.05, 0.05]]
+
+
+def test_multipart_without_payload_part_is_400():
+    app = make_app()
+    boundary = "bb"
+    body = (
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="unrelated"\r\n\r\n'
+        "x\r\n"
+        f"--{boundary}--\r\n"
+    ).encode()
+    import asyncio as _a
+
+    from seldon_core_tpu.http_server import Request
+
+    req = Request(
+        "POST", "/api/v0.1/predictions", "",
+        {"content-type": f"multipart/form-data; boundary={boundary}"}, body,
+    )
+    resp = _a.run(app.rest_app()._dispatch(req))
+    assert resp.status == 400
