@@ -67,6 +67,18 @@ def rows_from(bench):
                        or _extract_obj(line, "ary_front"),
                        "grpc_front": _extract_obj(line, "grpc_front")
                        or _extract_obj(line, "rpc_front")}
+            if not payload["model_tier"]:
+                # even the model_tier key was cut: pick up whichever tier
+                # sub-objects survive verbatim in the tail
+                tiers = {}
+                for key in ("resnet50_rest", "resnet50_device", "bert_grpc",
+                            "bert_grpc_latency", "llm_generate", "llm_1b",
+                            "llm_1b_latency", "llm_1b_spec",
+                            "llm_generate_long", "llm_1b_long"):
+                    obj = _extract_obj(line, key)
+                    if obj:
+                        tiers[key] = obj
+                payload["model_tier"] = tiers
             m = re.search(r'"unit": "req/s", "vs_baseline": ([0-9.]+)', line)
             if m:
                 payload["vs_baseline"] = float(m.group(1))
@@ -122,6 +134,14 @@ def rows_from(bench):
             "BERT-base, engine gRPC",
             f"{fmt(bg.get('rows_per_s'))} rows/s, MFU {bg.get('mfu_pct', '—')}%",
             "full stack at the chip's matmul roof",
+        ))
+    bl = mt.get("bert_grpc_latency") or {}
+    if bl:
+        rows.append((
+            "BERT-base, latency tier",
+            f"p50 {fmt(bl.get('p50_ms'), 1)} ms, p99 {fmt(bl.get('p99_ms'), 1)} ms",
+            f"{bl.get('concurrency', '—')} closed-loop lanes, single-row "
+            "requests — service latency, not queueing",
         ))
     g = mt.get("llm_generate") or {}
     if g:
