@@ -1,0 +1,203 @@
+// Example NON-PYTHON graph component speaking the Seldon wire contract.
+//
+// Counterpart of the reference's Java s2i example handler
+// (reference: wrappers/s2i/java/, ExampleModelHandler.java; R/NodeJS
+// wrappers doc/source/{R,nodejs}/) — proof that a component in any
+// language can sit behind the engine: it only has to answer the wrapper
+// route set with SeldonMessage JSON bodies.
+//
+// This one is a ~250-line dependency-free C++17 REST microservice:
+//   POST /predict          JSON SeldonMessage in -> row means out
+//   POST /transform-input  passthrough with a tag
+//   GET  /ping /ready /live
+//
+// Build + run:
+//   g++ -O2 -std=c++17 -o component component.cpp
+//   ./component 9100
+//
+// Put it in a graph like any wrapped model:
+//   {"name": "cpp", "type": "MODEL",
+//    "endpoint": {"service_host": "127.0.0.1", "service_port": 9100,
+//                 "transport": "REST"}}
+//
+// tests/test_cpp_component_example.py builds it and fronts it with BOTH
+// engines (Python + native).
+
+#include <arpa/inet.h>
+#include <csignal>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cctype>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+// -- tiny JSON helpers (enough for {"data": {"ndarray": [[...]]}}) ----------
+
+// find the first top-level ndarray matrix in the body; returns rows
+static bool parse_ndarray(const std::string& body,
+                          std::vector<std::vector<double>>& rows) {
+  size_t p = body.find("\"ndarray\"");
+  if (p == std::string::npos) return false;
+  p = body.find('[', p);
+  if (p == std::string::npos) return false;
+  int depth = 0;
+  std::vector<double> cur;
+  std::string num;
+  auto flush_num = [&]() {
+    if (!num.empty()) {
+      cur.push_back(strtod(num.c_str(), nullptr));
+      num.clear();
+    }
+  };
+  for (size_t i = p; i < body.size(); i++) {
+    char c = body[i];
+    if (c == '[') {
+      depth++;
+      if (depth == 2) cur.clear();
+    } else if (c == ']') {
+      flush_num();
+      if (depth == 2) rows.push_back(cur);
+      depth--;
+      if (depth == 0) return !rows.empty();
+    } else if (c == ',') {
+      flush_num();
+    } else if (isdigit(c) || c == '-' || c == '+' || c == '.' || c == 'e' ||
+               c == 'E') {
+      num.push_back(c);
+    }
+  }
+  return false;
+}
+
+static std::string mean_response(const std::vector<std::vector<double>>& rows) {
+  std::string out = "{\"data\":{\"names\":[\"mean\"],\"ndarray\":[";
+  char buf[64];
+  for (size_t r = 0; r < rows.size(); r++) {
+    double sum = 0;
+    for (double v : rows[r]) sum += v;
+    double mean = rows[r].empty() ? 0.0 : sum / double(rows[r].size());
+    snprintf(buf, sizeof buf, "%s[%.12g]", r ? "," : "", mean);
+    out += buf;
+  }
+  out += "]},\"meta\":{\"tags\":{\"component\":\"cpp-example\"}}}";
+  return out;
+}
+
+// -- minimal HTTP/1.1 serving ----------------------------------------------
+
+static void respond(int fd, int status, const std::string& body,
+                    bool keep_alive) {
+  const char* reason = status == 200 ? "OK" : status == 400 ? "Bad Request"
+                                                            : "Not Found";
+  char head[256];
+  int n = snprintf(head, sizeof head,
+                   "HTTP/1.1 %d %s\r\nContent-Type: application/json\r\n"
+                   "Content-Length: %zu\r\nConnection: %s\r\n\r\n",
+                   status, reason, body.size(),
+                   keep_alive ? "keep-alive" : "close");
+  std::string resp(head, n);
+  resp += body;
+  size_t off = 0;
+  while (off < resp.size()) {
+    ssize_t w = write(fd, resp.data() + off, resp.size() - off);
+    if (w <= 0) return;
+    off += size_t(w);
+  }
+}
+
+static void serve_conn(int fd) {
+  std::string buf;
+  char tmp[65536];
+  for (;;) {
+    size_t hdr_end;
+    while ((hdr_end = buf.find("\r\n\r\n")) == std::string::npos) {
+      ssize_t r = read(fd, tmp, sizeof tmp);
+      if (r <= 0) return;
+      buf.append(tmp, r);
+    }
+    std::string head = buf.substr(0, hdr_end);
+    size_t clen = 0;
+    {
+      size_t cp = head.find("Content-Length:");
+      if (cp == std::string::npos) cp = head.find("content-length:");
+      if (cp != std::string::npos) clen = strtoul(head.c_str() + cp + 15, nullptr, 10);
+    }
+    while (buf.size() < hdr_end + 4 + clen) {
+      ssize_t r = read(fd, tmp, sizeof tmp);
+      if (r <= 0) return;
+      buf.append(tmp, r);
+    }
+    std::string body = buf.substr(hdr_end + 4, clen);
+    buf.erase(0, hdr_end + 4 + clen);
+
+    size_t sp1 = head.find(' ');
+    size_t sp2 = head.find(' ', sp1 + 1);
+    if (sp1 == std::string::npos || sp2 == std::string::npos) return;
+    std::string method = head.substr(0, sp1);
+    std::string path = head.substr(sp1 + 1, sp2 - sp1 - 1);
+    size_t q = path.find('?');
+    if (q != std::string::npos) path.resize(q);
+
+    if (path == "/ping") {
+      respond(fd, 200, "\"pong\"", true);
+    } else if (path == "/ready" || path == "/live" || path == "/health/status") {
+      respond(fd, 200, "{\"status\":\"ok\"}", true);
+    } else if (path == "/predict" || path == "/api/v0.1/predictions") {
+      std::vector<std::vector<double>> rows;
+      if (!parse_ndarray(body, rows)) {
+        respond(fd, 400,
+                "{\"status\":{\"code\":400,\"info\":\"need data.ndarray\","
+                "\"status\":\"FAILURE\"}}",
+                true);
+      } else {
+        respond(fd, 200, mean_response(rows), true);
+      }
+    } else if (path == "/transform-input") {
+      // passthrough transformer: the body goes back with a tag merged in
+      std::string out = body;
+      size_t mp = out.rfind('}');
+      if (mp != std::string::npos)
+        out.insert(mp, ",\"meta\":{\"tags\":{\"transformed-by\":\"cpp-example\"}}");
+      respond(fd, 200, out, true);
+    } else {
+      respond(fd, 404,
+              "{\"status\":{\"code\":404,\"info\":\"no route\","
+              "\"status\":\"FAILURE\"}}",
+              true);
+    }
+  }
+}
+
+int main(int argc, char** argv) {
+  signal(SIGCHLD, SIG_IGN);  // no zombies from the per-connection forks
+  int port = argc > 1 ? atoi(argv[1]) : 9100;
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(uint16_t(port));
+  if (bind(lfd, (sockaddr*)&addr, sizeof addr) < 0 || listen(lfd, 64) < 0) {
+    perror("bind/listen");
+    return 1;
+  }
+  fprintf(stderr, "cpp-example component listening on :%d\n", port);
+  for (;;) {
+    int fd = accept(lfd, nullptr, nullptr);
+    if (fd < 0) continue;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    if (fork() == 0) {  // process-per-connection: simplest correct model
+      close(lfd);
+      serve_conn(fd);
+      _exit(0);
+    }
+    close(fd);
+  }
+}

@@ -1,0 +1,148 @@
+"""The non-Python wrapper story (examples/cpp-component): a C++ component
+speaking the wire contract, fronted by BOTH engines — counterpart of the
+reference's Java s2i wrapper example (wrappers/s2i/java/)."""
+
+import shutil
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+
+from _net import free_port, wait_port
+
+EXAMPLE = Path(__file__).parent.parent / "examples" / "cpp-component"
+
+
+@pytest.fixture(scope="module")
+def cpp_component():
+    binary = EXAMPLE / "component"
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-o", str(binary), "component.cpp"],
+        cwd=EXAMPLE, check=True,
+    )
+    port = free_port()
+    proc = subprocess.Popen([str(binary), str(port)])
+    try:
+        wait_port(port)
+        yield port
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_direct_predict(cpp_component):
+    import json
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{cpp_component}/predict",
+        data=json.dumps({"data": {"ndarray": [[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]]}}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        out = json.loads(r.read())
+    assert out["data"]["ndarray"] == [[2.0], [5.0]]
+    assert out["data"]["names"] == ["mean"]
+    assert out["meta"]["tags"]["component"] == "cpp-example"
+
+
+def test_python_engine_fronts_cpp_component(cpp_component):
+    import asyncio
+
+    from seldon_core_tpu.graph.service import EngineApp
+    from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
+
+    spec = default_predictor(
+        PredictorSpec.from_dict(
+            {
+                "name": "cppdep",
+                "graph": {
+                    "name": "cpp", "type": "MODEL",
+                    "endpoint": {"service_host": "127.0.0.1",
+                                 "service_port": cpp_component,
+                                 "transport": "REST"},
+                },
+            }
+        )
+    )
+    app = EngineApp(spec)
+    out = asyncio.run(app.predict({"data": {"ndarray": [[2.0, 4.0]]}}))
+    assert out["data"]["ndarray"] == [[3.0]]
+    # the component's custom tags surface through the engine meta-merge
+    assert out["meta"]["tags"]["component"] == "cpp-example"
+
+
+def test_native_engine_fronts_cpp_component(cpp_component):
+    import json
+    import urllib.request
+
+    from seldon_core_tpu.native_engine import NativeEngine, build
+
+    build()
+    port = free_port()
+    spec = {
+        "name": "cppnat",
+        "graph": {
+            "name": "cpp", "type": "MODEL",
+            "endpoint": {"service_host": "127.0.0.1",
+                         "service_port": cpp_component, "transport": "REST"},
+        },
+    }
+    with NativeEngine(spec, port=port):
+        wait_port(port)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v0.1/predictions",
+            data=json.dumps({"data": {"ndarray": [[10.0, 20.0]]}}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            out = json.loads(r.read())
+    got = out["data"].get("ndarray") or [out["data"]["tensor"]["values"]]
+    assert [[float(x) for x in row] for row in got] == [[15.0]]
+
+
+def test_cpp_transformer_in_graph(cpp_component):
+    """The same binary serves TRANSFORMER units (passthrough + tag)."""
+    import asyncio
+
+    from seldon_core_tpu.graph.service import EngineApp
+    from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
+
+    spec = default_predictor(
+        PredictorSpec.from_dict(
+            {
+                "name": "cppt",
+                "graph": {
+                    "name": "t", "type": "TRANSFORMER",
+                    "endpoint": {"service_host": "127.0.0.1",
+                                 "service_port": cpp_component,
+                                 "transport": "REST"},
+                    "children": [
+                        {"name": "m", "implementation": "SIMPLE_MODEL"}
+                    ],
+                },
+            }
+        )
+    )
+    app = EngineApp(spec)
+    out = asyncio.run(app.predict({"data": {"ndarray": [[1.0, 2.0]]}}))
+    assert out["data"]["ndarray"] == [[0.9, 0.05, 0.05]]
+    assert out["meta"]["tags"]["transformed-by"] == "cpp-example"
+
+
+def test_bad_payload_is_400(cpp_component):
+    import json
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{cpp_component}/predict",
+        data=json.dumps({"strData": "no tensor here"}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=5)
+    assert e.value.code == 400
