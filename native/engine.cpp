@@ -25,6 +25,16 @@
 //     bytes, not base64-inside-JSON (the zero-copy encoding's native
 //     transport; the reference's binary path was gRPC,
 //     grpc/SeldonGrpcServer.java:40-143)
+//
+// Division of labor (deliberate, not a gap): TPU co-location — in-process
+// JAX units, device-prefetch micro-batching, continuous generate lanes —
+// lives in the PYTHON engine, where the model runtime is. This binary is
+// the front/orchestration tier: stub + remote graphs, both wire fronts,
+// and the h2c upstream/streaming paths above. A deployment pairs them
+// (native front -> Python engine upstream) when it wants both; fusing
+// remote-unit calls in C++ would re-batch what the Python engine's
+// micro-batcher already fuses one hop later.
+//
 //   * --bench mode: in-binary loopback load generator (clients and server
 //     share the process, mirroring the locust setup of
 //     notebooks/benchmark_simple_model.ipynb without a cluster);

@@ -70,6 +70,12 @@ def main(argv=None) -> None:
     p_render.add_argument("-o", "--output", default="-",
                           help="output file (default stdout)")
 
+    sub.add_parser(
+        "crd",
+        help="print the SeldonDeployment CustomResourceDefinition YAML "
+        "(GitOps alternative to controller --kube's auto-install)",
+    )
+
     p_ctl = sub.add_parser("controller")
     p_ctl.add_argument("--gateway-port", type=int, default=int(os.environ.get("GATEWAY_PORT", 8003)))
     p_ctl.add_argument("--subprocess-runtime", action="store_true",
@@ -119,6 +125,13 @@ def main(argv=None) -> None:
             with open(args.output, "w") as f:
                 f.write(out)
             print(f"wrote {len(manifests)} objects to {args.output}", file=sys.stderr)
+        return
+
+    if args.cmd == "crd":
+        from .k8s import to_yaml
+        from .kube import CRD_MANIFEST
+
+        sys.stdout.write(to_yaml([CRD_MANIFEST]))
         return
 
     if args.cmd == "get":

@@ -224,6 +224,29 @@ class HttpKubeApi(KubeApi):
             raise KubeApiError(status, str(body.get("message", body)))
         return True
 
+    def watch(self, path: str, timeout_s: float = 300.0):
+        """Kubernetes watch stream: yields event dicts ({type, object})
+        until the server closes the window. The controller treats every
+        event as 'reconcile now' — level-triggered logic stays the source
+        of truth, the watch only shortens reaction time."""
+        import urllib.request
+
+        url = f"{self.server}/{path}?watch=1&timeoutSeconds={int(timeout_s)}"
+        req = urllib.request.Request(url)
+        req.add_header("Accept", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        with urllib.request.urlopen(
+            req, timeout=timeout_s + 10, context=self._ctx
+        ) as r:
+            for line in r:
+                if not line.strip():
+                    continue
+                try:
+                    yield json.loads(line)
+                except ValueError:
+                    continue
+
 
 def object_path(kind: str, namespace: Optional[str], name: Optional[str] = None) -> str:
     """REST path for a (kind, namespace, name). Cluster-scoped kinds (the
@@ -274,6 +297,7 @@ class KubeController:
         self.namespace = namespace  # None = all namespaces the api can list
         self.resync_s = resync_s
         self._stop = threading.Event()
+        self._kick = threading.Event()  # watch events accelerate the loop
         # namespaces this controller has ever reconciled into: pruning after
         # the LAST CR in a namespace is deleted needs somewhere to look.
         # Survives for the controller's lifetime; across restarts a real
@@ -472,14 +496,53 @@ class KubeController:
 
     def stop(self) -> None:
         self._stop.set()
+        self._kick.set()  # wake the run loop immediately
 
     def run(self, iterations: Optional[int] = None) -> None:
-        """Level-triggered control loop: reconcile everything, sleep the
-        resync period, repeat. A watch-capable api (``watch_seldon``
-        attribute) shortens the wait on events."""
+        """Level-triggered control loop: reconcile everything, wait for a
+        CR watch event OR the resync period, repeat. Watch events only
+        shorten the wait — every pass is a full level-triggered reconcile,
+        so a dropped event costs at most resync_s of staleness, never
+        correctness (the reference gets the same property from
+        controller-runtime's informers + periodic resync)."""
         self.install_crd()
+        kick = self._kick
+        watcher: Optional[threading.Thread] = None
+        if hasattr(self.api, "watch"):
+            def watch_loop() -> None:
+                if self.namespace:
+                    path = object_path("SeldonDeployment", self.namespace)
+                else:
+                    prefix, plural = KIND_ROUTES["SeldonDeployment"]
+                    path = f"{prefix}/{plural}"
+                failures = 0
+                while not self._stop.is_set():
+                    try:
+                        for _event in self.api.watch(path):
+                            kick.set()
+                            failures = 0
+                            if self._stop.is_set():
+                                return
+                        failures = 0  # clean window close
+                    except Exception as e:  # noqa: BLE001 - watch is an
+                        # accelerator; resync covers a broken stream. But a
+                        # PERSISTENT failure (RBAC missing the watch verb)
+                        # silently degrades reactivity — say so, once.
+                        failures += 1
+                        log = logger.warning if failures in (1, 10) else logger.debug
+                        log("watch stream failed (x%d, falling back to %ss "
+                            "resync): %s", failures, self.resync_s, e)
+                        self._stop.wait(min(1.0 * failures, 30.0))
+
+            watcher = threading.Thread(
+                target=watch_loop, daemon=True, name="sdep-watch"
+            )
+            watcher.start()
         n = 0
         while not self._stop.is_set():
+            # clear BEFORE reconciling: an event landing mid-pass must wake
+            # the next wait instead of being erased after the pass
+            kick.clear()
             try:
                 ops = self.reconcile_all()
                 if any(ops[k] for k in ("created", "replaced", "deleted")):
@@ -489,4 +552,5 @@ class KubeController:
             n += 1
             if iterations is not None and n >= iterations:
                 return
-            self._stop.wait(self.resync_s)
+            # woken early by a watch event or stop(); else the resync period
+            kick.wait(self.resync_s)

@@ -71,3 +71,24 @@ def test_scale_errors(tmp_path, capsys, dep_file):
 def test_status_missing(tmp_path, capsys):
     with pytest.raises(SystemExit):
         cli.main(["--store-dir", str(tmp_path / "s"), "status", "ghost"])
+
+
+def test_crd_subcommand_prints_manifest(capsys):
+    from seldon_core_tpu.controlplane.cli import main
+
+    main(["crd"])
+    out = capsys.readouterr().out
+    assert "CustomResourceDefinition" in out
+    assert "seldondeployments.machinelearning.seldon.io" in out
+    assert "x-kubernetes-preserve-unknown-fields" in out
+
+
+def test_controller_kube_needs_a_cluster(tmp_path):
+    """--kube outside a cluster with no --kube-server fails with guidance,
+    not a stack trace buried in a watch loop."""
+    import pytest
+
+    from seldon_core_tpu.controlplane.cli import main
+
+    with pytest.raises(RuntimeError, match="kubectl proxy"):
+        main(["--store-dir", str(tmp_path), "controller", "--kube"])

@@ -202,3 +202,30 @@ def test_cli_enqueue_and_consume(tmp_path, engine_port, capsys):
         "--out", str(tmp_path / "results.jsonl"), "--drain",
     ])
     assert len(read_results(str(tmp_path / "results.jsonl"))) == 5
+
+
+def test_ingest_drains_through_native_engine(tmp_path):
+    """The consumer speaks the engine's EXTERNAL API, so the C++ engine
+    works as the scoring tier too."""
+    import shutil
+
+    pytest.importorskip("numpy")
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    from _net import wait_port
+
+    from seldon_core_tpu.native_engine import NativeEngine, build
+
+    build()
+    port = free_port()
+    spec = {"name": "ing-nat", "graph": {"name": "m", "implementation": "SIMPLE_MODEL"}}
+    with NativeEngine(spec, port=port):
+        wait_port(port)
+        q = FileQueue(str(tmp_path / "q"))
+        for r in records(10):
+            q.append(r)
+        consumer = IngestConsumer(q, "127.0.0.1", port,
+                                  out_path=str(tmp_path / "r.jsonl"))
+        stats = asyncio.run(consumer.run(drain=True))
+    assert stats["scored"] == 10
+    assert len(read_results(str(tmp_path / "r.jsonl"))) == 10

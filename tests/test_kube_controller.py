@@ -280,3 +280,43 @@ def test_subset_equal_semantics():
     assert not subset_equal({"a": [1, 2]}, {"a": [1]})
     assert subset_equal(2, 2.0)
     assert not subset_equal({"a": {"b": 1}}, {"a": 3})
+
+
+def test_watch_events_accelerate_the_loop():
+    """A watch-capable api wakes the run loop immediately on CR events;
+    the loop stays level-triggered (a reconcile pass per wake)."""
+    import queue
+    import threading
+    import time
+
+    class WatchingFake(FakeKube):
+        def __init__(self):
+            super().__init__()
+            self.events: "queue.Queue" = queue.Queue()
+
+        def watch(self, path, timeout_s=300.0):
+            while True:
+                ev = self.events.get()
+                if ev is None:
+                    return  # stream window closed
+                yield ev
+
+    kube = WatchingFake()
+    ctl = KubeController(kube, namespace="prod", resync_s=30.0)
+    t = threading.Thread(target=ctl.run, daemon=True)
+    t.start()
+    try:
+        time.sleep(0.2)  # first (empty) pass done; loop now waits 30s
+        put_cr(kube, CR)
+        kube.events.put({"type": "ADDED", "object": CR})
+        deadline = time.time() + 5.0
+        dep_path = object_path("Deployment", "prod", "iris-main")
+        while time.time() < deadline and dep_path not in kube.objects:
+            time.sleep(0.05)
+        # converged in well under the 30s resync: the watch woke the loop
+        assert dep_path in kube.objects
+    finally:
+        ctl.stop()
+        kube.events.put(None)
+        t.join(timeout=5)
+        assert not t.is_alive()
