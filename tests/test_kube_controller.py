@@ -320,3 +320,45 @@ def test_watch_events_accelerate_the_loop():
         kube.events.put(None)
         t.join(timeout=5)
         assert not t.is_alive()
+
+
+def test_http_watch_stream_parses_json_lines():
+    """HttpKubeApi.watch reads a real chunk-less watch stream: one JSON
+    event per line until the server closes the window."""
+    import json as _json
+    import socket
+    import threading
+
+    from seldon_core_tpu.controlplane.kube import HttpKubeApi
+
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    events = [
+        {"type": "ADDED", "object": {"metadata": {"name": "a"}}},
+        {"type": "MODIFIED", "object": {"metadata": {"name": "a"}}},
+    ]
+
+    def serve():
+        conn, _ = srv.accept()
+        req = b""
+        while b"\r\n\r\n" not in req:
+            req += conn.recv(4096)
+        assert b"watch=1" in req
+        body = b"".join(_json.dumps(e).encode() + b"\n" for e in events)
+        conn.sendall(
+            b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+            b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n" + body
+        )
+        conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    api = HttpKubeApi(server=f"http://127.0.0.1:{port}")
+    got = list(api.watch("apis/machinelearning.seldon.io/v1alpha2/seldondeployments",
+                         timeout_s=5))
+    srv.close()
+    assert [e["type"] for e in got] == ["ADDED", "MODIFIED"]
