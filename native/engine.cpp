@@ -411,6 +411,14 @@ struct Engine {
   std::atomic<bool> stopping{false};
   std::vector<std::thread> loops;
   std::vector<int> listen_fds;
+  // graph readiness: a background checker probes every REMOTE unit (GET
+  // /ready for REST units, TCP connect for gRPC units) on a 5s cadence and
+  // gates this engine's /ready (parity with the Python engine's readiness
+  // loop and the reference's SeldonGraphReadyChecker.java:24-115)
+  std::atomic<bool> graph_ready{true};
+  struct RemoteEndpoint { std::string host; int port; bool grpc; };
+  std::vector<RemoteEndpoint> remote_endpoints;
+  std::thread ready_thread;
 };
 
 // --- builtin units (parity: reference engine/.../predictors/*.java) --------
@@ -1664,7 +1672,8 @@ static bool process_buffer(Engine& eng, Conn& c, std::mt19937& rng,
     } else if (path == "/live") {
       http_response(c.out, 200, "{\"status\":\"ok\"}");
     } else if (path == "/ready") {
-      if (eng.paused.load()) http_response(c.out, 503, error_json(503, "not ready"));
+      if (eng.paused.load() || !eng.graph_ready.load())
+        http_response(c.out, 503, error_json(503, "not ready"));
       else http_response(c.out, 200, "{\"status\":\"ok\"}");
     } else if (path == "/pause") {
       eng.paused.store(true);
@@ -1817,6 +1826,62 @@ static void engine_stop(Engine* eng);
 
 #include "grpc_front.inc"
 
+// probe one unit endpoint. REST units: GET /ready, any HTTP 2xx = ready
+// (the probe the wire contract guarantees on every component, and the one
+// the Python engine's readiness loop uses). gRPC units: a successful TCP
+// connect = ready — an h2c server would close on a stray HTTP/1.1 request,
+// so the probe stays at the transport level (the Python engine's
+// channel_ready() does the same).
+static bool ping_endpoint(const std::string& host, int port, bool grpc,
+                          int timeout_ms) {
+  int fd = connect_to(host, port, timeout_ms);
+  if (fd < 0) return false;
+  if (grpc) { close(fd); return true; }
+  char req[256];
+  int n = snprintf(req, sizeof req,
+                   "GET /ready HTTP/1.1\r\nHost: %s\r\nConnection: close\r\n\r\n",
+                   host.c_str());
+  bool ok = false;
+  if (write(fd, req, n) == n) {
+    // loop reads until the status line is complete — a fragmented first
+    // segment must not flap a healthy upstream to 503 for a whole sweep
+    char buf[64];
+    size_t have = 0;
+    while (have < sizeof buf - 1) {
+      ssize_t r = read(fd, buf + have, sizeof buf - 1 - have);
+      if (r <= 0) break;
+      have += size_t(r);
+      if (have >= 12) break;  // "HTTP/1.1 2xx"
+    }
+    if (have >= 12) {
+      buf[have] = 0;
+      const char* sp = strchr(buf, ' ');
+      ok = sp && sp[1] == '2';
+    }
+  }
+  close(fd);
+  return ok;
+}
+
+static void readiness_loop(Engine* eng) {
+  // every 5s (reference: @Scheduled(fixedDelay=5000),
+  // SeldonGraphReadyChecker.java:111), responsive to shutdown
+  while (!eng->stopping.load(std::memory_order_relaxed)) {
+    bool all = true;
+    for (auto& ep : eng->remote_endpoints)
+      if (!ping_endpoint(ep.host, ep.port, ep.grpc, 1000)) { all = false; break; }
+    eng->graph_ready.store(all, std::memory_order_relaxed);
+    for (int i = 0; i < 50 && !eng->stopping.load(std::memory_order_relaxed); i++)
+      usleep(100 * 1000);
+  }
+}
+
+static void collect_remote_endpoints(const Unit& u,
+                                     std::vector<Engine::RemoteEndpoint>& out) {
+  if (u.remote) out.push_back({u.host, u.port, u.grpc_transport});
+  for (auto& c : u.children) collect_remote_endpoints(c, out);
+}
+
 static Engine* engine_start(const std::string& spec_json, int port, int threads,
                             int grpc_port = 0) {
   json::Parser p(spec_json);
@@ -1840,9 +1905,18 @@ static Engine* engine_start(const std::string& spec_json, int port, int threads,
   eng->root = parse_unit(*graph);
   eng->port = port;
   eng->threads = threads;
+  collect_remote_endpoints(eng->root, eng->remote_endpoints);
+  if (!eng->remote_endpoints.empty()) {
+    // readiness starts FALSE until the first sweep proves the graph up —
+    // a probe racing boot must not route traffic at a dead upstream
+    eng->graph_ready.store(false);
+    eng->ready_thread = std::thread(readiness_loop, eng);
+  }
   if (grpc_port > 0) {
     int gfd = make_listener(grpc_port);
-    if (gfd < 0) { delete eng; return nullptr; }
+    // engine_stop, not delete: the readiness thread may already be running
+    // over *eng (raw delete = UAF + std::terminate on the joinable thread)
+    if (gfd < 0) { engine_stop(eng); return nullptr; }
     eng->listen_fds.push_back(gfd);
     eng->loops.emplace_back(grpc_loop, eng, gfd, 4242u);
   }
@@ -1863,6 +1937,7 @@ static Engine* engine_start(const std::string& spec_json, int port, int threads,
 static void engine_stop(Engine* eng) {
   eng->stopping.store(true);
   for (auto& t : eng->loops) t.join();
+  if (eng->ready_thread.joinable()) eng->ready_thread.join();
   for (int fd : eng->listen_fds) close(fd);
   delete eng;
 }

@@ -529,3 +529,82 @@ def test_feedback_route(built):
         with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
             text = r.read().decode()
         assert "feedback" in text
+
+
+def test_native_readiness_gates_on_remote_units(built):
+    """/ready reflects GRAPH health, not just pause state: a dead REMOTE
+    unit keeps readiness 503; once the upstream comes up, the 5s checker
+    flips it to 200 (parity with the Python engine's readiness loop and
+    the reference's SeldonGraphReadyChecker)."""
+    import time
+    import urllib.error
+    import urllib.request
+
+    from _net import free_port, wait_port
+
+    from seldon_core_tpu.native_engine import NativeEngine
+
+    up_port = free_port()
+    spec = {
+        "name": "readygate",
+        "graph": {
+            "name": "leaf", "type": "MODEL",
+            "endpoint": {"service_host": "127.0.0.1",
+                         "service_port": up_port, "transport": "REST"},
+        },
+    }
+    port = free_port()
+    with NativeEngine(spec, port=port):
+        wait_port(port)
+
+        def ready_status():
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/ready", timeout=3
+                ) as r:
+                    return r.status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        # nothing listening upstream -> not ready
+        assert ready_status() == 503
+        # /live stays 200 (liveness is about THIS process)
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/live", timeout=3) as r:
+            assert r.status == 200
+
+        # bring the upstream up ON THE PORT THE SPEC NAMES: minimal HTTP
+        # server answering the GET /ready probe with 200
+        import socket
+        import threading
+
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", up_port))
+        srv.listen(8)
+        stop_evt = threading.Event()
+
+        def serve():
+            while not stop_evt.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except OSError:
+                    return
+                try:
+                    conn.recv(4096)
+                    conn.sendall(
+                        b"HTTP/1.1 200 OK\r\nContent-Length: 4\r\n"
+                        b"Connection: close\r\n\r\npong"
+                    )
+                finally:
+                    conn.close()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        try:
+            deadline = time.time() + 12  # checker cadence is 5s
+            while time.time() < deadline and ready_status() != 200:
+                time.sleep(0.25)
+            assert ready_status() == 200
+        finally:
+            stop_evt.set()
+            srv.close()

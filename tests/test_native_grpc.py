@@ -444,3 +444,37 @@ def test_generate_stream_concurrent_with_unary(sse_upstream):
         t.join(timeout=15)
         chan.close()
     assert len(got["msgs"]) == 4
+
+
+def test_readiness_with_grpc_only_leaf(grpc_only_leaf):
+    """A gRPC-transport unit is probed at the TCP level (an h2c server
+    would reject a stray HTTP/1.1 GET), so a healthy gRPC-only graph
+    reports ready."""
+    import time
+    import urllib.error
+    import urllib.request
+
+    build()
+    port = free_port()
+    spec = {
+        "name": "grpcready",
+        "graph": {
+            "name": "leaf", "type": "MODEL",
+            "endpoint": {"service_host": "127.0.0.1",
+                         "service_port": grpc_only_leaf, "transport": "GRPC"},
+        },
+    }
+    with NativeEngine(spec, port=port):
+        wait_port(port)
+        deadline = time.time() + 10
+        status = 0
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/ready", timeout=3
+                ) as r:
+                    status = r.status
+                    break
+            except urllib.error.HTTPError:
+                time.sleep(0.2)
+        assert status == 200
