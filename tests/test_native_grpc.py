@@ -478,3 +478,54 @@ def test_readiness_with_grpc_only_leaf(grpc_only_leaf):
             except urllib.error.HTTPError:
                 time.sleep(0.2)
         assert status == 200
+
+
+def test_h2_front_survives_garbage_and_mutated_frames(engine):
+    """Robustness: random bytes, truncated prefaces, and bit-flipped valid
+    frames must never crash or wedge the front — every connection ends in
+    a clean close or error, and the server still serves afterwards."""
+    import random
+    import socket
+    import struct
+
+    _, _, gport = engine
+    rng = random.Random(1234)
+
+    def blast(payload: bytes):
+        s = socket.create_connection(("127.0.0.1", gport), timeout=5)
+        try:
+            s.sendall(payload)
+            s.settimeout(1.0)
+            try:
+                while s.recv(65536):
+                    pass
+            except (TimeoutError, OSError):
+                pass
+        finally:
+            s.close()
+
+    preface = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+    # pure noise
+    for n in (1, 9, 64, 1024):
+        blast(bytes(rng.getrandbits(8) for _ in range(n)))
+    # valid preface + noise frames
+    for _ in range(8):
+        frames = b""
+        for _ in range(rng.randint(1, 4)):
+            ln = rng.randint(0, 64)
+            ftype = rng.randint(0, 12)
+            flags = rng.getrandbits(8)
+            sid = rng.getrandbits(31)
+            frames += struct.pack(">I", ln)[1:] + bytes([ftype, flags])
+            frames += struct.pack(">I", sid)
+            frames += bytes(rng.getrandbits(8) for _ in range(ln))
+        blast(preface + frames)
+    # oversized frame length declaration
+    blast(preface + b"\xff\xff\xff\x00\x00\x00\x00\x00\x01")
+    # the front still serves a REAL client after all that
+    chan, stub = stub_for(gport)
+    try:
+        resp = stub(raw_req(np.asarray([[1.0]], np.float64)), timeout=10)
+        assert resp.data.raw.data
+    finally:
+        chan.close()
