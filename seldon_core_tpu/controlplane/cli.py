@@ -95,6 +95,9 @@ def main(argv=None) -> None:
                        help="bearer token (default: in-cluster service account)")
     p_ctl.add_argument("--resync-s", type=float, default=30.0,
                        help="kube mode: level-triggered reconcile period")
+    p_ctl.add_argument("--once", action="store_true",
+                       help="kube mode: one reconcile pass then exit "
+                       "(GitOps/CI: converge and report, no daemon)")
 
     args = parser.parse_args(argv)
     logging.basicConfig(level="INFO", format="%(asctime)s %(name)s %(levelname)s %(message)s")
@@ -214,6 +217,11 @@ def main(argv=None) -> None:
         api = HttpKubeApi(server=args.kube_server, token=args.kube_token)
         ns = args.namespace if args.namespace != "default" else None
         ctl = KubeController(api, namespace=ns, resync_s=args.resync_s)
+        if args.once:
+            ctl.install_crd()
+            ops = ctl.reconcile_all()
+            print(json.dumps(ops))
+            raise SystemExit(1 if ops.get("failed") else 0)
         try:
             ctl.run()
         except KeyboardInterrupt:

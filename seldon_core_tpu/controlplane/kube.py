@@ -395,6 +395,9 @@ class KubeController:
                     self.api.delete(object_path(kind, ns, obj["metadata"]["name"]))
                     ops["deleted"] += 1
         if apply_errors:
+            # surfaced in ops too: --once CI mode exits nonzero on ANY
+            # unconverged object, not just CR-level validation failures
+            ops["failed"] = ops.get("failed", 0) + len(apply_errors)
             self._set_status(
                 cr, "Creating",
                 f"{len(apply_errors)} of {len(manifests)} objects failed: "

@@ -92,3 +92,28 @@ def test_controller_kube_needs_a_cluster(tmp_path):
 
     with pytest.raises(RuntimeError, match="kubectl proxy"):
         main(["--store-dir", str(tmp_path), "controller", "--kube"])
+
+
+def test_controller_kube_once_single_pass(tmp_path, monkeypatch, capsys):
+    """--kube --once: converge and exit 0 (GitOps/CI mode) — one reconcile
+    pass against the API, ops printed as JSON."""
+    import json
+
+    import pytest
+
+    from seldon_core_tpu.controlplane import kube as kube_mod
+    from seldon_core_tpu.controlplane.cli import main
+
+    # conftest puts tests/ on sys.path
+    from test_kube_controller import CR, FakeKube, put_cr
+
+    fake = FakeKube()
+    put_cr(fake, CR)
+    monkeypatch.setattr(kube_mod, "HttpKubeApi", lambda **kw: fake)
+    with pytest.raises(SystemExit) as e:
+        main(["--store-dir", str(tmp_path), "-n", "prod",
+              "controller", "--kube", "--once"])
+    assert e.value.code == 0
+    ops = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert ops["created"] >= 2 and ops["failed"] == 0
+    assert kube_mod.object_path("Deployment", "prod", "iris-main") in fake.objects
