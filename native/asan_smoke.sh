@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# ASAN/UBSAN smoke for the native engine: boot the instrumented binary,
+# push a request mix through both fronts (valid + malformed), and fail on
+# any sanitizer report (halt_on_error aborts the process, which the
+# health checks below then catch).
+set -euo pipefail
+BIN=${1:?usage: asan_smoke.sh <engine-asan-binary>}
+PORT=${ASAN_SMOKE_PORT:-9963}
+GPORT=$((PORT + 1))
+
+export ASAN_OPTIONS="halt_on_error=1:abort_on_error=1:detect_leaks=0"
+export UBSAN_OPTIONS="halt_on_error=1:abort_on_error=1"
+
+"$BIN" --port "$PORT" --grpc-port "$GPORT" \
+  --spec '{"name":"asan","graph":{"name":"c","implementation":"AVERAGE_COMBINER","children":[{"name":"a","implementation":"SIMPLE_MODEL"},{"name":"b","implementation":"SIMPLE_MODEL"}]}}' &
+PID=$!
+trap 'kill $PID 2>/dev/null || true' EXIT
+
+for i in $(seq 1 50); do
+  curl -fsS "http://127.0.0.1:$PORT/ping" >/dev/null 2>&1 && break
+  kill -0 $PID 2>/dev/null || { echo "engine died during boot"; exit 1; }
+  sleep 0.1
+done
+
+# valid JSON predictions
+for i in $(seq 1 50); do
+  curl -fsS -X POST "http://127.0.0.1:$PORT/api/v0.1/predictions" \
+    -H 'Content-Type: application/json' \
+    -d '{"data":{"ndarray":[[1.0,2.0],[3.0,4.0]]}}' >/dev/null
+done
+# feedback + probes + metrics
+curl -fsS -X POST "http://127.0.0.1:$PORT/api/v0.1/feedback" \
+  -H 'Content-Type: application/json' -d '{"reward": 0.5}' >/dev/null
+curl -fsS "http://127.0.0.1:$PORT/metrics" >/dev/null
+curl -fsS "http://127.0.0.1:$PORT/inflight" >/dev/null
+# malformed inputs (each answered, none may trip the sanitizer)
+curl -s -X POST "http://127.0.0.1:$PORT/api/v0.1/predictions" \
+  -H 'Content-Type: application/json' -d '{broken' >/dev/null || true
+curl -s -X POST "http://127.0.0.1:$PORT/api/v0.1/predictions" \
+  -H 'Content-Type: application/x-protobuf' --data-binary $'\xff\xfe\x01' >/dev/null || true
+head -c 2048 /dev/urandom | curl -s -X POST --data-binary @- \
+  "http://127.0.0.1:$PORT/api/v0.1/predictions" >/dev/null || true
+# raw garbage at the h2 port
+head -c 512 /dev/urandom | timeout 2 bash -c "cat > /dev/tcp/127.0.0.1/$GPORT" || true
+printf 'PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n\x00\x00\x04\x08\x00\x00\x00\x00\x00AB' \
+  | timeout 2 bash -c "cat > /dev/tcp/127.0.0.1/$GPORT" || true
+
+sleep 0.3
+kill -0 $PID 2>/dev/null || { echo "engine crashed under smoke (sanitizer?)"; exit 1; }
+# still healthy after the mix
+curl -fsS "http://127.0.0.1:$PORT/ping" >/dev/null
+kill $PID
+wait $PID 2>/dev/null || true
+echo "ASAN smoke passed"
