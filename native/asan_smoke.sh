@@ -33,6 +33,12 @@ curl -fsS -X POST "http://127.0.0.1:$PORT/api/v0.1/feedback" \
   -H 'Content-Type: application/json' -d '{"reward": 0.5}' >/dev/null
 curl -fsS "http://127.0.0.1:$PORT/metrics" >/dev/null
 curl -fsS "http://127.0.0.1:$PORT/inflight" >/dev/null
+# multipart predictions (the C++ multipart parser under the sanitizer)
+curl -fsS -X POST "http://127.0.0.1:$PORT/api/v0.1/predictions" \
+  -F 'data={"ndarray": [[1.0, 2.0]]};type=application/json' >/dev/null
+curl -s -X POST "http://127.0.0.1:$PORT/api/v0.1/predictions" \
+  -H 'Content-Type: multipart/form-data; boundary=zz' \
+  --data-binary $'--zz\r\nbroken' >/dev/null || true
 # malformed inputs (each answered, none may trip the sanitizer)
 curl -s -X POST "http://127.0.0.1:$PORT/api/v0.1/predictions" \
   -H 'Content-Type: application/json' -d '{broken' >/dev/null || true

@@ -1370,6 +1370,91 @@ static bool predict_proto(Engine& eng, RequestCtx& ctx, const std::string& in_pb
   return true;
 }
 
+// multipart/form-data predictions: compose a SeldonMessage JSON body from
+// parts named after its fields — json, jsonData, data, strData, binData,
+// meta (parity with the Python fronts and the reference's multipart
+// controller, RestClientController.java:136-206). Payloads are byte-exact:
+// a part ends at the CRLF preceding the next boundary.
+static bool multipart_to_json(const std::string& body, const std::string& boundary,
+                              std::string& out_json, std::string& err) {
+  const std::string delim = "\r\n--" + boundary;
+  std::map<std::string, std::string> parts;
+  // scan IN PLACE (no prepended copy of a possibly large upload): the
+  // first boundary has no leading CRLF, later ones do
+  size_t start;
+  if (body.compare(0, boundary.size() + 2, "--" + boundary) == 0)
+    start = boundary.size() + 2;
+  else {
+    size_t b0 = body.find(delim);
+    if (b0 == std::string::npos) { err = "no multipart boundary found"; return false; }
+    start = b0 + delim.size();
+  }
+  while (true) {
+    if (body.compare(start, 2, "--") == 0) break;  // closing boundary
+    if (body.compare(start, 2, "\r\n") == 0) start += 2;
+    size_t hdr_end = body.find("\r\n\r\n", start);
+    if (hdr_end == std::string::npos) break;
+    size_t next = body.find(delim, hdr_end + 4);
+    if (next == std::string::npos) break;
+    std::string head = body.substr(start, hdr_end - start);
+    std::string payload = body.substr(hdr_end + 4, next - hdr_end - 4);
+    // the FIELD name parameter: require a separator before "name=" so
+    // filename="..." (which may precede name=, RFC 7578 fixes no order)
+    // never masquerades as the field name
+    size_t np = 0;
+    std::string fieldname;
+    while ((np = head.find("name=\"", np)) != std::string::npos) {
+      if (np == 0 || head[np - 1] == ' ' || head[np - 1] == ';') {
+        size_t ne = head.find('"', np + 6);
+        if (ne != std::string::npos) fieldname = head.substr(np + 6, ne - np - 6);
+        break;
+      }
+      np += 6;
+    }
+    if (!fieldname.empty()) parts[fieldname] = std::move(payload);
+    start = next + delim.size();
+  }
+  auto it = parts.find("json");
+  if (it != parts.end()) {  // a whole SeldonMessage as one part
+    out_json = it->second;
+    return true;
+  }
+  json::Value msg = json::Value::object();
+  bool have = false;
+  for (const char* field : {"jsonData", "data", "meta"}) {
+    auto p = parts.find(field);
+    if (p == parts.end()) continue;
+    json::Parser sub(p->second);
+    json::Value v = sub.parse();
+    if (!sub.ok) {
+      err = std::string(field) + " part is not valid JSON";
+      return false;
+    }
+    msg.set(field, std::move(v));
+    if (strcmp(field, "meta") != 0) have = true;
+  }
+  if (!have) {
+    auto ps = parts.find("strData");
+    if (ps != parts.end()) {
+      msg.set("strData", json::Value::string(ps->second));
+      have = true;
+    }
+  }
+  if (!have) {
+    auto pb = parts.find("binData");
+    if (pb != parts.end()) {
+      msg.set("binData", json::Value::string(b64_encode(pb->second)));
+      have = true;
+    }
+  }
+  if (!have) {
+    err = "multipart body has no json/jsonData/data/strData/binData part";
+    return false;
+  }
+  out_json = json::serialize(msg);
+  return true;
+}
+
 static void handle_predictions(Engine& eng, RequestCtx& ctx, const std::string& body,
                                std::string& out, bool binary = false) {
   InflightGuard guard(eng.inflight);
@@ -1575,6 +1660,7 @@ static bool process_buffer(Engine& eng, Conn& c, std::mt19937& rng,
     if (c.in.size() < c.need_total) return true;  // need more bytes
     header_end = c.in.find("\r\n\r\n");
     bool binary = false;
+    std::string mp_boundary;
     {
       const char* ct = strcasestr(c.in.c_str(), "content-type:");
       if (ct && ct < c.in.c_str() + header_end) {
@@ -1582,6 +1668,17 @@ static bool process_buffer(Engine& eng, Conn& c, std::mt19937& rng,
         while (*ct == ' ') ct++;
         binary = !strncasecmp(ct, "application/x-protobuf", 22) ||
                  !strncasecmp(ct, "application/octet-stream", 24);
+        if (!strncasecmp(ct, "multipart/form-data", 19)) {
+          const char* eol = strstr(ct, "\r\n");
+          const char* bd = strcasestr(ct, "boundary=");
+          if (bd && (!eol || bd < eol)) {
+            bd += 9;
+            if (*bd == '"') bd++;
+            const char* end = bd;
+            while (*end && *end != '"' && *end != ';' && *end != '\r') end++;
+            mp_boundary.assign(bd, end - bd);
+          }
+        }
       }
     }
 
@@ -1613,7 +1710,17 @@ static bool process_buffer(Engine& eng, Conn& c, std::mt19937& rng,
         ctx.rng = &rng;
         ctx.upstreams = &upstreams;
         ctx.binary = binary;
-        handle_predictions(eng, ctx, body, c.out, binary);
+        if (!mp_boundary.empty()) {
+          std::string json_body, mp_err;
+          if (!multipart_to_json(body, mp_boundary, json_body, mp_err)) {
+            eng.metrics.errors.fetch_add(1, std::memory_order_relaxed);
+            http_response(c.out, 400, error_json(400, mp_err));
+          } else {
+            handle_predictions(eng, ctx, json_body, c.out, false);
+          }
+        } else {
+          handle_predictions(eng, ctx, body, c.out, binary);
+        }
       }
     } else if (path == "/api/v0.1/feedback" || path == "/api/v1.0/feedback") {
       // reward feedback (reference: RestClientController.java:244-291).

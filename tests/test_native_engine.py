@@ -608,3 +608,43 @@ def test_native_readiness_gates_on_remote_units(built):
         finally:
             stop_evt.set()
             srv.close()
+
+
+def test_native_multipart_predictions(built):
+    """Multipart form predictions on the native front (parity with the
+    Python engine and the reference's multipart controller)."""
+    port = free_port()
+    spec = {"name": "mp", "graph": {"name": "stub", "implementation": "SIMPLE_MODEL"}}
+    with NativeEngine(spec, port=port):
+        wait_port(port)
+        boundary = "natBoUnD"
+        body = (
+            f"--{boundary}\r\n"
+            'Content-Disposition: form-data; name="data"; filename="d.json"\r\n'
+            "Content-Type: application/json\r\n\r\n"
+            '{"ndarray": [[1.0, 2.0]]}\r\n'
+            f"--{boundary}\r\n"
+            'Content-Disposition: form-data; name="meta"\r\n\r\n'
+            '{"puid": "mp-native-1"}\r\n'
+            f"--{boundary}--\r\n"
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v0.1/predictions",
+            data=body,
+            headers={"Content-Type": f"multipart/form-data; boundary={boundary}"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            out = json.loads(r.read())
+        assert out["data"]["ndarray"] == [[0.9, 0.05, 0.05]]
+        assert out["meta"]["puid"] == "mp-native-1"
+        # a part-less multipart is a clean 400
+        bad = f"--{boundary}\r\n".encode() + b"Content-Disposition: form-data; " \
+              b'name="x"\r\n\r\nv\r\n' + f"--{boundary}--\r\n".encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v0.1/predictions",
+            data=bad,
+            headers={"Content-Type": f"multipart/form-data; boundary={boundary}"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 400
