@@ -473,3 +473,74 @@ def test_multipart_without_payload_part_is_400():
     )
     resp = _a.run(app.rest_app()._dispatch(req))
     assert resp.status == 400
+
+
+def test_admission_429_maps_to_grpc_resource_exhausted():
+    """The gRPC front maps the admission 429 to RESOURCE_EXHAUSTED (not a
+    generic INTERNAL) so clients can back off on the right code."""
+    import threading
+    import time
+
+    import pytest
+
+    grpc = pytest.importorskip("grpc")
+    from _net import free_port, wait_port
+
+    class Slow(SeldonComponent):
+        def predict(self, X, names, meta=None):
+            time.sleep(1.0)
+            return np.asarray(X)
+
+    spec = default_predictor(
+        PredictorSpec.from_dict(
+            {
+                "name": "g429",
+                "annotations": {"seldon.io/max-inflight": "1"},
+                "graph": {"name": "m", "type": "MODEL"},
+            }
+        )
+    )
+    app = EngineApp(spec, registry={"m": Slow()}, metrics=MetricsRegistry())
+    port = free_port()
+    stop_evt = threading.Event()
+
+    def run():
+        import asyncio as _a
+
+        async def serve():
+            server = app.grpc_server()
+            server.add_insecure_port(f"127.0.0.1:{port}")
+            await server.start()
+            while not stop_evt.is_set():
+                await _a.sleep(0.05)
+            await server.stop(grace=0)
+
+        _a.run(serve())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    try:
+        wait_port(port)
+        from seldon_core_tpu.proto import prediction_pb2 as pb
+
+        chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+        rpc = chan.unary_unary(
+            "/seldontpu.Seldon/Predict",
+            request_serializer=pb.SeldonMessage.SerializeToString,
+            response_deserializer=pb.SeldonMessage.FromString,
+        )
+        req = pb.SeldonMessage()
+        req.data.ndarray.values.add().list_value.values.add().number_value = 1.0
+        # first call occupies the single slot...
+        fut = rpc.future(req, timeout=10)
+        time.sleep(0.2)
+        # ...the second is shed with RESOURCE_EXHAUSTED
+        with pytest.raises(grpc.RpcError) as e:
+            rpc(req, timeout=10)
+        assert e.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        assert "max-inflight" in e.value.details()
+        fut.result(timeout=10)  # the occupant completes fine
+        chan.close()
+    finally:
+        stop_evt.set()
+        t.join(timeout=5)
