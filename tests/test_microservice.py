@@ -213,3 +213,36 @@ def test_scalar_result_predict():
     assert resp.status == 200
     out = json.loads(resp.body)
     assert out["data"]["ndarray"] == 0.5 or out["data"]["ndarray"] == [0.5]
+
+
+def test_wrapper_multipart_predict():
+    """Multipart predictions work on the WRAPPER front too (same Request
+    parsing as the engine; reference accepted multipart on its engine)."""
+    import asyncio
+    import json as _json
+
+    import numpy as np
+
+    from seldon_core_tpu.http_server import Request
+    from seldon_core_tpu.wrapper import get_rest_microservice
+
+    class M:
+        def predict(self, X, names, meta=None):
+            return np.asarray(X) * 3
+
+    app = get_rest_microservice(M())
+    boundary = "wrapB"
+    body = (
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="data"\r\n\r\n'
+        '{"ndarray": [[1.0, 2.0]]}\r\n'
+        f"--{boundary}--\r\n"
+    ).encode()
+    req = Request(
+        "POST", "/predict", "",
+        {"content-type": f"multipart/form-data; boundary={boundary}"}, body,
+    )
+    resp = asyncio.run(app._dispatch(req))
+    assert resp.status == 200, resp.body
+    out = _json.loads(resp.body)
+    assert out["data"]["ndarray"] == [[3.0, 6.0]]
