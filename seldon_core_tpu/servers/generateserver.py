@@ -41,7 +41,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-from typing import Any, Callable, Dict, Iterable, List, Optional
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
 
 from ..user_model import SeldonComponent
 from .jaxserver import JAXServer
@@ -68,9 +68,12 @@ class GenerateServer(SeldonComponent):
         shard_cache_seq: bool = False,
         steps_per_poll: int = 8,
         pipeline_depth: int = 3,
+        attn_bucket: int = 128,
         speculate_tokens: int = 0,
         draft_layers: int = 0,
         draft_uri: Optional[str] = None,
+        warmup_prompt_lens: Optional[Sequence[int]] = None,
+        warmup_max_new_tokens: int = 0,
         **kwargs,
     ):
         self.model_uri = model_uri
@@ -82,9 +85,17 @@ class GenerateServer(SeldonComponent):
         ) else shard_cache_seq.lower() == "true"
         self._steps_per_poll = int(steps_per_poll)
         self._pipeline_depth = int(pipeline_depth)
+        self._attn_bucket = int(attn_bucket)
         self._speculate_tokens = int(speculate_tokens)
         self._draft_layers = int(draft_layers)
         self._draft_uri = draft_uri
+        # parse CSV from typed-params env ("128,1792") as well as sequences
+        if isinstance(warmup_prompt_lens, str):
+            warmup_prompt_lens = [
+                int(x) for x in warmup_prompt_lens.split(",") if x.strip()
+            ]
+        self._warmup_prompt_lens = list(warmup_prompt_lens or [])
+        self._warmup_max_new_tokens = int(warmup_max_new_tokens)
         self._extra = kwargs
         self.batcher = None
         self._model = None
@@ -144,10 +155,19 @@ class GenerateServer(SeldonComponent):
             shard_cache_seq=self._shard_cache_seq,
             steps_per_poll=self._steps_per_poll,
             pipeline_depth=self._pipeline_depth,
+            attn_bucket=self._attn_bucket,
             draft_model=draft_model,
             draft_params=draft_params,
             speculate_tokens=self._speculate_tokens,
         )
+        if self._warmup_prompt_lens:
+            # compile-before-listen: every prefill/insert/burst variant the
+            # declared traffic shape needs is built here, so the first
+            # admission wave never stalls tens of seconds on XLA
+            self.batcher.warm(
+                prompt_lens=self._warmup_prompt_lens,
+                max_new_tokens=self._warmup_max_new_tokens,
+            )
         self.batcher.start()
         logger.info(
             "generateserver: %s ready (slots=%d, max_seq=%d)",

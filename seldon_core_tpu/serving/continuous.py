@@ -77,6 +77,17 @@ class _Slot:
     # it would cost a host sync per admission); the next burst's [0] row
     # carries it to the host instead
     first_pending: bool = True
+    # tokens covered by bursts DISPATCHED so far (not yet necessarily
+    # observed). When the request has no eos, completion is predictable:
+    # dispatched >= max_new_tokens means the in-flight bursts already
+    # cover the whole budget and the lane can be re-admitted NOW instead
+    # of pipeline_depth bursts later (see the pre-free block in _loop)
+    dispatched: int = 0
+    # crediting fence: set once the request's output is complete (budget
+    # or eos) so rows from later in-flight bursts — overshoot decode, or
+    # rows that now belong to the lane's next occupant — are never
+    # appended or streamed to a finished request
+    credit_done: bool = False
 
 
 class ContinuousBatcher:
@@ -94,9 +105,10 @@ class ContinuousBatcher:
         max_seq: Optional[int] = None,
         mesh=None,
         shard_cache_seq: bool = False,
-        prefill_buckets: Sequence[int] = (32, 128, 512),
+        prefill_buckets: Sequence[int] = (32, 128, 512, 1024, 1792),
         steps_per_poll: int = 8,
         pipeline_depth: int = 3,
+        attn_bucket: int = 128,
         draft_model=None,
         draft_params=None,
         speculate_tokens: int = 4,
@@ -110,9 +122,21 @@ class ContinuousBatcher:
         self.max_seq = int(max_seq or model.cfg.max_seq)
         self.mesh = mesh
         self.steps_per_poll = int(steps_per_poll)
+        # burst length actually dispatched: pow2 floor of steps_per_poll —
+        # computed ONCE so warm() and the loop can never disagree on which
+        # burst executable exists
+        k = max(1, self.steps_per_poll)
+        while k & (k - 1):
+            k &= k - 1
+        self._k = k
         # how many bursts may be in flight before the host reads the oldest
         # one's tokens; 1 = fully synchronous (dispatch, read, dispatch ...)
         self.pipeline_depth = max(1, int(pipeline_depth))
+        # attention-read bucket granularity: the per-burst cache read is
+        # rounded up to a multiple of this. Smaller = tighter KV reads at
+        # deep prefixes but more burst executables (one per bucket); must
+        # keep the read MXU/VPU-tileable, so 64 is the practical floor
+        self.attn_bucket = max(64, int(attn_bucket))
         # speculative decoding: a cheap draft proposes `speculate_tokens`
         # tokens per round and ONE target chunk forward verifies them.
         # Exact for any draft: greedy lanes emit the target's argmax
@@ -223,6 +247,10 @@ class ContinuousBatcher:
         self.params = params
         cache_sharding = cache_sharding_for(model.cfg.n_kv_heads)
         self._cache = unstack_cache(model, cache_sharding)
+        # throwaway-cache factory for warm(): donating executables can't be
+        # pre-executed against the live cache, so warm runs them on a
+        # same-shape dummy that is dropped afterwards
+        self._make_cache = lambda: unstack_cache(model, cache_sharding)
         self._draft_params = None
         self._draft_cache = None
         if self.speculate_tokens > 0:
@@ -290,6 +318,51 @@ class ContinuousBatcher:
             first = jnp.where(temp > 0, sampled, greedy)
             return first, cache_one, key
 
+        def prefill_many(params, prompts, last_index, seeds, temps):
+            # m admissions share ONE forward: the prompt matmuls go from
+            # [Tb, d] to [m*Tb, d] rows, so the MXU amortises what m
+            # separate [1, Tb] prefills would each pay — at 20-30 admits/s
+            # the per-admission forward is the throughput tier's largest
+            # non-decode device cost. m is a small static bucket (2/4/8),
+            # so at most 3 extra executables exist per prompt bucket.
+            logits, slab = model.prefill(
+                params, prompts, prompts.shape[1], last_index=last_index
+            )
+            keys = jax.vmap(jax.random.PRNGKey)(seeds)
+            split = jax.vmap(jax.random.split)(keys)
+            keys, subs = split[:, 0], split[:, 1]
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            sampled = jax.vmap(
+                lambda k, lg, t: jax.random.categorical(k, lg / jnp.maximum(t, 1e-6))
+            )(subs, logits, temps).astype(jnp.int32)
+            firsts = jnp.where(temps > 0, sampled, greedy)
+            return firsts, slab, keys
+
+        def insert_many(cache, slab, slot_ix, firsts, first_pos, lane_keys,
+                       cur_tok, pos, keys):
+            # slab is the batched prefill's [L, m, KV, Tb, Dh] stack; each
+            # row i lands in its lane slot_ix[i] (traced start indices —
+            # one executable per (m, bucket), not per slot assignment)
+            m = slab["k"].shape[1]
+            new = {
+                name: [
+                    layer
+                    for layer in cache[name]
+                ]
+                for name in ("k", "v")
+            }
+            for i in range(m):
+                for name in ("k", "v"):
+                    for l in range(len(new[name])):
+                        new[name][l] = lax.dynamic_update_slice(
+                            new[name][l], slab[name][l, i:i + 1],
+                            (slot_ix[i], 0, 0, 0),
+                        )
+            cur_tok = cur_tok.at[slot_ix].set(firsts)
+            pos = pos.at[slot_ix].set(first_pos)
+            keys = keys.at[slot_ix].set(lane_keys)
+            return new, cur_tok, pos, keys
+
         def fused_burst(params, cache, cur_tok, pos, active, temps, keys, k, attn_len):
             """k fused decode steps as one executable; returns [k, slots]
             tokens so the host syncs once per burst. ``attn_len`` (static)
@@ -317,6 +390,8 @@ class ContinuousBatcher:
         )
         self._insert_fn = jax.jit(insert, donate_argnums=(0,))
         self._prefill_fn = jax.jit(prefill_one)
+        self._prefill_many_fn = jax.jit(prefill_many)
+        self._insert_many_fn = jax.jit(insert_many, donate_argnums=(0,))
 
         # -- speculative executables (exact; see spec_round docstring) ------
         self._spec_burst_fn = None
@@ -542,6 +617,125 @@ class ContinuousBatcher:
                 self._thread.start()
         self._started.wait()
 
+    def warm(
+        self,
+        prompt_lens: Sequence[int] = (),
+        max_new_tokens: int = 0,
+        batch_sizes: Sequence[int] = (1, 4),
+    ) -> None:
+        """Pre-compile every executable the serving loop will need for the
+        given traffic shape, BEFORE traffic arrives.
+
+        jit executables compile lazily, so without this the first
+        admission wave compiles the batched prefill, and every new
+        attention-read bucket a deepening prefix crosses compiles a new
+        burst — tens of seconds of stall landing mid-traffic. Warm runs
+        each variant once on dummy inputs (donating executables get a
+        throwaway same-shape cache) while the scheduler is idle; it must
+        be called before the first submit() (the wrapper's
+        warmup-before-listen phase).
+
+        Mirrors the reference's model-warmup-before-ready pattern
+        (readiness gating); compile-stall avoidance is the TPU-specific
+        reason it is load-bearing here.
+        """
+        import jax.numpy as jnp
+
+        buckets = sorted({self._bucket(p) for p in prompt_lens})
+        if not buckets:
+            buckets = [self.prefill_buckets[0]]
+        k = self._k
+        adv = k * (self.speculate_tokens + 1 if self._spec_burst_fn else 1)
+        # attention buckets a run at these prompt lengths can touch: from
+        # the shallowest first-burst prefix to the deepest end-of-budget.
+        # eos-bearing lanes outlive their budget until the host OBSERVES
+        # the stop — up to pipeline_depth-1 bursts of extra _pos_host
+        # advance — so cover that overhang too
+        lo = min(prompt_lens) if prompt_lens else 1
+        hi = (
+            (max(prompt_lens) if prompt_lens else 1)
+            + max_new_tokens
+            + adv * (1 + max(0, self.pipeline_depth - 1))
+        )
+        ab = self.attn_bucket
+        attn_lens = sorted(
+            {
+                min(self.max_seq, -(-p // ab) * ab)
+                for p in range(lo + adv, hi + 1, ab)
+            }
+            | {min(self.max_seq, -(-(hi) // ab) * ab)}
+        )
+        for bucket in buckets:
+            for m in batch_sizes:
+                if m > 1 and self.speculate_tokens > 0:
+                    continue  # spec mode admits singly
+                prompts = jnp.zeros((m, bucket), jnp.int32)
+                last = jnp.zeros((m,), jnp.int32)
+                if m == 1:
+                    first, cache_one, lane_key = self._prefill_fn(
+                        self.params, prompts, last, jnp.int32(0), jnp.float32(0.0)
+                    )
+                    dummy = self._make_cache()
+                    out = self._insert_fn(
+                        dummy, cache_one, 0, first[0], 1, lane_key,
+                        self._cur_tok, self._pos, self._keys,
+                    )
+                else:
+                    firsts, slab, lane_keys = self._prefill_many_fn(
+                        self.params, prompts, last,
+                        jnp.zeros((m,), jnp.int32), jnp.zeros((m,), jnp.float32),
+                    )
+                    dummy = self._make_cache()
+                    out = self._insert_many_fn(
+                        dummy, slab, jnp.arange(m, dtype=jnp.int32),
+                        firsts, last + 1, lane_keys,
+                        self._cur_tok, self._pos, self._keys,
+                    )
+                # warm calls each hold a cache-sized dummy; block so only
+                # ONE is ever in flight (back-to-back dispatch would pile
+                # cache-sized allocations and OOM large configs)
+                out[1].block_until_ready()
+                del dummy, out
+                if self.speculate_tokens > 0:
+                    dslab = self._draft_prefill_fn(
+                        self._draft_params, prompts, last
+                    )
+                    ddummy = {
+                        "k": [jnp.zeros_like(a) for a in self._draft_cache["k"]],
+                        "v": [jnp.zeros_like(a) for a in self._draft_cache["v"]],
+                    }
+                    self._draft_insert_fn(ddummy, dslab, 0)
+        active = jnp.zeros((self.slots,), bool)
+        temps = jnp.zeros((self.slots,), jnp.float32)
+        for attn_len in attn_lens:
+            if self._spec_burst_fn is not None:
+                dummy = self._make_cache()
+                ddummy = {
+                    "k": [jnp.zeros_like(a) for a in self._draft_cache["k"]],
+                    "v": [jnp.zeros_like(a) for a in self._draft_cache["v"]],
+                }
+                caches = {
+                    "k": dummy["k"], "v": dummy["v"],
+                    "dk": ddummy["k"], "dv": ddummy["v"],
+                }
+                # greedy variant only: temperature lanes compile their own
+                # (rare) variant on first use
+                out = self._spec_burst_fn(
+                    self.params, self._draft_params, caches,
+                    self._cur_tok, self._pos, active, temps,
+                    self._keys, k, attn_len, False,
+                )
+                out[0].block_until_ready()
+                del caches, dummy, ddummy, out
+            else:
+                dummy = self._make_cache()
+                out = self._burst_fn(
+                    self.params, dummy, self._cur_tok, self._pos,
+                    active, temps, self._keys, k, attn_len,
+                )
+                out[0].block_until_ready()
+                del dummy, out
+
     def close(self) -> None:
         self._stop.set()
         if self._thread is not None:
@@ -600,20 +794,56 @@ class ContinuousBatcher:
         self._masks_dirty = True
         self.stats["admitted"] += 1
 
-    def _finish(self, slot: int) -> None:
-        # a trailing eos token is kept in the output, like HF generate
-        s = self._active.pop(slot)
-        self._pos_host.pop(slot, None)
+    def _admit_many(self, slots: List[int], reqs: List[GenRequest], bucket: int) -> None:
+        """Admit m same-bucket requests with ONE batched prefill forward +
+        ONE batched insert (see prefill_many). Only used without
+        speculation — the draft cache path stays per-request."""
+        import jax.numpy as jnp
+
+        m = len(reqs)
+        prompts = np.zeros((m, bucket), np.int32)
+        last = np.zeros((m,), np.int32)
+        seeds = np.zeros((m,), np.int32)
+        temps = np.zeros((m,), np.float32)
+        for i, req in enumerate(reqs):
+            n = len(req.tokens)
+            prompts[i, :n] = req.tokens
+            last[i] = n - 1
+            seeds[i] = req.seed
+            temps[i] = req.temperature
+        firsts, slab, lane_keys = self._prefill_many_fn(
+            self.params, jnp.asarray(prompts), jnp.asarray(last),
+            jnp.asarray(seeds), jnp.asarray(temps),
+        )
+        self._cache, self._cur_tok, self._pos, self._keys = self._insert_many_fn(
+            self._cache, slab, jnp.asarray(np.asarray(slots, np.int32)),
+            firsts, jnp.asarray(last + 1), lane_keys,
+            self._cur_tok, self._pos, self._keys,
+        )
+        for slot, req in zip(slots, reqs):
+            self._active[slot] = _Slot(request=req)
+            self._pos_host[slot] = len(req.tokens)
         self._masks_dirty = True
+        self.stats["admitted"] += m
+
+    def _resolve(self, s: _Slot) -> None:
+        # a trailing eos token is kept in the output, like HF generate.
         # `finished` counts requests that ran to completion; `cancelled`
         # counts abandonments (queued or mid-decode) — disjoint, so
         # finished + cancelled = all requests ever resolved
+        s.credit_done = True
         if s.request.future.cancelled():
             self.stats["cancelled"] += 1
             return
         if not s.request.future.done():
             s.request.future.set_result(s.request.tokens + s.emitted)
         self.stats["finished"] += 1
+
+    def _finish(self, slot: int) -> None:
+        s = self._active.pop(slot)
+        self._pos_host.pop(slot, None)
+        self._masks_dirty = True
+        self._resolve(s)
 
     def _check_done(self) -> None:
         for slot in list(self._active):
@@ -652,14 +882,22 @@ class ContinuousBatcher:
 
     def _process_burst(self, toks_dev, snapshot) -> None:
         """Credit one burst's tokens to the requests that occupied each lane
-        AT DISPATCH TIME. A lane whose request already finished (and was
-        possibly re-admitted) mid-pipeline is skipped via identity check —
-        its rows are overshoot decode, dropped by design."""
+        AT DISPATCH TIME. Bursts execute on the device stream in dispatch
+        order and any re-admission insert is dispatched after them, so the
+        snapshot occupant is always the request the rows belong to — even
+        when the lane was pre-freed and re-admitted before this read. A
+        request whose output is already complete (``credit_done``) is
+        skipped: its remaining rows are overshoot decode, dropped by
+        design."""
         host_toks = np.asarray(toks_dev)  # the burst's one host sync
         for slot, (s, start) in snapshot.items():
-            if self._active.get(slot) is not s:
+            if s.credit_done:
                 continue
-            self._credit(s, host_toks[start:, slot])
+            if self._credit(s, host_toks[start:, slot]):
+                if self._active.get(slot) is s:
+                    self._finish(slot)
+                else:
+                    self._resolve(s)  # lane was pre-freed at dispatch time
         self._check_done()
 
     def _process_spec_burst(self, start_tok_dev, toks_dev, counts_dev, snapshot, k) -> None:
@@ -701,8 +939,11 @@ class ContinuousBatcher:
         pending: "collections.deque" = collections.deque()
         try:
             while not self._stop.is_set():
-                # admit as many queued requests as there are free slots
-                while len(self._active) < self.slots:
+                # admit as many queued requests as there are free slots —
+                # same-bucket admissions are grouped so m lanes share one
+                # batched prefill forward (pow2 chunks bound executables)
+                wave: List[GenRequest] = []
+                while len(self._active) + len(wave) < self.slots:
                     try:
                         req = self._queue.get_nowait()
                     except queue.Empty:
@@ -710,13 +951,39 @@ class ContinuousBatcher:
                     if req.future.cancelled():
                         self.stats["cancelled"] += 1
                         continue  # caller gave up while queued
-                    free = next(i for i in range(self.slots) if i not in self._active)
-                    try:
-                        self._admit(free, req)
-                    except Exception as e:  # noqa: BLE001 - bad request
-                        logger.exception("admit failed")
-                        if not req.future.done():
-                            req.future.set_exception(e)
+                    wave.append(req)
+                if wave:
+                    free_iter = iter(
+                        i for i in range(self.slots) if i not in self._active
+                    )
+                    by_bucket: Dict[int, List[GenRequest]] = {}
+                    for req in wave:
+                        by_bucket.setdefault(
+                            self._bucket(len(req.tokens)), []
+                        ).append(req)
+                    for bucket, reqs in by_bucket.items():
+                        while reqs:
+                            # exactly one batched variant (m=4) exists per
+                            # bucket — remainders of 1-3 go through the
+                            # single-admission path rather than compiling
+                            # more executables
+                            m = (
+                                4
+                                if self.speculate_tokens == 0 and len(reqs) >= 4
+                                else 1
+                            )
+                            chunk, reqs = reqs[:m], reqs[m:]
+                            slots_ = [next(free_iter) for _ in chunk]
+                            try:
+                                if m == 1:
+                                    self._admit(slots_[0], chunk[0])
+                                else:
+                                    self._admit_many(slots_, chunk, bucket)
+                            except Exception as e:  # noqa: BLE001 - bad request
+                                logger.exception("admit failed")
+                                for req in chunk:
+                                    if not req.future.done():
+                                        req.future.set_exception(e)
                 if not self._active and not pending:
                     try:
                         req = self._queue.get(timeout=0.05)
@@ -744,15 +1011,13 @@ class ContinuousBatcher:
                     active_dev = self._active_dev
                     temps_dev = self._temps_dev
                     # one fused burst of k steps = ONE device call + ONE host
-                    # sync. k is FIXED at steps_per_poll (one compiled variant):
-                    # lanes that hit max_new_tokens or eos mid-burst simply have
-                    # their overshoot tokens dropped by _process_burst —
-                    # clamping k to the tightest remaining budget (the previous
-                    # design) made staggered requests force tiny bursts on every
+                    # sync. k is FIXED (one compiled variant): lanes that hit
+                    # max_new_tokens or eos mid-burst simply have their
+                    # overshoot tokens dropped by _process_burst — clamping k
+                    # to the tightest remaining budget (the previous design)
+                    # made staggered requests force tiny bursts on every
                     # lane, paying the sync RTT per token near each completion
-                    k = max(1, self.steps_per_poll)
-                    while k & (k - 1):  # pow2 guard for odd configs
-                        k &= k - 1
+                    k = self._k
                     # per-burst worst-case position advance (spec rounds can
                     # emit up to gamma+1 tokens each)
                     adv = k * (self.speculate_tokens + 1 if self._spec_burst_fn else 1)
@@ -760,13 +1025,16 @@ class ContinuousBatcher:
                     # covers every active lane's end-of-burst position
                     # (host-tracked, no sync). One executable per bucket.
                     hi = max(self._pos_host[i] for i in self._active) + adv
-                    attn_len = min(self.max_seq, -(-hi // 128) * 128)
+                    ab = self.attn_bucket
+                    attn_len = min(self.max_seq, -(-hi // ab) * ab)
                     # snapshot BEFORE dispatch: tokens of this burst belong to
                     # these occupants, whatever the host learns later
                     snapshot = {}
                     for slot, s in self._active.items():
-                        snapshot[slot] = (s, 0 if s.first_pending else 1)
+                        first = s.first_pending
+                        snapshot[slot] = (s, 0 if first else 1)
                         s.first_pending = False
+                        s.dispatched += k + (1 if first else 0)
                         self._pos_host[slot] += adv
                     if self._spec_burst_fn is not None:
                         caches = {
@@ -807,11 +1075,46 @@ class ContinuousBatcher:
                         except AttributeError:  # non-jax array (test doubles)
                             pass
                         pending.append(("plain", (toks, snapshot)))
-                # read the oldest burst once the pipeline is full — or drain
-                # fully when there is nothing left to dispatch
-                while pending and (
-                    len(pending) >= self.pipeline_depth or not self._active
-                ):
+                        # PREDICTIVE FREE: a lane whose eos-less budget is
+                        # now fully covered by dispatched bursts is done —
+                        # the host needn't observe the tokens to know it.
+                        # Freeing it here (instead of pipeline_depth bursts
+                        # later) lets the next admission's prefill+insert
+                        # queue behind the in-flight bursts, so the lane
+                        # decodes a NEW request the very next burst rather
+                        # than burning steps on overshoot. (Spec mode keeps
+                        # the observed path: its per-round advance is
+                        # data-dependent, so completion isn't predictable.)
+                        freed = [
+                            slot
+                            for slot, s in self._active.items()
+                            if s.request.eos_id is None
+                            and s.dispatched >= s.request.max_new_tokens
+                        ]
+                        for slot in freed:
+                            self._active.pop(slot)
+                            self._pos_host.pop(slot, None)
+                        if freed:
+                            self._masks_dirty = True
+                # read bursts oldest-first: always when the pipeline is full
+                # (or nothing is left to dispatch) — and OPPORTUNISTICALLY
+                # when a burst's token copy has already landed on the host
+                # (is_ready -> np.asarray won't block). Eager reads shrink
+                # the completion-observation lag for eos/temperature lanes
+                # without ever stalling dispatch.
+                while pending:
+                    if not (len(pending) >= self.pipeline_depth or not self._active):
+                        # last-initiated transfer of the oldest burst: counts
+                        # for spec (start_tok/toks/counts copy in order),
+                        # toks for plain — if IT landed, np.asarray of the
+                        # earlier arrays won't block either
+                        head_mode, head_payload = pending[0]
+                        head = head_payload[2 if head_mode == "spec" else 0]
+                        try:
+                            if not head.is_ready():
+                                break
+                        except AttributeError:
+                            pass  # non-jax array (test doubles): treat as ready
                     mode, payload = pending.popleft()
                     if mode == "spec":
                         self._process_spec_burst(*payload)
@@ -827,5 +1130,12 @@ class ContinuousBatcher:
                 s = self._active.pop(slot)
                 if not s.request.future.done():
                     s.request.future.set_exception(err)
+            # pre-freed lanes live only in pending-burst snapshots now —
+            # without this sweep their callers would block forever
+            for _mode, payload in pending:
+                snap = payload[3] if _mode == "spec" else payload[1]
+                for s, _start in snap.values():
+                    if not s.request.future.done():
+                        s.request.future.set_exception(err)
             self._drain_queue(err)
             raise
