@@ -45,6 +45,7 @@
 #include <arpa/inet.h>
 #include <cerrno>
 #include <fcntl.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
@@ -66,6 +67,7 @@
 #include <deque>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <random>
 #include <string>
 #include <thread>
@@ -556,6 +558,46 @@ static void set_io_timeouts(int fd, int ms) {
   setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
 }
 
+// IPv4 literal fast path, then getaddrinfo so in-cluster DNS service
+// names (the reference's normal addressing mode) resolve too. Successful
+// lookups are cached: cluster ClusterIPs are stable for a Service's
+// lifetime, and the gRPC front calls this on its single-threaded event
+// loop where a per-request synchronous DNS query would head-of-line
+// block every in-flight stream (only the FIRST request per host pays).
+static bool resolve_ipv4(const std::string& host, in_addr* out) {
+  const char* h = host == "localhost" ? "127.0.0.1" : host.c_str();
+  if (inet_pton(AF_INET, h, out) == 1) return true;
+  // failures are cached too (5 s) or a misconfigured host would pay the
+  // blocking resolver timeout on EVERY request instead of once per window
+  static std::mutex mu;
+  static std::map<std::string, in_addr> cache;
+  static std::map<std::string, std::chrono::steady_clock::time_point> neg;
+  {
+    std::lock_guard<std::mutex> lk(mu);
+    auto it = cache.find(host);
+    if (it != cache.end()) { *out = it->second; return true; }
+    auto nit = neg.find(host);
+    if (nit != neg.end()) {
+      if (std::chrono::steady_clock::now() < nit->second) return false;
+      neg.erase(nit);
+    }
+  }
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(h, nullptr, &hints, &res) != 0 || !res) {
+    std::lock_guard<std::mutex> lk(mu);
+    neg[host] = std::chrono::steady_clock::now() + std::chrono::seconds(5);
+    return false;
+  }
+  *out = ((sockaddr_in*)res->ai_addr)->sin_addr;
+  freeaddrinfo(res);
+  std::lock_guard<std::mutex> lk(mu);
+  cache[host] = *out;
+  return true;
+}
+
 static int connect_to(const std::string& host, int port, int timeout_ms) {
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
@@ -565,7 +607,7 @@ static int connect_to(const std::string& host, int port, int timeout_ms) {
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(port);
-  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) { close(fd); return -1; }
+  if (!resolve_ipv4(host, &addr.sin_addr)) { close(fd); return -1; }
   // bounded connect: non-blocking + poll, then back to blocking-with-deadline
   fcntl(fd, F_SETFL, O_NONBLOCK);
   int rc = connect(fd, (sockaddr*)&addr, sizeof addr);

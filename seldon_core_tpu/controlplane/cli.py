@@ -219,6 +219,16 @@ def main(argv=None) -> None:
         ctl = KubeController(api, namespace=ns, resync_s=args.resync_s)
         if args.once:
             ctl.install_crd()
+            # unconditional: even a pre-existing CRD (e.g. created by a
+            # racing replica or a prior run that exited early) may not be
+            # Established yet, and the immediate list below would crash
+            # the whole one-shot pass. Cheap when already serving: the
+            # first successful list returns.
+            if not ctl.wait_crd_established():
+                print(json.dumps(
+                    {"failed": 1, "error": "CRD not established in time"}
+                ))
+                raise SystemExit(1)
             ops = ctl.reconcile_all()
             print(json.dumps(ops))
             raise SystemExit(1 if ops.get("failed") else 0)

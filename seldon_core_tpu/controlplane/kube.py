@@ -316,6 +316,26 @@ class KubeController:
         logger.info("installed CRD %s", CRD_MANIFEST["metadata"]["name"])
         return True
 
+    def wait_crd_established(self, timeout_s: float = 10.0) -> bool:
+        """Block until the CRD serves list requests (Established).
+
+        Right after install_crd() a real apiserver needs a beat before the
+        seldondeployments endpoint exists; a one-shot pass that lists
+        immediately would crash on KubeApiError (the daemon loop tolerates
+        this via catch-and-resync). Polls the list endpoint itself — the
+        exact capability the next step needs — rather than parsing
+        status.conditions, so it also works against minimal fake servers.
+        """
+        deadline = time.time() + timeout_s
+        while True:
+            try:
+                self._list_crs()
+                return True
+            except KubeApiError:
+                if time.time() >= deadline:
+                    return False
+                time.sleep(0.2)
+
     # -- one reconcile pass --------------------------------------------------
 
     def _list_crs(self) -> List[Dict[str, Any]]:

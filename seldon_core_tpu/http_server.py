@@ -108,7 +108,10 @@ class Request:
             head, sep, payload = chunk.partition(b"\r\n\r\n")
             if not sep:
                 continue  # malformed part (no header/body separator)
-            nm = re.search(rb'name="([^"]+)"', head)
+            # require a preceding separator so `filename="..."` can never
+            # satisfy the match when it appears before `name=` (RFC 7578
+            # fixes no parameter order) — mirrors the native engine's parser
+            nm = re.search(rb'(?:^|[;\s])name="([^"]+)"', head)
             if nm:
                 parts[nm.group(1).decode("latin-1")] = payload
         if "json" in parts:  # a whole SeldonMessage as one part

@@ -237,6 +237,7 @@ class IngestConsumer:
         self.stats = {"scored": 0, "dead_lettered": 0, "replayed": 0}
         self._client = None
         self._prior_ids: set = set()
+        self._out_f = None
 
     def stop(self) -> None:
         self._stop.set()
@@ -271,8 +272,16 @@ class IngestConsumer:
             # at-least-once working as designed, surfaced for operators
             self.stats["replayed"] += 1
         row = {"id": rid, "offset": offset, "response": response}
-        with open(self.out_path, "a", encoding="utf-8") as f:
-            f.write(json.dumps(row, separators=(",", ":")) + "\n")
+        if self._out_f is None:
+            self._out_f = open(self.out_path, "a", encoding="utf-8")
+        self._out_f.write(json.dumps(row, separators=(",", ":")) + "\n")
+
+    def _sync_results(self) -> None:
+        # results must be durable BEFORE the commit offset advances past
+        # them, or a crash loses sunk rows the replay will never re-score
+        if self._out_f is not None:
+            self._out_f.flush()
+            os.fsync(self._out_f.fileno())
 
     def _dead_letter(self, offset: int, record: Dict[str, Any], error: str) -> None:
         self.stats["dead_lettered"] += 1
@@ -311,6 +320,7 @@ class IngestConsumer:
                 new += 1
             if new != commit:
                 commit = new
+                self._sync_results()
                 self.broker.commit(self.group, commit)
 
         try:
@@ -345,6 +355,10 @@ class IngestConsumer:
                 await asyncio.gather(*inflight, return_exceptions=True)
             advance_commit()
         finally:
+            if self._out_f is not None:
+                self._sync_results()
+                self._out_f.close()
+                self._out_f = None
             if self._client is not None:
                 await self._client.close()
                 self._client = None

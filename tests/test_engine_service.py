@@ -432,6 +432,33 @@ def test_multipart_form_predictions(rest_client):
     assert out["meta"]["puid"] == "mp-1"
 
 
+def test_multipart_filename_before_name():
+    """RFC 7578 fixes no parameter order: when filename= precedes name=,
+    the part must still be stored under name= (a bare `name="` search would
+    match inside filename= and mis-file the part)."""
+    app = make_app()
+    boundary = "XbOuNdArYx"
+    body = (
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; filename="not-the-field.bin"; name="data"\r\n'
+        "Content-Type: application/json\r\n\r\n"
+        '{"ndarray": [[1.0, 2.0]]}\r\n'
+        f"--{boundary}--\r\n"
+    ).encode()
+    import asyncio as _a
+
+    from seldon_core_tpu.http_server import Request
+
+    req = Request(
+        "POST", "/api/v0.1/predictions", "",
+        {"content-type": f"multipart/form-data; boundary={boundary}"}, body,
+    )
+    resp = _a.run(app.rest_app()._dispatch(req))
+    assert resp.status == 200, resp.body
+    out = json.loads(resp.body)
+    assert out["data"]["ndarray"] == [[0.9, 0.05, 0.05]]
+
+
 def test_multipart_whole_message_part(rest_client):
     app = make_app()
     boundary = "bb"
