@@ -160,6 +160,43 @@ def main() -> None:
     }
     if os.environ.get("BENCH_MODELS", "1") != "0":
         result["model_tier"] = run_model_tier(repo)
+    # the front headlines live in an ARTIFACT, not just this process's
+    # stdout tail: the driver keeps only the tail of long captures, and
+    # round 4's most-quoted number (native gRPC req/s) survived nowhere
+    # but prose. Same publish guard as the model tier: only a full
+    # benchmark-host capture (model tier ran, on TPU, not tiny) may
+    # overwrite the published headline numbers. captured_at stamps both
+    # blocks so gen_arch_numbers can prove same-capture provenance.
+    mt = result.get("model_tier") or {}
+    publishable = (
+        mt.get("device", {}).get("platform") == "tpu"
+        and not mt.get("tiny")
+        and "error" not in mt
+    )
+    if publishable:
+        try:
+            import time as _time
+
+            stamp = _time.time()
+            path = os.path.join(repo, "BASELINE.json")
+            with open(path) as f:
+                baseline = json.load(f)
+            if isinstance(baseline.get("published"), dict):
+                baseline["published"]["captured_at"] = stamp
+            baseline["published_fronts"] = {
+                "captured_at": stamp,
+                "stub_rest": {
+                    "value": result["value"], "unit": "req/s",
+                    "vs_baseline": result["vs_baseline"],
+                    "p50_ms": result["p50_ms"], "p99_ms": result["p99_ms"],
+                },
+                "binary_front": result["binary_front"],
+                "grpc_front": result["grpc_front"],
+            }
+            with open(path, "w") as f:
+                json.dump(baseline, f, indent=2)
+        except Exception as e:  # noqa: BLE001 - publishing never kills the run
+            result["front_publish_error"] = str(e)
     print(json.dumps(result))
 
 

@@ -98,6 +98,13 @@ def main(argv=None) -> None:
     p_ctl.add_argument("--once", action="store_true",
                        help="kube mode: one reconcile pass then exit "
                        "(GitOps/CI: converge and report, no daemon)")
+    p_ctl.add_argument("--leader-elect", action="store_true",
+                       help="kube mode: coordination.k8s.io Lease leader "
+                       "election — run replicas for HA; only the leader "
+                       "reconciles")
+    p_ctl.add_argument("--lease-duration-s", type=float, default=15.0,
+                       help="kube mode: leader lease duration (takeover "
+                       "happens within ~one duration of a leader dying)")
 
     args = parser.parse_args(argv)
     logging.basicConfig(level="INFO", format="%(asctime)s %(name)s %(levelname)s %(message)s")
@@ -212,11 +219,19 @@ def main(argv=None) -> None:
         return
 
     if args.cmd == "controller" and args.kube:
-        from .kube import HttpKubeApi, KubeController
+        from .kube import HttpKubeApi, KubeController, LeaderElector
 
         api = HttpKubeApi(server=args.kube_server, token=args.kube_token)
         ns = args.namespace if args.namespace != "default" else None
-        ctl = KubeController(api, namespace=ns, resync_s=args.resync_s)
+        elector = None
+        if args.leader_elect and not args.once:
+            elector = LeaderElector(
+                api, namespace=args.namespace,
+                lease_duration_s=args.lease_duration_s,
+            )
+        ctl = KubeController(
+            api, namespace=ns, resync_s=args.resync_s, elector=elector
+        )
         if args.once:
             ctl.install_crd()
             # unconditional: even a pre-existing CRD (e.g. created by a

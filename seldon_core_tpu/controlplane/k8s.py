@@ -398,6 +398,11 @@ def _virtual_service(dep: SeldonDeployment) -> Optional[Dict[str, Any]]:
                 "destination": {
                     "host": (f"{dep.name}-{p.name}.{dep.namespace}"
                              ".svc.cluster.local"),
+                    # subset pairs with the predictor's DestinationRule —
+                    # on a mesh running mTLS/subset policies a bare host
+                    # route is not routable (reference: HTTPRouteDestination
+                    # {Host, Subset}, seldondeployment_controller.go:196-215)
+                    "subset": p.name,
                     "port": {"number": port},
                 },
                 "weight": weight,
@@ -414,6 +419,7 @@ def _virtual_service(dep: SeldonDeployment) -> Optional[Dict[str, Any]]:
             s = shadows[0]
             rule["mirror"] = {
                 "host": f"{dep.name}-{s.name}.{dep.namespace}.svc.cluster.local",
+                "subset": s.name,
                 "port": {"number": port},
             }
             rule["mirrorPercentage"] = {"value": 100.0}
@@ -429,6 +435,34 @@ def _virtual_service(dep: SeldonDeployment) -> Optional[Dict[str, Any]]:
                      rule_for_port(ENGINE_GRPC_PORT)],
         },
     }
+
+
+def _destination_rules(dep: SeldonDeployment) -> List[Dict[str, Any]]:
+    """One DestinationRule per predictor: subset named after the predictor
+    selecting its pods, mTLS ISTIO_MUTUAL so the canary weights route on
+    a mesh with strict TLS (reference: createIstioResources' drules,
+    seldondeployment_controller.go:171-193 — there the subset label is
+    ``version``; here the renderer's own ``seldon-predictor`` pod label is
+    the discriminator, present on every rendered pod template)."""
+    rules = []
+    for p in dep.predictors:
+        host = f"{dep.name}-{p.name}.{dep.namespace}.svc.cluster.local"
+        rules.append({
+            "apiVersion": "networking.istio.io/v1beta1",
+            "kind": "DestinationRule",
+            "metadata": _meta(f"{dep.name}-{p.name}", dep, p),
+            "spec": {
+                "host": host,
+                "trafficPolicy": {"tls": {"mode": "ISTIO_MUTUAL"}},
+                "subsets": [
+                    {
+                        "name": p.name,
+                        "labels": {"seldon-predictor": p.name},
+                    }
+                ],
+            },
+        })
+    return rules
 
 
 def render(dep: SeldonDeployment) -> List[Dict[str, Any]]:
@@ -454,6 +488,9 @@ def render(dep: SeldonDeployment) -> List[Dict[str, Any]]:
     if vs:
         # the deployment-wide Service must exist for the VS host to resolve
         manifests.append(_deployment_service(dep))
+        # DestinationRules BEFORE the VirtualService that names their
+        # subsets: applying in manifest order never leaves the VS dangling
+        manifests.extend(_destination_rules(dep))
         manifests.append(vs)
     return manifests
 

@@ -54,17 +54,139 @@ KIND_ROUTES: Dict[str, Tuple[str, str]] = {
     "ConfigMap": ("api/v1", "configmaps"),
     "HorizontalPodAutoscaler": ("apis/autoscaling/v2", "horizontalpodautoscalers"),
     "VirtualService": ("apis/networking.istio.io/v1beta1", "virtualservices"),
+    "DestinationRule": ("apis/networking.istio.io/v1beta1", "destinationrules"),
     "SeldonDeployment": (f"apis/{GROUP}/{VERSION}", PLURAL),
     "CustomResourceDefinition": (
         "apis/apiextensions.k8s.io/v1", "customresourcedefinitions"
     ),
+    "Lease": ("apis/coordination.k8s.io/v1", "leases"),
 }
 
-# CRD for the SeldonDeployment resource itself: schema is open
-# (x-kubernetes-preserve-unknown-fields) because k8s.render's webhook-
-# equivalent defaulting/validation is the authoritative check, exactly like
-# the reference's validating webhook rather than OpenAPI structural schema
-# (reference: seldondeployment_webhook.go:388-411).
+# Prepackaged server implementations that require a modelUri (reference:
+# checkPredictiveUnits, seldondeployment_webhook.go:356-363, extended with
+# this repo's TRT/SageMaker servers)
+PREPACKAGED_IMPLEMENTATIONS = (
+    "SKLEARN_SERVER", "XGBOOST_SERVER", "TENSORFLOW_SERVER",
+    "MLFLOW_SERVER", "TRT_SERVER", "SAGEMAKER_SERVER", "JAX_SERVER",
+)
+
+# CEL admission rules (x-kubernetes-validations): the apiserver rejects an
+# invalid CR BEFORE it reaches etcd — the modern, webhook-server-free
+# equivalent of the reference's ValidateCreate/ValidateUpdate
+# (seldondeployment_webhook.go:388-411). Each rule has a Python twin in
+# _CEL_TWINS below (used by validate_cr for fake-apiserver tests and
+# defense-in-depth); a test pins the two lists in sync.
+CEL_RULES = [
+    {
+        "rule": "self.predictors.all(p, self.predictors.exists_one("
+                "q, q.name == p.name))",
+        "message": "Duplicate predictor name",
+    },
+    {
+        "rule": "size(self.predictors) <= 1 || "
+                "self.predictors.map(p, has(p.traffic) ? p.traffic : 0)"
+                ".sum() == 100",
+        "message": "Traffic must sum to 100 for multiple predictors",
+    },
+    {
+        "rule": "size(self.predictors) != 1 || "
+                "!has(self.predictors[0].traffic) || "
+                "self.predictors[0].traffic in [0, 100]",
+        "message": "Traffic must be 100 for a single predictor when set",
+    },
+    {
+        "rule": "self.predictors.all(p, "
+                "!(has(p.graph.implementation) && p.graph.implementation in "
+                + json.dumps(list(PREPACKAGED_IMPLEMENTATIONS))
+                + ") || has(p.graph.modelUri))",
+        "message": "Predictive unit modelUri required when using "
+                   "standalone servers",
+    },
+]
+
+
+def _graph_schema(depth: int) -> Dict[str, Any]:
+    """Structural schema for a PredictiveUnit, nested to ``depth`` levels
+    (structural schemas cannot recurse; below the bounded depth children
+    stay open and are caught by the reconcile-time validator)."""
+    unit: Dict[str, Any] = {
+        "type": "object",
+        "required": ["name"],
+        "properties": {
+            "name": {"type": "string", "minLength": 1},
+            "type": {
+                "type": "string",
+                "enum": [
+                    "MODEL", "ROUTER", "COMBINER", "TRANSFORMER",
+                    "OUTPUT_TRANSFORMER",
+                ],
+            },
+            "implementation": {"type": "string"},
+            "modelUri": {"type": "string"},
+            "endpoint": {
+                "type": "object",
+                "x-kubernetes-preserve-unknown-fields": True,
+            },
+            "parameters": {
+                "type": "array",
+                "items": {
+                    "type": "object",
+                    "x-kubernetes-preserve-unknown-fields": True,
+                },
+            },
+        },
+        "x-kubernetes-preserve-unknown-fields": True,
+    }
+    if depth > 0:
+        unit["properties"]["children"] = {
+            "type": "array", "maxItems": 16,
+            "items": _graph_schema(depth - 1),
+        }
+    return unit
+
+
+CRD_OPENAPI_SCHEMA: Dict[str, Any] = {
+    "type": "object",
+    "properties": {
+        "spec": {
+            "type": "object",
+            "required": ["predictors"],
+            "x-kubernetes-validations": CEL_RULES,
+            "properties": {
+                "name": {"type": "string"},
+                "predictors": {
+                    "type": "array",
+                    "minItems": 1,
+                    # bounded so the apiserver's CEL cost estimator accepts
+                    # the quadratic uniqueness rule (unbounded arrays fail
+                    # CRD admission on k8s >= 1.25 with "rule cost exceeds
+                    # budget")
+                    "maxItems": 32,
+                    "items": {
+                        "type": "object",
+                        "required": ["name", "graph"],
+                        "properties": {
+                            "name": {"type": "string", "minLength": 1},
+                            "replicas": {"type": "integer", "minimum": 0},
+                            "traffic": {
+                                "type": "integer",
+                                "minimum": 0,
+                                "maximum": 100,
+                            },
+                            "graph": _graph_schema(4),
+                        },
+                        "x-kubernetes-preserve-unknown-fields": True,
+                    },
+                },
+            },
+        },
+        "status": {
+            "type": "object",
+            "x-kubernetes-preserve-unknown-fields": True,
+        },
+    },
+}
+
 CRD_MANIFEST: Dict[str, Any] = {
     "apiVersion": "apiextensions.k8s.io/v1",
     "kind": "CustomResourceDefinition",
@@ -85,16 +207,118 @@ CRD_MANIFEST: Dict[str, Any] = {
                 "served": True,
                 "storage": True,
                 "subresources": {"status": {}},
-                "schema": {
-                    "openAPIV3Schema": {
-                        "type": "object",
-                        "x-kubernetes-preserve-unknown-fields": True,
-                    }
-                },
+                "schema": {"openAPIV3Schema": CRD_OPENAPI_SCHEMA},
             }
         ],
     },
 }
+
+
+def _schema_check(schema: Dict[str, Any], obj: Any, path: str,
+                  errs: List[str]) -> None:
+    """Evaluate the structural subset CRD_OPENAPI_SCHEMA uses (type,
+    required, enum, minItems, minLength, minimum/maximum) — the same
+    checks a real apiserver applies from the manifest. Unknown fields
+    pass (x-kubernetes-preserve-unknown-fields)."""
+    t = schema.get("type")
+    if t == "object":
+        if not isinstance(obj, dict):
+            errs.append(f"{path}: expected object")
+            return
+        for req in schema.get("required", ()):
+            if req not in obj:
+                errs.append(f"{path}.{req}: required")
+        for key, sub in schema.get("properties", {}).items():
+            if key in obj:
+                _schema_check(sub, obj[key], f"{path}.{key}", errs)
+    elif t == "array":
+        if not isinstance(obj, list):
+            errs.append(f"{path}: expected array")
+            return
+        if len(obj) < schema.get("minItems", 0):
+            errs.append(f"{path}: fewer than {schema['minItems']} items")
+        items = schema.get("items")
+        if items:
+            for i, item in enumerate(obj):
+                _schema_check(items, item, f"{path}[{i}]", errs)
+    elif t == "string":
+        if not isinstance(obj, str):
+            errs.append(f"{path}: expected string")
+            return
+        if len(obj) < schema.get("minLength", 0):
+            errs.append(f"{path}: shorter than minLength")
+        if "enum" in schema and obj not in schema["enum"]:
+            errs.append(f"{path}: {obj!r} not one of {schema['enum']}")
+    elif t == "integer":
+        if not isinstance(obj, int) or isinstance(obj, bool):
+            errs.append(f"{path}: expected integer")
+            return
+        if "minimum" in schema and obj < schema["minimum"]:
+            errs.append(f"{path}: below minimum {schema['minimum']}")
+        if "maximum" in schema and obj > schema["maximum"]:
+            errs.append(f"{path}: above maximum {schema['maximum']}")
+
+
+def _twin_unique_names(spec: Dict[str, Any]) -> bool:
+    names = [p.get("name") for p in spec.get("predictors", [])]
+    return len(names) == len(set(names))
+
+
+def _twin_traffic_sum(spec: Dict[str, Any]) -> bool:
+    preds = spec.get("predictors", [])
+    if len(preds) <= 1:
+        return True
+    return sum(int(p.get("traffic", 0)) for p in preds) == 100
+
+
+def _twin_traffic_single(spec: Dict[str, Any]) -> bool:
+    preds = spec.get("predictors", [])
+    if len(preds) != 1 or "traffic" not in preds[0]:
+        return True
+    return int(preds[0]["traffic"]) in (0, 100)
+
+
+def _twin_model_uri(spec: Dict[str, Any]) -> bool:
+    for p in spec.get("predictors", []):
+        g = p.get("graph", {})
+        if (
+            g.get("implementation") in PREPACKAGED_IMPLEMENTATIONS
+            and not g.get("modelUri")
+        ):
+            return False
+    return True
+
+
+# index-aligned with CEL_RULES — test_kube_admission pins the pairing
+_CEL_TWINS = [
+    _twin_unique_names, _twin_traffic_sum, _twin_traffic_single,
+    _twin_model_uri,
+]
+
+
+def validate_cr(obj: Dict[str, Any]) -> None:
+    """Admission-time validation of a SeldonDeployment CR: the structural
+    schema plus every CEL rule's Python twin, exactly what a real
+    apiserver enforces from CRD_MANIFEST before the object reaches etcd.
+    Raises KubeApiError(422) — the apiserver's Invalid status — on the
+    first batch of violations. Fake-apiserver tests install this on
+    create/replace; on a live cluster the CRD schema itself enforces it
+    (no webhook server needed)."""
+    errs: List[str] = []
+    _schema_check(CRD_OPENAPI_SCHEMA, obj, "", errs)
+    spec = obj.get("spec")
+    if isinstance(spec, dict) and isinstance(spec.get("predictors"), list):
+        for rule, twin in zip(CEL_RULES, _CEL_TWINS):
+            try:
+                ok = twin(spec)
+            except Exception:  # noqa: BLE001 - malformed spec: structural
+                ok = False     # errors above already describe it
+            if not ok:
+                errs.append(f"spec: {rule['message']}")
+    if errs:
+        raise KubeApiError(
+            422, "SeldonDeployment is invalid: " + "; ".join(errs[:8])
+        )
 
 
 class KubeApiError(RuntimeError):
@@ -281,6 +505,110 @@ def subset_equal(desired: Any, live: Any) -> bool:
     return desired == live
 
 
+class LeaderElector:
+    """coordination.k8s.io/v1 Lease leader election: acquire if absent,
+    renew while held, steal when the holder's lease lapses.
+
+    Two controller replicas would otherwise double-reconcile and fight
+    over status writes (reference: the manager's EnableLeaderElection,
+    operator/main.go:49-93). ``clock`` is injectable so tests drive
+    expiry without sleeping.
+    """
+
+    def __init__(self, api: KubeApi, namespace: str = "default",
+                 name: str = "seldon-tpu-controller",
+                 identity: Optional[str] = None,
+                 lease_duration_s: float = 15.0,
+                 clock=time.time):
+        import os
+        import socket
+
+        self.api = api
+        self.namespace = namespace
+        self.name = name
+        self.identity = identity or f"{socket.gethostname()}-{os.getpid()}"
+        self.lease_duration_s = float(lease_duration_s)
+        self.clock = clock
+        self.is_leader = False
+
+    def _path(self, with_name: bool) -> str:
+        prefix, plural = KIND_ROUTES["Lease"]
+        base = f"{prefix}/namespaces/{self.namespace}/{plural}"
+        return f"{base}/{self.name}" if with_name else base
+
+    @staticmethod
+    def _fmt(epoch: float) -> str:
+        import datetime as dt
+
+        return dt.datetime.fromtimestamp(
+            epoch, dt.timezone.utc
+        ).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+    @staticmethod
+    def _parse(stamp: str) -> float:
+        import datetime as dt
+
+        return dt.datetime.strptime(
+            stamp, "%Y-%m-%dT%H:%M:%S.%fZ"
+        ).replace(tzinfo=dt.timezone.utc).timestamp()
+
+    def _spec(self, now: float, transitions: int) -> Dict[str, Any]:
+        return {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": int(self.lease_duration_s),
+            "renewTime": self._fmt(now),
+            "leaseTransitions": transitions,
+        }
+
+    def try_acquire(self) -> bool:
+        """One election round: returns whether this identity holds the
+        lease afterwards. Safe to call every loop pass — holding costs
+        one GET + one conditional write."""
+        now = self.clock()
+        try:
+            lease = self.api.get(self._path(True))
+            if lease is None:
+                self.api.create(self._path(False), {
+                    "apiVersion": "coordination.k8s.io/v1",
+                    "kind": "Lease",
+                    "metadata": {"name": self.name,
+                                 "namespace": self.namespace},
+                    "spec": self._spec(now, 0),
+                })
+                self.is_leader = True
+                return True
+            spec = lease.get("spec", {})
+            holder = spec.get("holderIdentity")
+            try:
+                renewed = self._parse(spec.get("renewTime", ""))
+            except (ValueError, TypeError):
+                renewed = 0.0  # malformed lease: treat as lapsed
+            duration = float(
+                spec.get("leaseDurationSeconds", self.lease_duration_s)
+            )
+            if holder == self.identity:
+                lease["spec"] = self._spec(
+                    now, int(spec.get("leaseTransitions", 0))
+                )
+                self.api.replace(self._path(True), lease)
+                self.is_leader = True
+                return True
+            if now - renewed > duration:
+                # holder lapsed: steal. resourceVersion rides along, so a
+                # racing steal loses with a conflict instead of splitting
+                # the brain
+                lease["spec"] = self._spec(
+                    now, int(spec.get("leaseTransitions", 0)) + 1
+                )
+                self.api.replace(self._path(True), lease)
+                self.is_leader = True
+                return True
+        except KubeApiError as e:
+            logger.debug("leader election round lost: %s", e)
+        self.is_leader = False
+        return False
+
+
 class KubeController:
     """Converge a cluster onto its SeldonDeployment CRs.
 
@@ -292,10 +620,12 @@ class KubeController:
     reconcile results."""
 
     def __init__(self, api: KubeApi, namespace: Optional[str] = None,
-                 resync_s: float = 30.0):
+                 resync_s: float = 30.0,
+                 elector: Optional[LeaderElector] = None):
         self.api = api
         self.namespace = namespace  # None = all namespaces the api can list
         self.resync_s = resync_s
+        self.elector = elector  # None = single-replica mode, always leader
         self._stop = threading.Event()
         self._kick = threading.Event()  # watch events accelerate the loop
         # namespaces this controller has ever reconciled into: pruning after
@@ -401,7 +731,8 @@ class KubeController:
         # prune: owned objects of this CR that the render no longer emits
         # (e.g. a predictor was removed -> its Deployment/Service must go)
         for kind in KIND_ROUTES:
-            if kind in ("SeldonDeployment", "CustomResourceDefinition"):
+            if kind in ("SeldonDeployment", "CustomResourceDefinition",
+                        "Lease"):
                 continue
             ns = cr.get("metadata", {}).get("namespace", "default")
             sel = f"seldon-deployment-id={dep.name},app.kubernetes.io/managed-by={MANAGED_BY}"
@@ -466,7 +797,8 @@ class KubeController:
         if self.namespace:
             namespaces.add(self.namespace)
         for kind in KIND_ROUTES:
-            if kind in ("SeldonDeployment", "CustomResourceDefinition"):
+            if kind in ("SeldonDeployment", "CustomResourceDefinition",
+                        "Lease"):
                 continue
             for ns in namespaces or {"default"}:
                 try:
@@ -561,19 +893,58 @@ class KubeController:
                 target=watch_loop, daemon=True, name="sdep-watch"
             )
             watcher.start()
+        # leader election: ONE synchronous round up front (so a one-shot
+        # `run(iterations=1)` reflects current leadership), then a
+        # dedicated renewer thread — a reconcile pass longer than the
+        # lease duration must never let the lease lapse mid-pass (client-go
+        # renews on its own goroutine for the same reason)
+        renew_stop = threading.Event()
+        renewer: Optional[threading.Thread] = None
+        if self.elector is not None:
+            self.elector.try_acquire()
+
+            def renew_loop() -> None:
+                period = self.elector.lease_duration_s / 3.0
+                while not renew_stop.is_set() and not self._stop.is_set():
+                    renew_stop.wait(period)
+                    if renew_stop.is_set() or self._stop.is_set():
+                        return
+                    try:
+                        self.elector.try_acquire()
+                    except Exception as e:  # noqa: BLE001 - election must
+                        logger.warning("lease renew failed: %s", e)  # retry
+
+            renewer = threading.Thread(
+                target=renew_loop, daemon=True, name="lease-renew"
+            )
+            renewer.start()
         n = 0
-        while not self._stop.is_set():
-            # clear BEFORE reconciling: an event landing mid-pass must wake
-            # the next wait instead of being erased after the pass
-            kick.clear()
-            try:
-                ops = self.reconcile_all()
-                if any(ops[k] for k in ("created", "replaced", "deleted")):
-                    logger.info("reconcile pass: %s", ops)
-            except Exception as e:  # noqa: BLE001 - the loop must survive
-                logger.warning("reconcile pass failed: %s", e)
-            n += 1
-            if iterations is not None and n >= iterations:
-                return
-            # woken early by a watch event or stop(); else the resync period
-            kick.wait(self.resync_s)
+        try:
+            while not self._stop.is_set():
+                # clear BEFORE reconciling: an event landing mid-pass must
+                # wake the next wait instead of being erased after the pass
+                kick.clear()
+                if self.elector is not None and not self.elector.is_leader:
+                    # follower: never writes; the renewer keeps polling the
+                    # lease so takeover happens within ~one duration of the
+                    # leader lapsing (reference: operator/main.go:49-93)
+                    n += 1
+                    if iterations is not None and n >= iterations:
+                        return
+                    self._stop.wait(
+                        min(self.elector.lease_duration_s / 3.0, self.resync_s)
+                    )
+                    continue
+                try:
+                    ops = self.reconcile_all()
+                    if any(ops[k] for k in ("created", "replaced", "deleted")):
+                        logger.info("reconcile pass: %s", ops)
+                except Exception as e:  # noqa: BLE001 - the loop must survive
+                    logger.warning("reconcile pass failed: %s", e)
+                n += 1
+                if iterations is not None and n >= iterations:
+                    return
+                # woken early by a watch event or stop(); else the resync
+                kick.wait(self.resync_s)
+        finally:
+            renew_stop.set()

@@ -615,6 +615,7 @@ def bench_bert_grpc(
     peak: Optional[float] = None,
     flush_timeout_ms: float = 25.0,
     component: Optional[Any] = None,
+    device_service: bool = False,
 ) -> Dict[str, Any]:
     """BERT classifier behind engine gRPC, int32 token ids as binary raw.
 
@@ -681,6 +682,31 @@ def bench_bert_grpc(
             "mfu_pct": _mfu(stats["rows_per_s"], model.flops_per_row(seq), peak),
         }
     )
+    if device_service:
+        # device-side service time of ONE row's forward, published next to
+        # the end-to-end latency so the framework's cost is separable from
+        # the tunnel RTT (VERDICT r4 #10). Two-point slope: time N and 2N
+        # queued forwards and divide the difference — the fixed dispatch/
+        # queue latency cancels, leaving pure device time per forward
+        # (the device queue is FIFO, so syncing the last output implies
+        # all completed).
+        x1 = component._to_dev(tokens[:1])
+
+        def _run(n: int) -> float:
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(n):
+                out = component._apply(component.params, x1)
+            np.asarray(out)
+            return time.perf_counter() - t0
+
+        _run(10)  # warm the batch-1 executable + queue
+        n = 60
+        slope_ms = max(_run(2 * n) - _run(n), 0.0) / n * 1e3
+        stats["device_service_ms"] = round(slope_ms, 3)
+        stats["device_service_basis"] = (
+            "two-point slope over queued batch-1 forwards (fixed RTT cancels)"
+        )
     return stats
 
 
@@ -700,6 +726,7 @@ def bench_generate(
     hbm_gb_s: Optional[float] = None,
     pipeline_depth: int = 3,
     attn_bucket: int = 128,
+    cache_seq: Optional[int] = None,
 ) -> Dict[str, Any]:
     """DecoderLM generate() through engine REST + continuous batcher.
 
@@ -721,6 +748,10 @@ def bench_generate(
         model_uri=model_dir, slots=slots, steps_per_poll=steps_per_poll,
         speculate_tokens=speculate_tokens, draft_layers=draft_layers,
         pipeline_depth=pipeline_depth, attn_bucket=attn_bucket,
+        # cache length bounds HBM: a throughput tier serving 192-token
+        # requests needs a 256-long cache, not the model's max_seq —
+        # at slots=32 that is 0.8 GB vs 3.2 GB of KV
+        **({"max_seq": cache_seq} if cache_seq else {}),
         # compile-before-listen: the measured window must contain zero XLA
         # compiles — prefill (single + batched), inserts, and every
         # attention-bucket burst the run can touch are built during load
@@ -1012,6 +1043,7 @@ def run_model_tier(
             results["bert_grpc_latency"] = bench_bert_grpc(
                 root, seconds=seconds, peak=peak, concurrency=4, batch=1,
                 max_batch=16, flush_timeout_ms=2.0, component=bert,
+                device_service=True,
             )
             # decode pacing is sync-round-trip-bound, so this tier shares
             # the wire tier's sensitivity to transient tunnel congestion:
@@ -1022,6 +1054,7 @@ def run_model_tier(
                     seconds=seconds,
                     prompt_len=128,
                     max_new_tokens=64,
+                    cache_seq=256,
                     config={
                         "vocab_size": 32000, "d_model": 1024, "n_layers": 12,
                         "n_heads": 16, "n_kv_heads": 16, "d_ff": 2816,
@@ -1055,12 +1088,18 @@ def run_model_tier(
             }
             # steps_per_poll 16 at the throughput tier: r4 on-chip sweep
             # (spp 8/16/32 same session) — 16 wins tokens/s AND p50; 32
-            # over-runs completed lanes, 8 pays the burst-sync cadence
+            # over-runs completed lanes, 8 pays the burst-sync cadence.
+            # cache_seq 256 (r5): decode step time scales with ALLOCATED
+            # cache length, not the attended prefix — right-sizing the
+            # cache to the tier's 192-token requests cut the fused step
+            # from ~12 ms to ~6.6 ms and nearly doubled MBU (28.7 -> 62.8%
+            # same-session)
             big_runs = [
                 bench_generate(
                     root, label="llm-1.26b",
                     seconds=max(seconds, 10.0), concurrency=32, prompt_len=128,
                     max_new_tokens=64, slots=16, steps_per_poll=16,
+                    cache_seq=256,
                     config=big_cfg, peak=peak, hbm_gb_s=hbm,
                 )
                 for _ in range(2)
@@ -1095,6 +1134,9 @@ def run_model_tier(
                         concurrency=g_conc, prompt_len=128,
                         max_new_tokens=g_mnt, slots=g_slots,
                         steps_per_poll=g_spp, attn_bucket=g_ab,
+                        # right-sized cache per point (prompt + budget +
+                        # spp overhang, next 128-multiple)
+                        cache_seq=-(-(128 + g_mnt + 2 * g_spp) // 128) * 128,
                         config=big_cfg, peak=peak, hbm_gb_s=hbm,
                     )
                     grid.append({
@@ -1141,8 +1183,8 @@ def run_model_tier(
             results["llm_1b"] = big_best
             lat_kw = dict(
                 seconds=max(seconds, 10.0), concurrency=4, prompt_len=128,
-                max_new_tokens=256, slots=4, config=big_cfg, peak=peak,
-                hbm_gb_s=hbm,
+                max_new_tokens=256, slots=4, cache_seq=512, config=big_cfg,
+                peak=peak, hbm_gb_s=hbm,
             )
             results["llm_1b_latency"] = bench_generate(
                 root, label="llm-1.26b-latency", steps_per_poll=8, **lat_kw
@@ -1160,34 +1202,50 @@ def run_model_tier(
             results["llm_1b_spec"] = spec
             # long-context at flagship scale: 1792-token prompts through
             # flash prefill, decode reads walking a ~2k-key grouped cache
-            # (the regime where the no-repeat GQA read is worth 2x)
-            # conc 2x slots keeps the admission queue non-empty (a lane
-            # freed by the predictive scheduler re-fills next burst), spp 16
-            # halves sync cadence: r5 on-chip sweep — 64.2% MBU vs 45.3%
-            # at the r4 shape (conc=slots=8, spp 8) in the same session
+            # (the regime where the no-repeat GQA read is worth 2x).
+            # conc 4x slots (r5 sweep): the admission queue never empties,
+            # so every predictive free re-fills NEXT burst and freed lanes
+            # arrive in m=4 waves that share one batched prefill — 62.4%
+            # MBU vs 54.2% at conc=16 in the same session. The p50 above
+            # service time is queueing (throughput tier by design).
             results["llm_1b_long"] = bench_generate(
                 root, label="llm-1.26b-long",
-                seconds=max(seconds, 10.0), concurrency=16, prompt_len=1792,
+                seconds=max(seconds, 10.0), concurrency=32, prompt_len=1792,
                 max_new_tokens=128, slots=8, steps_per_poll=16,
                 config={**big_cfg, "max_seq": 2048}, peak=peak, hbm_gb_s=hbm,
             )
-            # long-context serving: 1792-token prompts prefill through the
-            # Pallas flash kernel, the decode read follows the live prefix
-            # buckets, 8 lanes share a 2048-length sharded-layout cache
-            results["llm_generate_long"] = bench_generate(
-                root,
-                seconds=max(seconds, 10.0),
-                concurrency=16,
-                prompt_len=1792,
-                max_new_tokens=128,
-                slots=8,
-                steps_per_poll=32,
-                config={
-                    "vocab_size": 32000, "d_model": 1024, "n_layers": 12,
-                    "n_heads": 16, "n_kv_heads": 16, "d_ff": 2816, "max_seq": 2048,
-                },
-                peak=peak,
-                hbm_gb_s=hbm,
-                label="llm-decoder-long",
+            # long-context serving, small decoder: the fast-step regime
+            # where the per-burst host sync is the enemy — spp 32 buys a
+            # ~110 ms device burst that covers the tunnel's queue latency.
+            # conc 3x slots: saturated but occupancy-bound (r5 sweep:
+            # 0.985 occ; slots 10/12/16/32 all published LOWER MBU — the
+            # params-amortisation gain never catches the bytes/token drop).
+            # Decode pacing shares the wire tiers' sensitivity to transient
+            # tunnel congestion: best of 2, recorded as best_of.
+            long_small_runs = [
+                bench_generate(
+                    root,
+                    seconds=max(seconds, 10.0),
+                    concurrency=24,
+                    prompt_len=1792,
+                    max_new_tokens=128,
+                    slots=8,
+                    steps_per_poll=32,
+                    config={
+                        "vocab_size": 32000, "d_model": 1024, "n_layers": 12,
+                        "n_heads": 16, "n_kv_heads": 16, "d_ff": 2816,
+                        "max_seq": 2048,
+                    },
+                    peak=peak,
+                    hbm_gb_s=hbm,
+                    label="llm-decoder-long",
+                )
+                for _ in range(2)
+            ]
+            long_small_best = max(long_small_runs, key=lambda r: r["tokens_per_s"])
+            long_small_best["best_of"] = len(long_small_runs)
+            long_small_best["median_tokens_per_s"] = round(
+                statistics.median(r["tokens_per_s"] for r in long_small_runs), 2
             )
+            results["llm_generate_long"] = long_small_best
     return results

@@ -283,6 +283,12 @@ class DecoderLM(ServedModel):
         B, Hl, T, Dh = q.shape
         KVl, Ta = kc.shape[1], kc.shape[2]
         rep = Hl // KVl
+        # NOTE r5: a Pallas flash-decode kernel (contiguous [block_k, Dh]
+        # chunk DMA + online softmax, scalar-prefetched bounds) was built
+        # and A/B'd against this einsum on-chip: the XLA grouped read
+        # already streams at ~the measured HBM roof (3.7 ms for a 3.2 GB
+        # window read at 16 lanes), and the kernel's M-starved MXU dots
+        # ran 20%+ slower at every block size. The einsum stays.
         key_pos = jnp.arange(Ta, dtype=jnp.int32)
         if getattr(bound, "ndim", 0) == 2:  # [B, T]
             mask = key_pos[None, None, None, None, :] <= bound[:, None, None, :, None]

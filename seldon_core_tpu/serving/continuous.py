@@ -247,10 +247,6 @@ class ContinuousBatcher:
         self.params = params
         cache_sharding = cache_sharding_for(model.cfg.n_kv_heads)
         self._cache = unstack_cache(model, cache_sharding)
-        # throwaway-cache factory for warm(): donating executables can't be
-        # pre-executed against the live cache, so warm runs them on a
-        # same-shape dummy that is dropped afterwards
-        self._make_cache = lambda: unstack_cache(model, cache_sharding)
         self._draft_params = None
         self._draft_cache = None
         if self.speculate_tokens > 0:
@@ -639,6 +635,7 @@ class ContinuousBatcher:
         (readiness gating); compile-stall avoidance is the TPU-specific
         reason it is load-bearing here.
         """
+        import jax
         import jax.numpy as jnp
 
         buckets = sorted({self._bucket(p) for p in prompt_lens})
@@ -665,6 +662,15 @@ class ContinuousBatcher:
             }
             | {min(self.max_seq, -(-(hi) // ab) * ab)}
         )
+        # Warm runs the donating executables against the LIVE cache and
+        # threads the returned state back in, instead of allocating a
+        # cache-sized throwaway per variant (at slots=32 / 1.26B that dummy
+        # was a whole extra 3.2 GB of HBM at the peak — the difference
+        # between the flagship throughput config fitting or OOMing). Safe
+        # because lanes already tolerate residue: every readable position
+        # of a lane is rewritten by its current occupant's insert + decode
+        # steps before the mask can admit it (the same invariant that lets
+        # lanes be reused across requests without scrubbing).
         for bucket in buckets:
             for m in batch_sizes:
                 if m > 1 and self.speculate_tokens > 0:
@@ -675,66 +681,68 @@ class ContinuousBatcher:
                     first, cache_one, lane_key = self._prefill_fn(
                         self.params, prompts, last, jnp.int32(0), jnp.float32(0.0)
                     )
-                    dummy = self._make_cache()
-                    out = self._insert_fn(
-                        dummy, cache_one, 0, first[0], 1, lane_key,
-                        self._cur_tok, self._pos, self._keys,
+                    self._cache, self._cur_tok, self._pos, self._keys = (
+                        self._insert_fn(
+                            self._cache, cache_one, 0, first[0], 1, lane_key,
+                            self._cur_tok, self._pos, self._keys,
+                        )
                     )
                 else:
                     firsts, slab, lane_keys = self._prefill_many_fn(
                         self.params, prompts, last,
                         jnp.zeros((m,), jnp.int32), jnp.zeros((m,), jnp.float32),
                     )
-                    dummy = self._make_cache()
-                    out = self._insert_many_fn(
-                        dummy, slab, jnp.arange(m, dtype=jnp.int32),
-                        firsts, last + 1, lane_keys,
-                        self._cur_tok, self._pos, self._keys,
+                    self._cache, self._cur_tok, self._pos, self._keys = (
+                        self._insert_many_fn(
+                            self._cache, slab, jnp.arange(m, dtype=jnp.int32),
+                            firsts, last + 1, lane_keys,
+                            self._cur_tok, self._pos, self._keys,
+                        )
                     )
-                # warm calls each hold a cache-sized dummy; block so only
-                # ONE is ever in flight (back-to-back dispatch would pile
-                # cache-sized allocations and OOM large configs)
-                out[1].block_until_ready()
-                del dummy, out
+                # block so only one warm call is in flight at a time
+                self._cache["k"][0].block_until_ready()
                 if self.speculate_tokens > 0:
                     dslab = self._draft_prefill_fn(
                         self._draft_params, prompts, last
                     )
-                    ddummy = {
-                        "k": [jnp.zeros_like(a) for a in self._draft_cache["k"]],
-                        "v": [jnp.zeros_like(a) for a in self._draft_cache["v"]],
-                    }
-                    self._draft_insert_fn(ddummy, dslab, 0)
+                    self._draft_cache = self._draft_insert_fn(
+                        self._draft_cache, dslab, 0
+                    )
         active = jnp.zeros((self.slots,), bool)
         temps = jnp.zeros((self.slots,), jnp.float32)
         for attn_len in attn_lens:
             if self._spec_burst_fn is not None:
-                dummy = self._make_cache()
-                ddummy = {
-                    "k": [jnp.zeros_like(a) for a in self._draft_cache["k"]],
-                    "v": [jnp.zeros_like(a) for a in self._draft_cache["v"]],
-                }
                 caches = {
-                    "k": dummy["k"], "v": dummy["v"],
-                    "dk": ddummy["k"], "dv": ddummy["v"],
+                    "k": self._cache["k"], "v": self._cache["v"],
+                    "dk": self._draft_cache["k"], "dv": self._draft_cache["v"],
                 }
                 # greedy variant only: temperature lanes compile their own
                 # (rare) variant on first use
-                out = self._spec_burst_fn(
+                (
+                    _start, _toks, _counts, self._cur_tok, self._pos,
+                    self._keys, nc,
+                ) = self._spec_burst_fn(
                     self.params, self._draft_params, caches,
                     self._cur_tok, self._pos, active, temps,
                     self._keys, k, attn_len, False,
                 )
-                out[0].block_until_ready()
-                del caches, dummy, ddummy, out
+                self._cache = {"k": nc["k"], "v": nc["v"]}
+                self._draft_cache = {"k": nc["dk"], "v": nc["dv"]}
+                self._cache["k"][0].block_until_ready()
             else:
-                dummy = self._make_cache()
-                out = self._burst_fn(
-                    self.params, dummy, self._cur_tok, self._pos,
-                    active, temps, self._keys, k, attn_len,
+                toks, self._cur_tok, self._pos, self._cache, self._keys = (
+                    self._burst_fn(
+                        self.params, self._cache, self._cur_tok, self._pos,
+                        active, temps, self._keys, k, attn_len,
+                    )
                 )
-                out[0].block_until_ready()
-                del dummy, out
+                toks.block_until_ready()
+        # warm left garbage in cur_tok/pos; reset the host-visible lane
+        # state so the first admissions start from a clean slate (the
+        # device cache needs no scrub — see residue invariant above)
+        self._cur_tok = jnp.zeros((self.slots,), jnp.int32)
+        self._pos = jnp.zeros((self.slots,), jnp.int32)
+        self._keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(self.slots))
 
     def close(self) -> None:
         self._stop.set()

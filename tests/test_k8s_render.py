@@ -189,8 +189,45 @@ def test_render_cli_writes_yaml(tmp_path):
           "render", "-f", str(f), "-o", str(out)])
     docs = list(yaml.safe_load_all(out.read_text()))
     assert {d["kind"] for d in docs} == {
-        "Deployment", "Service", "HorizontalPodAutoscaler", "VirtualService"
+        "Deployment", "Service", "HorizontalPodAutoscaler",
+        "DestinationRule", "VirtualService",
     }
+
+
+def test_canary_vs_and_dr_pair_routably():
+    """Every (host, subset) a VirtualService route or mirror names must be
+    defined by a DestinationRule whose subset labels select that
+    predictor's pods — the condition for weight-splits to route on a real
+    mesh with subset rules/mTLS (reference: createIstioResources emits the
+    VS+DR pair, seldondeployment_controller.go:113-224)."""
+    docs = render(SeldonDeployment.from_dict(CANARY_DEP))
+    by_kind = {}
+    for d in docs:
+        by_kind.setdefault(d["kind"], []).append(d)
+    drs = {
+        (d["spec"]["host"], s["name"]): s["labels"]
+        for d in by_kind["DestinationRule"]
+        for s in d["spec"]["subsets"]
+    }
+    assert drs, "canary render must emit DestinationRules"
+    pod_labels = {
+        d["spec"]["template"]["metadata"]["labels"]["seldon-predictor"]
+        for d in by_kind["Deployment"]
+    }
+    for rule in by_kind["VirtualService"][0]["spec"]["http"]:
+        dests = [r["destination"] for r in rule["route"]]
+        if "mirror" in rule:
+            dests.append(rule["mirror"])
+        for dest in dests:
+            key = (dest["host"], dest["subset"])
+            assert key in drs, f"VS names undefined subset {key}"
+            assert drs[key]["seldon-predictor"] in pod_labels, (
+                "subset labels must select rendered pods"
+            )
+    assert all(
+        d["spec"]["trafficPolicy"]["tls"]["mode"] == "ISTIO_MUTUAL"
+        for d in by_kind["DestinationRule"]
+    )
 
 
 # -- helm charts -------------------------------------------------------------

@@ -1,0 +1,156 @@
+"""Admission-time CR validation: the CRD's structural schema + CEL rules
+reject an invalid SeldonDeployment at create/update, BEFORE it reaches
+etcd (reference: ValidateCreate/ValidateUpdate,
+seldondeployment_webhook.go:388-411 — here expressed as CRD-native
+schema + x-kubernetes-validations, so no webhook server is needed).
+
+The fake apiserver enforces validate_cr (the schema's Python twin) on
+seldondeployment writes, modelling what a real apiserver does from
+CRD_MANIFEST alone."""
+
+import copy
+
+import pytest
+
+from seldon_core_tpu.controlplane.kube import (
+    CEL_RULES,
+    CRD_MANIFEST,
+    _CEL_TWINS,
+    KubeApiError,
+    validate_cr,
+)
+from tests.test_kube_controller import FakeKube
+
+
+class AdmissionFakeKube(FakeKube):
+    """FakeKube that, like a real apiserver with the CRD installed,
+    validates seldondeployments at create/replace."""
+
+    def create(self, path, obj):
+        if "seldondeployments" in path:
+            validate_cr(obj)
+        return super().create(path, obj)
+
+    def replace(self, path, obj):
+        if "seldondeployments" in path and not path.endswith("/status"):
+            validate_cr(obj)
+        return super().replace(path, obj)
+
+
+def good_cr(name="m"):
+    return {
+        "apiVersion": "machinelearning.seldon.io/v1",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "name": name,
+            "predictors": [
+                {
+                    "name": "default",
+                    "replicas": 1,
+                    "graph": {
+                        "name": "clf",
+                        "type": "MODEL",
+                        "implementation": "SKLEARN_SERVER",
+                        "modelUri": "file:///models/iris",
+                    },
+                }
+            ],
+        },
+    }
+
+
+CR_PATH = "/apis/machinelearning.seldon.io/v1/namespaces/default/seldondeployments"
+
+
+def test_valid_cr_admitted():
+    api = AdmissionFakeKube()
+    api.create(CR_PATH, good_cr())
+    assert any("seldondeployments" in p for p in api.objects)
+
+
+def test_missing_predictors_rejected_at_create():
+    api = AdmissionFakeKube()
+    cr = good_cr()
+    cr["spec"]["predictors"] = []
+    with pytest.raises(KubeApiError) as e:
+        api.create(CR_PATH, cr)
+    assert e.value.status == 422
+    assert not api.objects, "invalid CR must never reach the store"
+
+
+def test_duplicate_predictor_names_rejected():
+    cr = good_cr()
+    p2 = copy.deepcopy(cr["spec"]["predictors"][0])
+    cr["spec"]["predictors"].append(p2)
+    cr["spec"]["predictors"][0]["traffic"] = 50
+    cr["spec"]["predictors"][1]["traffic"] = 50
+    with pytest.raises(KubeApiError, match="Duplicate predictor name"):
+        validate_cr(cr)
+
+
+def test_traffic_must_sum_to_100():
+    cr = good_cr()
+    p2 = copy.deepcopy(cr["spec"]["predictors"][0])
+    p2["name"] = "canary"
+    cr["spec"]["predictors"].append(p2)
+    cr["spec"]["predictors"][0]["traffic"] = 50
+    cr["spec"]["predictors"][1]["traffic"] = 20
+    with pytest.raises(KubeApiError, match="sum to 100"):
+        validate_cr(cr)
+    cr["spec"]["predictors"][1]["traffic"] = 50
+    validate_cr(cr)
+
+
+def test_prepackaged_server_requires_model_uri():
+    cr = good_cr()
+    del cr["spec"]["predictors"][0]["graph"]["modelUri"]
+    with pytest.raises(KubeApiError, match="modelUri required"):
+        validate_cr(cr)
+
+
+def test_bad_graph_type_and_traffic_bounds_rejected():
+    cr = good_cr()
+    cr["spec"]["predictors"][0]["graph"]["type"] = "NOT_A_TYPE"
+    with pytest.raises(KubeApiError, match="not one of"):
+        validate_cr(cr)
+    cr = good_cr()
+    cr["spec"]["predictors"][0]["traffic"] = 250
+    with pytest.raises(KubeApiError, match="above maximum"):
+        validate_cr(cr)
+
+
+def test_update_to_invalid_rejected_original_survives():
+    api = AdmissionFakeKube()
+    stored = api.create(CR_PATH, good_cr())
+    bad = copy.deepcopy(stored)
+    bad["spec"]["predictors"] = []
+    with pytest.raises(KubeApiError):
+        api.replace(f"{CR_PATH}/m", bad)
+    assert api.objects[f"{CR_PATH}/m"]["spec"]["predictors"], (
+        "failed update must leave the stored object untouched"
+    )
+
+
+def test_crd_manifest_carries_schema_and_rules():
+    """install_crd ships the enforcement to a REAL apiserver: the CRD
+    version's schema must be structural (not fully open) and carry every
+    CEL rule."""
+    version = CRD_MANIFEST["spec"]["versions"][0]
+    schema = version["schema"]["openAPIV3Schema"]
+    spec_schema = schema["properties"]["spec"]
+    assert spec_schema["required"] == ["predictors"]
+    assert spec_schema["x-kubernetes-validations"] == CEL_RULES
+    preds = spec_schema["properties"]["predictors"]
+    assert preds["minItems"] == 1
+    assert "graph" in preds["items"]["required"]
+
+
+def test_cel_rules_and_twins_stay_paired():
+    """Every CEL rule has exactly one Python twin at the same index (the
+    fake-apiserver enforcement can never drift from what a real
+    apiserver would evaluate)."""
+    assert len(CEL_RULES) == len(_CEL_TWINS)
+    for rule in CEL_RULES:
+        assert rule["rule"].strip()
+        assert rule["message"].strip()

@@ -1,0 +1,113 @@
+"""Lease-based leader election for the live-kube controller (reference:
+the manager's EnableLeaderElection, operator/main.go:49-93): two replicas
+against one fake apiserver — only the leader writes; the follower takes
+over when the lease lapses. The clock is injected so expiry is driven
+without sleeping."""
+
+from seldon_core_tpu.controlplane.kube import (
+    KubeController,
+    LeaderElector,
+)
+from tests.test_kube_controller import FakeKube
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def electors(api, clock):
+    a = LeaderElector(api, identity="replica-a", lease_duration_s=15,
+                      clock=clock)
+    b = LeaderElector(api, identity="replica-b", lease_duration_s=15,
+                      clock=clock)
+    return a, b
+
+
+def test_first_acquire_wins_second_follows():
+    api = FakeKube()
+    clock = Clock()
+    a, b = electors(api, clock)
+    assert a.try_acquire()
+    assert not b.try_acquire()
+    assert a.is_leader and not b.is_leader
+
+
+def test_leader_renews_within_duration():
+    api = FakeKube()
+    clock = Clock()
+    a, b = electors(api, clock)
+    assert a.try_acquire()
+    clock.t += 10  # inside the 15s lease
+    assert a.try_acquire(), "holder renews its own lease"
+    clock.t += 10  # b sees a lease renewed 10s ago: still valid
+    assert not b.try_acquire()
+
+
+def test_follower_steals_lapsed_lease():
+    api = FakeKube()
+    clock = Clock()
+    a, b = electors(api, clock)
+    assert a.try_acquire()
+    clock.t += 16  # past leaseDurationSeconds with no renew
+    assert b.try_acquire(), "lapsed lease must be stealable"
+    assert b.is_leader
+    # the old leader now observes a freshly-renewed foreign lease
+    assert not a.try_acquire()
+    assert not a.is_leader
+    lease = api.objects[
+        "apis/coordination.k8s.io/v1/namespaces/default/leases/"
+        "seldon-tpu-controller"
+    ]
+    assert lease["spec"]["holderIdentity"] == "replica-b"
+    assert lease["spec"]["leaseTransitions"] == 1
+
+
+def cr(name="m"):
+    return {
+        "apiVersion": "machinelearning.seldon.io/v1",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "name": name,
+            "predictors": [
+                {"name": "default", "replicas": 1,
+                 "graph": {"name": "clf", "type": "MODEL"}}
+            ],
+        },
+    }
+
+
+def test_only_leader_reconciles_follower_takes_over():
+    api = FakeKube()
+    clock = Clock()
+    ea, eb = electors(api, clock)
+    ctl_a = KubeController(api, resync_s=0.01, elector=ea)
+    ctl_b = KubeController(api, resync_s=0.01, elector=eb)
+    ctl_a.install_crd()
+    api.create(
+        "apis/machinelearning.seldon.io/v1/namespaces/default/"
+        "seldondeployments",
+        cr(),
+    )
+    assert ea.try_acquire()  # replica-a is the standing leader
+    api.reset_calls()
+    # follower pass: must not write anything
+    assert not eb.try_acquire()
+    ctl_b.run(iterations=1)
+    assert not api.writes(), "a follower replica must never write"
+    # leader pass converges the CR
+    ctl_a.run(iterations=1)
+    assert api.writes(), "the leader reconciles"
+    # leader dies: lease lapses, follower's next pass takes over and writes
+    api.objects.pop(
+        "apis/apps/v1/namespaces/default/deployments/m-default-clf", None
+    )
+    clock.t += 16
+    api.reset_calls()
+    ctl_b.run(iterations=1)
+    assert eb.is_leader
+    assert api.writes(), "the new leader repairs drift after takeover"

@@ -88,6 +88,49 @@ def rows_from(bench):
     else:
         payload = bench
     mt = payload.get("model_tier", {})
+    # Fallback (VERDICT r4 #4/#5): tail recovery can lose tiers the driver
+    # truncated away. BASELINE.json["published"] is the SAME capture
+    # (bench.py writes it in-run), so any tier missing from the tail is
+    # taken from there; the front headlines likewise ride in
+    # "published_fronts". The table can never drop tiers again.
+    try:
+        with open(os.path.join(ROOT, "BASELINE.json")) as f:
+            baseline = json.load(f)
+    except Exception:
+        baseline = {}
+    published = baseline.get("published") or {}
+    fronts = baseline.get("published_fronts") or {}
+    backfilled = []
+    if isinstance(mt, dict):
+        for key, tier in published.items():
+            if (
+                key not in ("device", "captured_at")
+                and isinstance(tier, dict)
+                and not mt.get(key)
+            ):
+                mt[key] = tier
+                backfilled.append(key)
+    for key in ("binary_front", "grpc_front"):
+        if not payload.get(key) and fronts.get(key):
+            payload[key] = fronts[key]
+            backfilled.append(key)
+    if payload.get("value") is None and fronts.get("stub_rest"):
+        payload["value"] = fronts["stub_rest"].get("value")
+        payload.setdefault("vs_baseline", fronts["stub_rest"].get("vs_baseline"))
+        backfilled.append("stub_rest")
+    if backfilled:
+        # provenance note rides with the table: same capture when bench.py
+        # stamped published + published_fronts in the run that produced the
+        # BENCH file, otherwise the note names the splice
+        same = published.get("captured_at") == fronts.get("captured_at")
+        payload["_backfill_note"] = (
+            f"{len(backfilled)} entr{'y' if len(backfilled) == 1 else 'ies'} "
+            f"({', '.join(sorted(backfilled))}) recovered from "
+            "BASELINE.json published"
+            + (" (same capture)" if same else
+               " (NOTE: published/published_fronts carry different "
+               "capture stamps)")
+        )
     rows = []
     if payload.get("value") is not None:
         rows.append((
@@ -191,17 +234,20 @@ def rows_from(bench):
             f"{fmt(g1l.get('tokens_per_s'))} tok/s{mbu}",
             "long context at flagship scale (grouped ~2k-key cache reads)",
         ))
-    return rows
+    return rows, payload.get("_backfill_note")
 
 
 def main():
     path, bench = latest_bench()
-    rows = rows_from(bench)
+    rows, note = rows_from(bench)
     lines = [BEGIN,
              f"*(generated from `{os.path.basename(path)}` — do not edit by hand)*",
              "", "| Tier | Published | Reading |", "|---|---|---|"]
     for tier, published, reading in rows:
         lines.append(f"| {tier} | {published} | {reading} |")
+    if note:
+        lines.append("")
+        lines.append(f"*{note}*")
     lines.append(END)
     block = "\n".join(lines)
     arch = os.path.join(ROOT, "ARCHITECTURE.md")
