@@ -184,15 +184,152 @@ class FileQueue(Broker):
         os.replace(tmp, self._commit_path(group))
 
 
-class KafkaBroker(Broker):  # pragma: no cover - no kafka client in the image
-    """Adapter for a real Kafka cluster (the reference's deployment mode).
-    Import-gated: requires ``confluent_kafka``."""
+class KafkaBroker(Broker):
+    """Adapter for a real Kafka cluster (the reference's deployment mode,
+    kafka/kafka.json:1-25 + helm-charts/seldon-core-kafka): the ``Broker``
+    contract over confluent-kafka's Producer/Consumer API against a
+    single-partition topic (partition 0 — contiguous offsets, matching
+    FileQueue's total order; scale-out shards by running one consumer per
+    topic, not by partitions).
 
-    def __init__(self, *a, **kw):
-        raise ImportError(
-            "confluent_kafka is not available in this image; use FileQueue "
-            "or run the consumer next to a broker with the client installed"
-        )
+    Import-gated optional dependency: constructing without
+    ``confluent_kafka`` installed (and without injected client classes)
+    raises ImportError. The client classes are injectable so the contract
+    tests run the SAME suite as FileQueue against a stub cluster
+    (tests/test_kafka_broker.py) — the adapter code paths exercised there
+    are exactly the deployable ones.
+
+    Deploy wiring::
+
+        python -m seldon_core_tpu.ingest consume \\
+            --kafka broker-0.kafka:9092 --topic seldon-requests \\
+            --engine engine.default.svc:8000 --group scorer --out r.jsonl
+    """
+
+    def __init__(self, topic: str, bootstrap: str = "localhost:9092",
+                 producer_cls=None, consumer_cls=None, tp_cls=None,
+                 poll_timeout_s: float = 1.0):
+        if producer_cls is None or consumer_cls is None or tp_cls is None:
+            try:
+                import confluent_kafka  # type: ignore
+            except ImportError as e:  # pragma: no cover - no client in image
+                raise ImportError(
+                    "confluent_kafka is not available in this image; use "
+                    "FileQueue or run the consumer next to a broker with "
+                    "the client installed"
+                ) from e
+            producer_cls = confluent_kafka.Producer      # pragma: no cover
+            consumer_cls = confluent_kafka.Consumer      # pragma: no cover
+            tp_cls = confluent_kafka.TopicPartition      # pragma: no cover
+        self.topic = topic
+        self.bootstrap = bootstrap
+        self.poll_timeout_s = poll_timeout_s
+        self._tp = tp_cls
+        self._consumer_cls = consumer_cls
+        self._producer = producer_cls({"bootstrap.servers": bootstrap})
+        self._reader = None           # offset-addressed poll() consumer
+        self._reader_next = None      # offset the reader is positioned at
+        self._group_consumers: Dict[str, Any] = {}
+
+    # -- producer side ------------------------------------------------------
+
+    def append(self, record: Dict[str, Any]) -> int:
+        return self.append_many([record])
+
+    def append_many(self, records: List[Dict[str, Any]]) -> int:
+        """Produce the whole batch, then ONE flush — the durability
+        barrier FileQueue gets from fsync, without paying a broker
+        round-trip per record. Returns the FIRST offset of the batch
+        (FileQueue's contract)."""
+        delivered: List[int] = []
+        errors: List[Any] = []
+
+        def on_delivery(err, msg):
+            if err is not None:
+                errors.append(err)
+            else:
+                delivered.append(msg.offset())
+
+        for record in records:
+            self._producer.produce(
+                self.topic,
+                json.dumps(record, separators=(",", ":")).encode("utf-8"),
+                on_delivery=on_delivery,
+            )
+        self._producer.flush()
+        if errors:
+            raise KafkaIngestError(f"produce failed: {errors[0]}")
+        if len(delivered) != len(records):
+            raise KafkaIngestError(
+                f"only {len(delivered)}/{len(records)} produces acknowledged"
+            )
+        return min(delivered)
+
+    # -- consumer side ------------------------------------------------------
+
+    def poll(self, offset: int, max_records: int
+             ) -> List[Tuple[int, Dict[str, Any]]]:
+        if max_records <= 0:
+            return []
+        if self._reader is None:
+            self._reader = self._consumer_cls({
+                "bootstrap.servers": self.bootstrap,
+                # offset-addressed reads: this consumer NEVER commits; the
+                # group consumers own commit state
+                "group.id": "__seldon_tpu_reader__",
+                "enable.auto.commit": False,
+            })
+        if self._reader_next != offset:
+            # position via (re-)assign with an explicit offset: seek()
+            # right after assign() raises "Erroneous state" in real
+            # confluent-kafka (the fetcher hasn't started); assign-with-
+            # offset is always legal
+            self._reader.assign([self._tp(self.topic, 0, offset)])
+            self._reader_next = offset
+        out: List[Tuple[int, Dict[str, Any]]] = []
+        for msg in self._reader.consume(max_records, self.poll_timeout_s):
+            if msg is None or msg.error():
+                continue
+            self._reader_next = msg.offset() + 1
+            try:
+                out.append(
+                    (msg.offset(), json.loads(msg.value().decode("utf-8")))
+                )
+            except (ValueError, UnicodeDecodeError) as e:
+                # surface the record instead of skipping it: a silent skip
+                # leaves an offset HOLE the consumer's contiguous commit
+                # can never cross (it would wedge at this offset forever).
+                # Returned as a marker record, it fails scoring, exhausts
+                # retries, dead-letters, and the commit advances past it.
+                out.append((msg.offset(), {
+                    "id": f"__undecodable-{msg.offset()}",
+                    "__undecodable__": str(e),
+                }))
+        return out
+
+    def _group_consumer(self, group: str):
+        if group not in self._group_consumers:
+            self._group_consumers[group] = self._consumer_cls({
+                "bootstrap.servers": self.bootstrap,
+                "group.id": group,
+                "enable.auto.commit": False,
+            })
+        return self._group_consumers[group]
+
+    def committed(self, group: str) -> int:
+        c = self._group_consumer(group)
+        tps = c.committed([self._tp(self.topic, 0)])
+        off = tps[0].offset if tps else None
+        # confluent uses OFFSET_INVALID (-1001) / -1 for "never committed"
+        return off if off is not None and off >= 0 else 0
+
+    def commit(self, group: str, offset: int) -> None:
+        c = self._group_consumer(group)
+        c.commit(offsets=[self._tp(self.topic, 0, offset)], asynchronous=False)
+
+
+class KafkaIngestError(RuntimeError):
+    """Producer-side delivery failure surfaced synchronously."""
 
 
 class IngestConsumer:
@@ -247,6 +384,12 @@ class IngestConsumer:
     async def _score(self, record: Dict[str, Any]) -> Dict[str, Any]:
         from .graph.client import RestClient
 
+        if "__undecodable__" in record:
+            # broker surfaced a payload it could not decode (see
+            # KafkaBroker.poll): not retryable — straight to dead-letter
+            raise ValueError(
+                f"undecodable broker payload: {record['__undecodable__']}"
+            )
         if self._client is None:
             self._client = RestClient(
                 self.engine_host, self.engine_port,
@@ -323,6 +466,7 @@ class IngestConsumer:
                 self._sync_results()
                 self.broker.commit(self.group, commit)
 
+        empty_polls = 0
         try:
             while not self._stop.is_set():
                 # poll only while in-flight slots are free (backpressure)
@@ -331,6 +475,7 @@ class IngestConsumer:
                     self.broker.poll(next_poll, min(self.poll_batch, max(free, 0)))
                     if free > 0 else []
                 )
+                empty_polls = 0 if batch else empty_polls + 1
                 for off, rec in batch:
                     t = asyncio.ensure_future(handle(off, rec))
                     inflight.add(t)
@@ -341,7 +486,13 @@ class IngestConsumer:
                         await asyncio.wait(
                             list(inflight), return_when=asyncio.FIRST_COMPLETED
                         )
-                    elif drain:
+                    elif drain and empty_polls >= 2:
+                        # TWO consecutive empty polls: against a real
+                        # broker one empty consume() does not mean
+                        # exhausted (fetcher warm-up, transient latency) —
+                        # a single-poll break would drain 0 records and
+                        # report success. FileQueue just pays one extra
+                        # (cheap, synchronous) poll.
                         break
                     else:
                         try:
@@ -390,12 +541,18 @@ def main(argv=None) -> None:
     sub = p.add_subparsers(dest="cmd", required=True)
 
     pe = sub.add_parser("enqueue", help="append records to the queue")
-    pe.add_argument("--queue-dir", required=True)
+    pe.add_argument("--queue-dir", default=None,
+                    help="file-queue directory (required unless --kafka)")
     pe.add_argument("--file", required=True,
                     help="JSONL of records ({'id', 'request'|'data'})")
+    pe.add_argument("--kafka", default=None,
+                    help="bootstrap servers — use a Kafka topic instead of "
+                    "the file queue (needs confluent_kafka)")
+    pe.add_argument("--topic", default="seldon-requests")
 
     pc = sub.add_parser("consume", help="drain the queue through an engine")
-    pc.add_argument("--queue-dir", required=True)
+    pc.add_argument("--queue-dir", default=None,
+                    help="file-queue directory (required unless --kafka)")
     pc.add_argument("--engine", required=True, help="host:port of the engine")
     pc.add_argument("--group", default="default")
     pc.add_argument("--out", default="results.jsonl")
@@ -403,10 +560,19 @@ def main(argv=None) -> None:
     pc.add_argument("--concurrency", type=int, default=8)
     pc.add_argument("--drain", action="store_true",
                     help="exit when the queue is exhausted")
+    pc.add_argument("--kafka", default=None,
+                    help="bootstrap servers — consume a Kafka topic instead "
+                    "of the file queue (needs confluent_kafka)")
+    pc.add_argument("--topic", default="seldon-requests")
 
     args = p.parse_args(argv)
     logging.basicConfig(level="INFO")
-    q = FileQueue(args.queue_dir)
+    if not args.kafka and not args.queue_dir:
+        p.error("--queue-dir is required unless --kafka is given")
+    q: Broker = (
+        KafkaBroker(args.topic, bootstrap=args.kafka)
+        if args.kafka else FileQueue(args.queue_dir)
+    )
     if args.cmd == "enqueue":
         records = []
         with open(args.file, encoding="utf-8") as f:
