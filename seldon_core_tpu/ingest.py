@@ -241,6 +241,13 @@ class KafkaBroker(Broker):
         barrier FileQueue gets from fsync, without paying a broker
         round-trip per record. Returns the FIRST offset of the batch
         (FileQueue's contract)."""
+        if not records:
+            # FileQueue parity: an empty batch returns the end offset
+            # (the offset the next record would get) via the broker's
+            # high watermark
+            c = self._group_consumer("__seldon_tpu_watermark__")
+            _lo, hi = c.get_watermark_offsets(self._tp(self.topic, 0))
+            return int(hi)
         delivered: List[int] = []
         errors: List[Any] = []
 
@@ -475,7 +482,11 @@ class IngestConsumer:
                     self.broker.poll(next_poll, min(self.poll_batch, max(free, 0)))
                     if free > 0 else []
                 )
-                empty_polls = 0 if batch else empty_polls + 1
+                if free > 0:
+                    # only a poll that actually RAN counts toward the
+                    # drain guard — a skipped poll (no free slots) is not
+                    # evidence the queue is empty
+                    empty_polls = 0 if batch else empty_polls + 1
                 for off, rec in batch:
                     t = asyncio.ensure_future(handle(off, rec))
                     inflight.add(t)

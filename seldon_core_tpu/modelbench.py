@@ -24,10 +24,11 @@ import asyncio
 import json
 import os
 import socket
+import statistics
 import tempfile
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -727,6 +728,7 @@ def bench_generate(
     pipeline_depth: int = 3,
     attn_bucket: int = 128,
     cache_seq: Optional[int] = None,
+    runs: int = 1,
 ) -> Dict[str, Any]:
     """DecoderLM generate() through engine REST + continuous batcher.
 
@@ -788,22 +790,36 @@ def bench_generate(
 
         return call
 
-    bstats0: Dict[str, Any] = {}
+    # ``runs`` measure windows over ONE loaded/warmed server (no
+    # per-repeat recompile): decode pacing shares the tunnel's
+    # session-to-session swing, so tiers publish the best window with the
+    # median alongside — same estimator the wire tiers use, at ~1/6 the
+    # wall cost of re-running the whole bench entry
+    windows: List[Tuple[Dict[str, Any], Dict[str, Any]]] = []
     try:
-        stats = closed_loop(
-            make_call, seconds, concurrency, warmup_calls=2,
-            on_window_start=lambda: bstats0.update(component.batcher.stats),
-        )
+        for _ in range(max(1, runs)):
+            bstats0: Dict[str, Any] = {}
+            w = closed_loop(
+                make_call, seconds, concurrency, warmup_calls=2,
+                on_window_start=lambda: bstats0.update(component.batcher.stats),
+            )
+            # window-diff of the scheduler counters: warmup generations ran
+            # nearly solo and would bias occupancy low if counted
+            bw = {
+                key: v - bstats0.get(key, 0)
+                for key, v in component.batcher.stats.items()
+            }
+            windows.append((w, bw))
     finally:
-        # window-diff of the scheduler counters: warmup generations ran
-        # nearly solo and would bias occupancy low if counted
-        bstats = {
-            key: v - bstats0.get(key, 0)
-            for key, v in (component.batcher.stats if component.batcher else {}).items()
-        }
         harness.stop()
         if component.batcher is not None:
             component.batcher.close()
+    stats, bstats = max(windows, key=lambda p: p[0]["rows_per_s"])
+    if len(windows) > 1:
+        stats["best_of"] = len(windows)
+        stats["median_tokens_per_s"] = round(
+            statistics.median(w["rows_per_s"] for w, _ in windows), 2
+        )
     model = component._model
     avg_ctx = prompt_len + max_new_tokens / 2.0
     tokens_per_s = stats.pop("rows_per_s")
@@ -962,8 +978,6 @@ def run_model_tier(
             # to transient tunnel congestion: best-of-two per encoding,
             # median-of-two published alongside (best_of alone is a
             # generous estimator)
-            import statistics
-
             h2d = measure_h2d_mb_s()
             hbm = measure_hbm_gb_s()
             raw_runs = [
@@ -1054,29 +1068,21 @@ def run_model_tier(
             # decode pacing is sync-round-trip-bound, so this tier shares
             # the wire tier's sensitivity to transient tunnel congestion:
             # best of two runs, recorded as best_of
-            gen_runs = [
-                bench_generate(
-                    root,
-                    seconds=seconds,
-                    prompt_len=128,
-                    max_new_tokens=64,
-                    cache_seq=256,
-                    config={
-                        "vocab_size": 32000, "d_model": 1024, "n_layers": 12,
-                        "n_heads": 16, "n_kv_heads": 16, "d_ff": 2816,
-                        "max_seq": 512,
-                    },
-                    peak=peak,
-                    hbm_gb_s=hbm,
-                )
-                for _ in range(2)
-            ]
-            best_gen = max(gen_runs, key=lambda r: r["tokens_per_s"])
-            best_gen["best_of"] = len(gen_runs)
-            best_gen["median_tokens_per_s"] = round(
-                statistics.median(r["tokens_per_s"] for r in gen_runs), 2
+            results["llm_generate"] = bench_generate(
+                root,
+                seconds=seconds,
+                prompt_len=128,
+                max_new_tokens=64,
+                cache_seq=256,
+                runs=2,
+                config={
+                    "vocab_size": 32000, "d_model": 1024, "n_layers": 12,
+                    "n_heads": 16, "n_kv_heads": 16, "d_ff": 2816,
+                    "max_seq": 512,
+                },
+                peak=peak,
+                hbm_gb_s=hbm,
             )
-            results["llm_generate"] = best_gen
             # flagship scale: a 1.26B-param llama-architecture decoder
             # (BASELINE.json config 5's class), bf16-resident, measured at
             # a throughput tier (16 lanes) and a latency tier (4 lanes,
@@ -1100,20 +1106,12 @@ def run_model_tier(
             # cache to the tier's 192-token requests cut the fused step
             # from ~12 ms to ~6.6 ms and nearly doubled MBU (28.7 -> 62.8%
             # same-session)
-            big_runs = [
-                bench_generate(
-                    root, label="llm-1.26b",
-                    seconds=max(seconds, 10.0), concurrency=32, prompt_len=128,
-                    max_new_tokens=64, slots=16, steps_per_poll=16,
-                    cache_seq=256,
-                    config=big_cfg, peak=peak, hbm_gb_s=hbm,
-                )
-                for _ in range(2)
-            ]
-            big_best = max(big_runs, key=lambda r: r["tokens_per_s"])
-            big_best["best_of"] = len(big_runs)
-            big_best["median_tokens_per_s"] = round(
-                statistics.median(r["tokens_per_s"] for r in big_runs), 2
+            big_best = bench_generate(
+                root, label="llm-1.26b",
+                seconds=max(seconds, 10.0), concurrency=32, prompt_len=128,
+                max_new_tokens=64, slots=16, steps_per_poll=16,
+                cache_seq=256, runs=2,
+                config=big_cfg, peak=peak, hbm_gb_s=hbm,
             )
             # slots x steps_per_poll x attn-bucket x max_new ablation
             # (VERDICT r4 #1), one session so the configs are orderable.
@@ -1176,14 +1174,15 @@ def run_model_tier(
                     slots=winner["slots"],
                     steps_per_poll=winner["steps_per_poll"],
                     attn_bucket=winner["attn_bucket"],
+                    cache_seq=-(-(128 + winner["max_new_tokens"]
+                                  + 2 * winner["steps_per_poll"]) // 128) * 128,
+                    runs=2,
                     config=big_cfg, peak=peak, hbm_gb_s=hbm,
                 )
                 if (
                     rerun["mbu_pct"] > big_best["mbu_pct"]
                     and rerun["p99_ms"] <= p99_cap
                 ):
-                    rerun["best_of"] = 1
-                    rerun["median_tokens_per_s"] = rerun["tokens_per_s"]
                     big_best = rerun
             big_best["ablation_grid"] = grid
             results["llm_1b"] = big_best
@@ -1217,7 +1216,7 @@ def run_model_tier(
             results["llm_1b_long"] = bench_generate(
                 root, label="llm-1.26b-long",
                 seconds=max(seconds, 10.0), concurrency=32, prompt_len=1792,
-                max_new_tokens=128, slots=8, steps_per_poll=16,
+                max_new_tokens=128, slots=8, steps_per_poll=16, runs=2,
                 config={**big_cfg, "max_seq": 2048}, peak=peak, hbm_gb_s=hbm,
             )
             # long-context serving, small decoder: the fast-step regime
@@ -1229,30 +1228,22 @@ def run_model_tier(
             # it). Decode pacing shares the wire tiers' sensitivity to
             # transient tunnel congestion: best of 3, recorded as best_of,
             # median alongside.
-            long_small_runs = [
-                bench_generate(
-                    root,
-                    seconds=max(seconds, 10.0),
-                    concurrency=30,
-                    prompt_len=1792,
-                    max_new_tokens=128,
-                    slots=10,
-                    steps_per_poll=32,
-                    config={
-                        "vocab_size": 32000, "d_model": 1024, "n_layers": 12,
-                        "n_heads": 16, "n_kv_heads": 16, "d_ff": 2816,
-                        "max_seq": 2048,
-                    },
-                    peak=peak,
-                    hbm_gb_s=hbm,
-                    label="llm-decoder-long",
-                )
-                for _ in range(3)
-            ]
-            long_small_best = max(long_small_runs, key=lambda r: r["tokens_per_s"])
-            long_small_best["best_of"] = len(long_small_runs)
-            long_small_best["median_tokens_per_s"] = round(
-                statistics.median(r["tokens_per_s"] for r in long_small_runs), 2
+            results["llm_generate_long"] = bench_generate(
+                root,
+                seconds=max(seconds, 10.0),
+                concurrency=30,
+                prompt_len=1792,
+                max_new_tokens=128,
+                slots=10,
+                steps_per_poll=32,
+                runs=3,
+                config={
+                    "vocab_size": 32000, "d_model": 1024, "n_layers": 12,
+                    "n_heads": 16, "n_kv_heads": 16, "d_ff": 2816,
+                    "max_seq": 2048,
+                },
+                peak=peak,
+                hbm_gb_s=hbm,
+                label="llm-decoder-long",
             )
-            results["llm_generate_long"] = long_small_best
     return results

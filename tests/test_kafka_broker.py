@@ -95,6 +95,9 @@ def make_client_classes(cluster: FakeCluster):
             for tp in offsets:
                 cluster.committed[self._group] = tp.offset
 
+        def get_watermark_offsets(self, tp):
+            return (0, len(cluster.log))
+
     return FakeProducer, FakeConsumer, FakeTopicPartition
 
 
@@ -224,6 +227,7 @@ def test_append_many_returns_first_offset(make_broker):
     q.append({"id": "r0"})
     first = q.append_many([{"id": "r1"}, {"id": "r2"}, {"id": "r3"}])
     assert first == 1, "append_many returns the FIRST offset of the batch"
+    assert q.append_many([]) == 4, "empty batch returns the end offset"
 
 
 def test_kafka_import_gate_without_clients():
