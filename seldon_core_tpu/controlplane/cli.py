@@ -106,8 +106,47 @@ def main(argv=None) -> None:
                        help="kube mode: leader lease duration (takeover "
                        "happens within ~one duration of a leader dying)")
 
+    p_build = sub.add_parser(
+        "build",
+        help="user code -> servable image build context (s2i counterpart)",
+    )
+    p_build.add_argument("--src", required=True,
+                         help="directory with the user component")
+    p_build.add_argument("--model-name", required=True,
+                         help="module.Class (python) or a label (cpp)")
+    p_build.add_argument("--api-type", default="REST",
+                         choices=["REST", "GRPC", "BOTH", "FBS"])
+    p_build.add_argument("--service-type", default="MODEL",
+                         choices=["MODEL", "ROUTER", "TRANSFORMER",
+                                  "OUTPUT_TRANSFORMER", "COMBINER"])
+    p_build.add_argument("--persistence", action="store_true")
+    p_build.add_argument("--language", default="python",
+                         choices=["python", "cpp"])
+    p_build.add_argument("--out", required=True,
+                         help="build-context output directory")
+    p_build.add_argument("--image", default=None,
+                         help="also run `docker build -t IMAGE` when a "
+                         "docker CLI is present")
+
     args = parser.parse_args(argv)
     logging.basicConfig(level="INFO", format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    if args.cmd == "build":
+        from ..build import docker_build, write_build_context
+
+        files = write_build_context(
+            src=args.src, out=args.out, model_name=args.model_name,
+            api_type=args.api_type, service_type=args.service_type,
+            persistence=args.persistence, language=args.language,
+        )
+        print(f"wrote build context ({len(files)} files) to {args.out}")
+        if args.image:
+            if docker_build(args.out, args.image):
+                print(f"built image {args.image}")
+            else:
+                print("docker CLI not found — build the context with: "
+                      f"docker build -t {args.image} {args.out}")
+        return
     store = ResourceStore(persist_dir=args.store_dir)
 
     if args.cmd == "apply":

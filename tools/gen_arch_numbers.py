@@ -53,7 +53,7 @@ def _extract_obj(text, key):
     return {}
 
 
-def rows_from(bench):
+def rows_from(bench, bench_mtime=None):
     tail = bench.get("tail")
     if isinstance(tail, str):
         line = tail.strip().splitlines()[-1]
@@ -100,9 +100,19 @@ def rows_from(bench):
         baseline = {}
     published = baseline.get("published") or {}
     fronts = baseline.get("published_fronts") or {}
-    if published.get("captured_at") and published.get(
-        "captured_at"
-    ) == fronts.get("captured_at"):
+    if (
+        published.get("captured_at")
+        and published.get("captured_at") == fronts.get("captured_at")
+        # recency: a BENCH file materially newer than the stamped capture
+        # means the driver ran after the last BASELINE write (e.g. bench
+        # crashed pre-publish) — then the BENCH tail stays primary and
+        # published only backfills, preserving "driver file is the source
+        # of truth"
+        and (
+            bench_mtime is None
+            or published["captured_at"] >= bench_mtime - 3600
+        )
+    ):
         # a stamped published capture is ONE coherent session (bench.py
         # writes tiers + fronts together); prefer it wholesale over
         # splicing tiers from different rounds — a driver-truncated tail
@@ -272,7 +282,7 @@ def finish_rows(payload, mt):
 
 def main():
     path, bench = latest_bench()
-    rows, note, src = rows_from(bench)
+    rows, note, src = rows_from(bench, bench_mtime=os.path.getmtime(path))
     source = (
         "`BASELINE.json` published"
         if src == "published"
