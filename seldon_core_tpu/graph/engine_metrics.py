@@ -65,6 +65,17 @@ class MetricsRegistry:
             h[-2] += seconds
             h[-1] += 1
 
+    # generate-scheduler step counters additionally export as ONE
+    # first-class series with a phase label: prefill vs decode device
+    # steps per graph node (prefix-cache wins show as the prefill series
+    # flattening while decode keeps pace — previously only request-level
+    # latency was tracked at the engine)
+    _STEP_PHASES = {
+        "gen_prefill_steps": ("seldon_engine_generate_steps", "prefill"),
+        "gen_decode_steps": ("seldon_engine_generate_steps", "decode"),
+        "gen_prefill_tokens": ("seldon_engine_generate_step_tokens", "prefill"),
+    }
+
     def record_custom(self, metrics: List[Dict], labels: Dict[str, str] | None = None):
         """Sink for Meta.metrics emitted by components
         (reference: PredictiveUnitBean.addCustomMetrics:318-344)."""
@@ -76,6 +87,10 @@ class MetricsRegistry:
             val = float(m.get("value", 0))
             if mtype == "COUNTER":
                 self.counter_inc(f"seldon_custom_{key}", tags, val)
+                step = self._STEP_PHASES.get(key)
+                if step is not None:
+                    name, phase = step
+                    self.counter_inc(name, {**tags, "phase": phase}, val)
             elif mtype == "GAUGE":
                 self.gauge_set(f"seldon_custom_{key}", val, tags)
             elif mtype == "TIMER":
@@ -111,8 +126,10 @@ class MetricsRegistry:
                 lines.append(f"# TYPE {name} histogram")
                 for key, h in series.items():
                     for i, b in enumerate(_BUCKETS):
-                        lines.append(f'{name}_bucket{_fmt_labels(key, f'le="{b}"')} {h[i]}')
-                    lines.append(f'{name}_bucket{_fmt_labels(key, 'le="+Inf"')} {h[-1]}')
+                        le = f'le="{b}"'
+                        lines.append(f"{name}_bucket{_fmt_labels(key, le)} {h[i]}")
+                    inf = 'le="+Inf"'
+                    lines.append(f"{name}_bucket{_fmt_labels(key, inf)} {h[-1]}")
                     lines.append(f"{name}_sum{_fmt_labels(key)} {h[-2]}")
                     lines.append(f"{name}_count{_fmt_labels(key)} {h[-1]}")
         return "\n".join(lines) + "\n"

@@ -55,7 +55,14 @@ class RequestCtx:
     def absorb(self, unit_name: str, response: Dict[str, Any]) -> None:
         meta = response.get("meta") or {}
         self.tags.update(meta.get("tags") or {})
-        self.metrics.extend(meta.get("metrics") or [])
+        for m in meta.get("metrics") or []:
+            # stamp the emitting graph node so the engine's exposition
+            # keeps per-unit series (a multi-node graph's counters would
+            # otherwise collapse into one unattributed stream)
+            if isinstance(m, dict) and "unit" not in (m.get("tags") or {}):
+                m = dict(m)
+                m["tags"] = {**(m.get("tags") or {}), "unit": unit_name}
+            self.metrics.append(m)
 
     def to_meta(self) -> Dict[str, Any]:
         meta: Dict[str, Any] = {"puid": self.puid}

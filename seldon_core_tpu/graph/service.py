@@ -121,6 +121,21 @@ class EngineApp:
         with self._inflight_lock:
             self.inflight += n
 
+    def _count_stream_cache_hit(self, chunk) -> None:
+        """Roll a streaming response's final-event ``cache_hit_tokens``
+        into the same deployment-level counter the unary path feeds."""
+        if not isinstance(chunk, dict) or "cache_hit_tokens" not in chunk:
+            return
+        try:
+            total = int(chunk["cache_hit_tokens"])
+        except (TypeError, ValueError):
+            return
+        if total:
+            self.metrics.counter_inc(
+                "seldon_engine_prefix_cache_hit_tokens",
+                {"deployment": self.spec.name}, total,
+            )
+
     # -- core entrypoints (shared by REST and gRPC fronts) ------------------
 
     async def predict(self, message: Dict[str, Any],
@@ -153,6 +168,22 @@ class EngineApp:
             )
         self.metrics.counter_inc("seldon_api_engine_server_requests", labels)
         self.metrics.record_custom((out.get("meta") or {}).get("metrics"), labels)
+        # generate graphs surface per-request prefix-cache hit tokens in
+        # the response body; roll them up at the engine so deployment-level
+        # dashboards see prompt reuse without scraping node metrics
+        jd = out.get("jsonData")
+        if isinstance(jd, dict) and "cache_hit_tokens" in jd:
+            try:
+                hits = jd["cache_hit_tokens"]
+                total = sum(int(h) for h in hits) if isinstance(
+                    hits, (list, tuple)
+                ) else int(hits)
+            except (TypeError, ValueError):
+                total = 0
+            if total:
+                self.metrics.counter_inc(
+                    "seldon_engine_prefix_cache_hit_tokens", labels, total
+                )
         self.request_logger.log((out.get("meta") or {}).get("puid", ""), message, out)
         return out
 
@@ -362,6 +393,10 @@ class EngineApp:
             def sse():
                 try:
                     for chunk in handle.chunks:
+                        # the final event carries the request's prefix-cache
+                        # hit count — feed the same engine roll-up the unary
+                        # path uses, or stream-only deployments read 0
+                        self._count_stream_cache_hit(chunk)
                         yield b"data: " + json.dumps(chunk).encode() + b"\n\n"
                 finally:
                     self._inflight_add(-1)
@@ -453,6 +488,7 @@ class EngineApp:
                     chunk = await loop.run_in_executor(None, next, it, sentinel)
                     if chunk is sentinel:
                         break
+                    app._count_stream_cache_hit(chunk)
                     yield json_to_proto({"jsonData": chunk})
             finally:
                 app._inflight_add(-1)

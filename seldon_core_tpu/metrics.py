@@ -38,6 +38,30 @@ def create_timer(key: str, value: float, tags: Dict[str, str] | None = None) -> 
     return m
 
 
+class CounterDeltas:
+    """Turn monotonically growing totals into ``Meta.metrics`` COUNTER
+    deltas. The engine sink SUMS counter values per response
+    (engine_metrics.record_custom), so a component holding cumulative
+    stats (e.g. the continuous batcher's scheduler counters) must ship
+    the increment since its last export, not the running total — this
+    keeps that bookkeeping in one place. Locked: ``metrics()`` hooks run
+    per-response from the serving thread pool, and an unlocked
+    read-modify-write would double-report (or drop) deltas under
+    concurrent exports."""
+
+    def __init__(self):
+        import threading
+
+        self._last: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, key: str, total: float, tags: Dict[str, str] | None = None) -> Dict:
+        with self._lock:
+            last = self._last.get(key, 0.0)
+            self._last[key] = float(total)
+        return create_counter(key, max(0.0, float(total) - last), tags)
+
+
 def validate_metrics(metrics: List[Dict]) -> bool:
     if not isinstance(metrics, (list, tuple)):
         return False

@@ -565,6 +565,82 @@ class DecoderLM(ServedModel):
         logits = (x @ params["unembed"].astype(dt)).astype(jnp.float32)
         return logits, nks, nvs
 
+    def prefill_with_prefix(self, params, prefix_kv, tokens, start_pos,
+                            last_index=None):
+        """Suffix prefill over a CACHED prefix (the prefix-splice cache op
+        behind the continuous batcher's radix prefix cache).
+
+        ``prefix_kv``: stacked ``{"k","v"}`` slab ``[L, 1, KV, Tp, Dh]``
+        holding valid K/V for positions ``[0, start_pos)`` of this
+        sequence — the ``cache_one`` layout an earlier prefill of a
+        prompt sharing the prefix produced (``start_pos`` is traced, so
+        one executable serves every match depth at a given slab/window
+        bucket pair). ``tokens`` ``[1, W]``: the remaining prompt, padded
+        to a bucket; token j sits at absolute position ``start_pos + j``
+        (RoPE uses absolute positions, so any split point is exact).
+
+        Per layer the window's K/V are spliced into a W-extended copy of
+        the prefix slab at ``start_pos`` and attention runs over the
+        grouped combined cache with the ``key_pos <= start_pos + j``
+        bound — covering the cached prefix AND in-window causality while
+        masking slab residue beyond the match (``_cache_attention``; the
+        donor's positions past ``start_pos`` belong to the DONOR's
+        prompt, never this one). Returns ``(logits [1, V]`` at
+        ``last_index`` within the window, suffix slab
+        ``[L, 1, KVl, W, Dh])`` for splicing into a decode lane.
+        """
+        import jax.numpy as jnp
+        from jax import lax
+
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        B, W = tokens.shape
+        start_pos = jnp.asarray(start_pos, jnp.int32)
+        positions = start_pos + jnp.arange(W, dtype=jnp.int32)[None, :]  # [1, W]
+        x = params["embed"][tokens.astype(jnp.int32)].astype(dt)
+
+        def body(x, xs):
+            layer_p, pk, pv = xs  # pk/pv: [1, KV, Tp, Dh]
+            h = _rms_norm(x, layer_p["ln1"].astype(dt), cfg.norm_eps)
+            q = h @ layer_p["wq"].astype(dt)
+            k = h @ layer_p["wk"].astype(dt)
+            v = h @ layer_p["wv"].astype(dt)
+            Hl = q.shape[-1] // cfg.head_dim
+            KVl = k.shape[-1] // cfg.head_dim
+            q = q.reshape(B, W, Hl, cfg.head_dim).transpose(0, 2, 1, 3)
+            k = k.reshape(B, W, KVl, cfg.head_dim).transpose(0, 2, 1, 3)
+            v = v.reshape(B, W, KVl, cfg.head_dim).transpose(0, 2, 1, 3)
+            q = _rope(q, positions, cfg.rope_theta)
+            k = _rope(k, positions, cfg.rope_theta)
+            # W-extended combined cache: start_pos <= Tp always (the slab
+            # covers at least the match), so the traced-start splice never
+            # clamps
+            pad = jnp.zeros((B, KVl, W, cfg.head_dim), dt)
+            ck = lax.dynamic_update_slice(
+                jnp.concatenate([pk.astype(dt), pad], axis=2), k,
+                (0, 0, start_pos, 0),
+            )
+            cv = lax.dynamic_update_slice(
+                jnp.concatenate([pv.astype(dt), pad], axis=2), v,
+                (0, 0, start_pos, 0),
+            )
+            o = self._cache_attention(q, ck, cv, positions, dt)
+            o = o.transpose(0, 2, 1, 3).reshape(B, W, Hl * cfg.head_dim)
+            x = x + o @ layer_p["wo"].astype(dt)
+            ffn_out, _ = self._ffn(layer_p, x)
+            return x + ffn_out, (k, v)
+
+        x, (sk, sv) = lax.scan(
+            body, x, (params["blocks"], prefix_kv["k"], prefix_kv["v"])
+        )
+        x = _rms_norm(x, params["ln_f"].astype(dt), cfg.norm_eps)
+        if last_index is None:
+            x_last = x[:, -1]
+        else:
+            x_last = x[jnp.arange(B), jnp.asarray(last_index, jnp.int32)]
+        logits = (x_last @ params["unembed"].astype(dt)).astype(jnp.float32)
+        return logits, {"k": sk, "v": sv}
+
     def prefill(self, params, prompt, max_seq: int, last_index=None):
         """Batched prefill: ONE forward over the whole prompt, K/V for all
         positions computed in parallel and written into a fresh cache of

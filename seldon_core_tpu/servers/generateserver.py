@@ -23,6 +23,15 @@ Model URI layout: same ``jax_config.json`` as jaxserver with
     draft_layers     early-exit self-draft — the first N layers of the
                      SERVED model propose (no second checkpoint)
     draft_uri        separate draft model dir (same vocab)
+    prefix_cache_hbm_bytes
+                     radix prefix KV-cache budget in HBM bytes (0 = off,
+                     the disable flag): completed requests publish their
+                     prompt K/V; later prompts sharing a prefix splice it
+                     and prefill only the suffix (LRU-evicted at radix-
+                     node granularity). Responses then carry per-request
+                     ``cache_hit_tokens``.
+    prefix_cache_min_tokens
+                     shortest prefix worth caching or reusing (default 16)
 
 Request (jsonData)::
 
@@ -72,6 +81,8 @@ class GenerateServer(SeldonComponent):
         speculate_tokens: int = 0,
         draft_layers: int = 0,
         draft_uri: Optional[str] = None,
+        prefix_cache_hbm_bytes: int = 0,
+        prefix_cache_min_tokens: int = 16,
         warmup_prompt_lens: Optional[Sequence[int]] = None,
         warmup_max_new_tokens: int = 0,
         **kwargs,
@@ -89,6 +100,13 @@ class GenerateServer(SeldonComponent):
         self._speculate_tokens = int(speculate_tokens)
         self._draft_layers = int(draft_layers)
         self._draft_uri = draft_uri
+        self._prefix_cache_hbm_bytes = int(prefix_cache_hbm_bytes)
+        self._prefix_cache_min_tokens = int(prefix_cache_min_tokens)
+        # cumulative scheduler stats ship as true counters (deltas)
+        # through Meta.metrics
+        from ..metrics import CounterDeltas
+
+        self._deltas = CounterDeltas()
         # parse CSV from typed-params env ("128,1792") as well as sequences
         if isinstance(warmup_prompt_lens, str):
             warmup_prompt_lens = [
@@ -185,6 +203,8 @@ class GenerateServer(SeldonComponent):
             draft_model=draft_model,
             draft_params=draft_params,
             speculate_tokens=self._speculate_tokens,
+            prefix_cache_hbm_bytes=self._prefix_cache_hbm_bytes,
+            prefix_cache_min_tokens=self._prefix_cache_min_tokens,
         )
         if self._warmup_prompt_lens:
             # compile-before-listen: every prefill/insert/burst variant the
@@ -251,6 +271,14 @@ class GenerateServer(SeldonComponent):
             out["text"] = [
                 self._decode(r[len(p):]) for r, p in zip(results, token_lists)
             ]
+        if self.batcher._prefix_index is not None:
+            # per-request prompt tokens served from the prefix cache, in
+            # request order — graph nodes and the engine report it
+            out["cache_hit_tokens"] = [
+                int(getattr(getattr(f, "gen_request", None),
+                            "cache_hit_tokens", 0))
+                for f in futures
+            ]
         return out
 
     def stream(self, body: Dict[str, Any]) -> "StreamHandle":
@@ -286,6 +314,11 @@ class GenerateServer(SeldonComponent):
             final: Dict[str, Any] = {"done": True, "tokens": result}
             if text_mode:
                 final["text"] = self._decode(result[len(toks):])
+            if self.batcher._prefix_index is not None:
+                final["cache_hit_tokens"] = int(
+                    getattr(getattr(fut, "gen_request", None),
+                            "cache_hit_tokens", 0)
+                )
             yield final
 
         return StreamHandle(chunks=chunks(), cancel=fut.cancel)
@@ -297,11 +330,26 @@ class GenerateServer(SeldonComponent):
         if self.batcher is None:
             return []
         s = self.batcher.stats
+        delta = self._deltas.counter
         out = [
             {"type": "GAUGE", "key": "gen_tokens_total", "value": float(s["tokens"])},
             {"type": "GAUGE", "key": "gen_steps_total", "value": float(s["steps"])},
             {"type": "GAUGE", "key": "gen_finished_total", "value": float(s["finished"])},
+            # prefill-vs-decode split: per-node cache wins show up as
+            # prefill step/token counters flattening while decode keeps pace
+            delta("gen_prefill_steps", s["prefill_steps"]),
+            delta("gen_prefill_tokens", s["prefill_tokens"]),
+            delta("gen_decode_steps", s["steps"]),
         ]
+        if self.batcher._prefix_index is not None:
+            out.extend([
+                delta("prefix_cache_hits", s["prefix_hits"]),
+                delta("prefix_cache_misses", s["prefix_misses"]),
+                delta("prefix_cache_evictions", s["prefix_evicted"]),
+                delta("prefix_tokens_saved", s["prefix_tokens_saved"]),
+                {"type": "GAUGE", "key": "prefix_cache_bytes",
+                 "value": float(s["prefix_cache_bytes"])},
+            ])
         if s.get("spec_rounds"):
             out.append(
                 {

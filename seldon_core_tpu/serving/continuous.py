@@ -67,6 +67,10 @@ class GenRequest:
     # span of tokens (must be cheap + non-blocking; exceptions are logged,
     # never propagated into the decode loop)
     on_tokens: Optional[object] = None
+    # prompt tokens served from the radix prefix cache at admit (0 = full
+    # prefill); surfaced per-request so responses/graph nodes can report
+    # cache effectiveness
+    cache_hit_tokens: int = 0
 
 
 @dataclasses.dataclass
@@ -112,6 +116,8 @@ class ContinuousBatcher:
         draft_model=None,
         draft_params=None,
         speculate_tokens: int = 4,
+        prefix_cache_hbm_bytes: int = 0,
+        prefix_cache_min_tokens: int = 16,
     ):
         import jax
         import jax.numpy as jnp
@@ -168,11 +174,28 @@ class ContinuousBatcher:
         self._thread: Optional[threading.Thread] = None
         self._thread_lock = threading.Lock()
         self._started = threading.Event()
+        # -- radix prefix KV cache (cross-request prompt reuse) -----------
+        # device K/V slabs of completed requests' prompts, indexed by a
+        # radix tree over token IDs; an admit whose prompt shares a cached
+        # prefix splices the slab and prefills only the suffix. Budgeted
+        # in HBM bytes (0 = off), LRU-evicted at radix-node granularity.
+        self.prefix_cache_min_tokens = max(1, int(prefix_cache_min_tokens))
+        self._prefix_index = None
+        if int(prefix_cache_hbm_bytes) > 0:
+            from .prefix_cache import RadixPrefixIndex
+
+            self._prefix_index = RadixPrefixIndex(int(prefix_cache_hbm_bytes))
         # spec_rounds / spec_emitted feed the acceptance-rate gauge:
-        # emitted/rounds ranges 1 (nothing accepted) .. gamma+1 (all)
+        # emitted/rounds ranges 1 (nothing accepted) .. gamma+1 (all).
+        # prefill_steps/prefill_tokens split device prefill work out from
+        # decode steps (the prefix cache's win shows up as prefill_tokens
+        # dropping while prefix_tokens_saved climbs)
         self.stats = {
             "admitted": 0, "finished": 0, "cancelled": 0, "steps": 0,
             "tokens": 0, "spec_rounds": 0, "spec_emitted": 0,
+            "prefill_steps": 0, "prefill_tokens": 0,
+            "prefix_hits": 0, "prefix_misses": 0, "prefix_evicted": 0,
+            "prefix_tokens_saved": 0, "prefix_cache_bytes": 0,
         }
 
         # -- device state ----------------------------------------------------
@@ -381,6 +404,64 @@ class ContinuousBatcher:
             toks = jnp.concatenate([cur_tok[None, :], toks], axis=0)
             return toks, cur_tok_out, pos, {"k": ks, "v": vs}, keys
 
+        # -- prefix-cache executables ---------------------------------------
+        def prefix_prefill(params, slab, suffix, start_pos, last_index, seed, temp):
+            # suffix-only prefill over the cached prefix slab: the model's
+            # prefix-splice op + the same first-token sampling prefill_one
+            # does. One executable per (slab bucket, suffix bucket) pair —
+            # start_pos/last_index are traced
+            logits, suffix_slab = model.prefill_with_prefix(
+                params, slab, suffix, start_pos, last_index=last_index
+            )
+            key = jax.random.PRNGKey(seed)
+            key, sub = jax.random.split(key)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            sampled = jax.random.categorical(
+                sub, logits / jnp.maximum(temp, 1e-6), axis=-1
+            ).astype(jnp.int32)
+            first = jnp.where(temp > 0, sampled, greedy)
+            return first, suffix_slab, key
+
+        def insert_prefix(cache, slab, suffix_slab, slot, start_pos,
+                          first_tok, first_pos, lane_key, cur_tok, pos, keys):
+            # splice the donor prefix slab at the lane's origin, then the
+            # freshly prefilled suffix at start_pos (both traced starts —
+            # donor residue past the real prompt end is decode-overwritten
+            # before it can become readable, the standard residue invariant)
+            new = {}
+            for name in ("k", "v"):
+                layers = []
+                for l, layer in enumerate(cache[name]):
+                    layer = lax.dynamic_update_slice(
+                        layer, slab[name][l], (slot, 0, 0, 0)
+                    )
+                    layer = lax.dynamic_update_slice(
+                        layer, suffix_slab[name][l], (slot, 0, start_pos, 0)
+                    )
+                    layers.append(layer)
+                new[name] = layers
+            cur_tok = cur_tok.at[slot].set(first_tok)
+            pos = pos.at[slot].set(first_pos)
+            keys = keys.at[slot].set(lane_key)
+            return new, cur_tok, pos, keys
+
+        def extract_prefix(cache, slot, bucket):
+            # copy one lane's prompt-prefix K/V out as a stacked cache_one
+            # slab [L, 1, KV, bucket, Dh] — the publishable unit. A copy,
+            # not a view: it must outlive the donated cache's churn
+            return {
+                name: jnp.stack(
+                    [
+                        lax.dynamic_slice(
+                            layer, (slot, 0, 0, 0),
+                            (1, layer.shape[1], bucket, layer.shape[3]),
+                        )
+                        for layer in cache[name]
+                    ]
+                )
+                for name in ("k", "v")
+            }
+
         self._burst_fn = jax.jit(
             fused_burst, donate_argnums=(1,), static_argnums=(7, 8)
         )
@@ -388,6 +469,9 @@ class ContinuousBatcher:
         self._prefill_fn = jax.jit(prefill_one)
         self._prefill_many_fn = jax.jit(prefill_many)
         self._insert_many_fn = jax.jit(insert_many, donate_argnums=(0,))
+        self._prefix_prefill_fn = jax.jit(prefix_prefill)
+        self._insert_prefix_fn = jax.jit(insert_prefix, donate_argnums=(0,))
+        self._extract_fn = jax.jit(extract_prefix, static_argnums=(2,))
 
         # -- speculative executables (exact; see spec_round docstring) ------
         self._spec_burst_fn = None
@@ -586,6 +670,9 @@ class ContinuousBatcher:
             seed=int(seed),
             on_tokens=on_tokens,
         )
+        # callers read per-request admit metadata (cache_hit_tokens) off
+        # the future after it resolves
+        req.future.gen_request = req
         self._queue.put(req)
         if self._stop.is_set():
             # the loop died between the entry check and the put: its drain
@@ -638,7 +725,11 @@ class ContinuousBatcher:
         import jax
         import jax.numpy as jnp
 
-        buckets = sorted({self._bucket(p) for p in prompt_lens})
+        # clamp declared warmup lens to the cache length: an oversized
+        # config entry warms the max_seq bucket rather than failing load()
+        # with _bucket's too-long-REQUEST error (submit() still rejects
+        # real prompts at the boundary)
+        buckets = sorted({self._bucket(min(p, self.max_seq)) for p in prompt_lens})
         if not buckets:
             buckets = [self.prefill_buckets[0]]
         k = self._k
@@ -712,6 +803,30 @@ class ContinuousBatcher:
                     self._draft_cache = self._draft_insert_fn(
                         self._draft_cache, dslab, 0
                     )
+        if self._prefix_index is not None:
+            # prefix-cache executables: extract per donor bucket, and the
+            # suffix prefill + splice per (donor, suffix<=donor) bucket
+            # pair — the shapes hit traffic takes (a longer-than-donor
+            # suffix compiles on first use; it is the rare shape)
+            for d in buckets:
+                slab = self._extract_fn(self._cache, 0, d)
+                for s_b in buckets:
+                    if s_b > d:
+                        continue
+                    suffix = jnp.zeros((1, s_b), jnp.int32)
+                    first, suffix_slab, lane_key = self._prefix_prefill_fn(
+                        self.params, slab, suffix, jnp.int32(1),
+                        jnp.zeros((1,), jnp.int32),
+                        jnp.int32(0), jnp.float32(0.0),
+                    )
+                    self._cache, self._cur_tok, self._pos, self._keys = (
+                        self._insert_prefix_fn(
+                            self._cache, slab, suffix_slab, 0, jnp.int32(1),
+                            first[0], 2, lane_key,
+                            self._cur_tok, self._pos, self._keys,
+                        )
+                    )
+                    self._cache["k"][0].block_until_ready()
         active = jnp.zeros((self.slots,), bool)
         temps = jnp.zeros((self.slots,), jnp.float32)
         for attn_len in attn_lens:
@@ -778,29 +893,126 @@ class ContinuousBatcher:
         for b in self.prefill_buckets:
             if n <= b:
                 return b
-        return self.max_seq
+        if n <= self.max_seq:
+            return self.max_seq
+        # a too-long request must fail HERE with a clear message, not as
+        # an opaque downstream broadcast/shape error when the prompt is
+        # packed into a bucket-sized array it cannot fit
+        raise ValueError(
+            f"request of {n} tokens exceeds the largest prefill bucket "
+            f"({self.prefill_buckets[-1]}) and max_seq ({self.max_seq}); "
+            "raise max_seq or shorten the prompt"
+        )
 
-    def _admit(self, slot: int, req: GenRequest) -> None:
+    def _prefix_match(self, req: GenRequest):
+        """Longest usable cached prefix for this prompt: ``(m, slab)`` or
+        None. Capped at n-1 (the last prompt token is always recomputed —
+        its forward produces the logits the first new token samples from)
+        and rejected when the suffix bucket would not fit the cache."""
+        if self._prefix_index is None:
+            return None
+        n = len(req.tokens)
+        m, slab = self._prefix_index.match(req.tokens)
+        m = min(m, n - 1)
+        if slab is None or m < self.prefix_cache_min_tokens:
+            return None
+        if m + self._bucket(n - m) > self.max_seq:
+            # the traced-start suffix insert would clamp and corrupt the
+            # lane; full prefill is the safe path for near-max prompts
+            return None
+        if slab["k"].shape[3] > self._bucket(n):
+            # the hit's cost scales with the DONOR's bucket (splice bytes
+            # + suffix attention over the combined cache): a short prompt
+            # matching into a much longer cached prompt would pay more
+            # than the full prefill it skips — not a win, decline
+            return None
+        return m, slab
+
+    def _maybe_publish(self, slot: int, s: "_Slot") -> None:
+        """Publish the request's prompt K/V back into the radix pool (the
+        prompt region [0, n) is fully written from admit onward and decode
+        only appends, so extraction is valid at any free point). Skipped
+        when an exact entry already covers the prompt — repeat-heavy
+        traffic publishes each distinct prompt once."""
+        idx = self._prefix_index
+        if idx is None:
+            return
+        toks = s.request.tokens
+        n = len(toks)
+        if n < self.prefix_cache_min_tokens:
+            return
+        if idx.covered_len(toks) >= n:
+            return
+        slab = self._extract_fn(self._cache, slot, self._bucket(n))
+        nbytes = int(slab["k"].nbytes) + int(slab["v"].nbytes)
+        self.stats["prefix_evicted"] += idx.insert(toks, slab, nbytes)
+        self.stats["prefix_cache_bytes"] = idx.total_bytes
+
+    def _admit(self, slot: int, req: GenRequest, hit=None) -> None:
+        # ``hit``: a (match_len, slab) the wave-routing loop already
+        # computed — passed through so the radix walk (and its LRU touch)
+        # runs once per admission, not twice
         import jax.numpy as jnp
 
         n = len(req.tokens)
-        bucket = self._bucket(n)
-        prompt = np.zeros((1, bucket), np.int32)
-        prompt[0, :n] = req.tokens
-        first, cache_one, lane_key = self._prefill_fn(
-            self.params,
-            jnp.asarray(prompt),
-            jnp.asarray([n - 1], jnp.int32),
-            jnp.int32(req.seed),
-            jnp.float32(req.temperature),
-        )
-        self._cache, self._cur_tok, self._pos, self._keys = self._insert_fn(
-            self._cache, cache_one, slot, first[0], n, lane_key,
-            self._cur_tok, self._pos, self._keys,
-        )
+        if hit is None:
+            hit = self._prefix_match(req)
+        if hit is not None:
+            # cache hit: splice the donor slab, prefill ONLY the suffix
+            # (same bucketed machinery, on the shorter remainder)
+            m, slab = hit
+            wb = self._bucket(n - m)
+            suffix = np.zeros((1, wb), np.int32)
+            suffix[0, : n - m] = req.tokens[m:]
+            first, suffix_slab, lane_key = self._prefix_prefill_fn(
+                self.params,
+                slab,
+                jnp.asarray(suffix),
+                jnp.int32(m),
+                jnp.asarray([n - 1 - m], jnp.int32),
+                jnp.int32(req.seed),
+                jnp.float32(req.temperature),
+            )
+            self._cache, self._cur_tok, self._pos, self._keys = (
+                self._insert_prefix_fn(
+                    self._cache, slab, suffix_slab, slot, jnp.int32(m),
+                    first[0], n, lane_key,
+                    self._cur_tok, self._pos, self._keys,
+                )
+            )
+            req.cache_hit_tokens = m
+            self.stats["prefix_hits"] += 1
+            self.stats["prefix_tokens_saved"] += m
+            self.stats["prefill_steps"] += 1
+            self.stats["prefill_tokens"] += wb
+        else:
+            bucket = self._bucket(n)
+            prompt = np.zeros((1, bucket), np.int32)
+            prompt[0, :n] = req.tokens
+            first, cache_one, lane_key = self._prefill_fn(
+                self.params,
+                jnp.asarray(prompt),
+                jnp.asarray([n - 1], jnp.int32),
+                jnp.int32(req.seed),
+                jnp.float32(req.temperature),
+            )
+            self._cache, self._cur_tok, self._pos, self._keys = self._insert_fn(
+                self._cache, cache_one, slot, first[0], n, lane_key,
+                self._cur_tok, self._pos, self._keys,
+            )
+            if self._prefix_index is not None:
+                self.stats["prefix_misses"] += 1
+            self.stats["prefill_steps"] += 1
+            self.stats["prefill_tokens"] += bucket
         if self.speculate_tokens > 0:
             # the draft needs the prompt's K/V prefix too so its proposals
-            # attend over the real context
+            # attend over the real context. Draft prefixes are RE-DERIVED
+            # from the full prompt, never cached: the radix pool holds only
+            # target K/V (a hit still pays the cheap draft prefill, and the
+            # pool never doubles its footprint for the thin draft)
+            if hit is not None:
+                prompt = np.zeros((1, self._bucket(n)), np.int32)
+                prompt[0, :n] = req.tokens
             dcache_one = self._draft_prefill_fn(
                 self._draft_params, jnp.asarray(prompt),
                 jnp.asarray([n - 1], jnp.int32),
@@ -846,6 +1058,10 @@ class ContinuousBatcher:
             self._pos_host[slot] = len(req.tokens)
         self._masks_dirty = True
         self.stats["admitted"] += m
+        self.stats["prefill_steps"] += 1
+        self.stats["prefill_tokens"] += m * bucket
+        if self._prefix_index is not None:
+            self.stats["prefix_misses"] += m
 
     def _resolve(self, s: _Slot) -> None:
         # a trailing eos token is kept in the output, like HF generate.
@@ -862,6 +1078,10 @@ class ContinuousBatcher:
 
     def _finish(self, slot: int) -> None:
         s = self._active.pop(slot)
+        # publish while the lane still holds this request's prompt K/V —
+        # the next occupant's insert is dispatched after the extract, so
+        # stream order keeps the slab coherent
+        self._maybe_publish(slot, s)
         self._pos_host.pop(slot, None)
         self._masks_dirty = True
         self._resolve(s)
@@ -979,6 +1199,23 @@ class ContinuousBatcher:
                     )
                     by_bucket: Dict[int, List[GenRequest]] = {}
                     for req in wave:
+                        hit = (
+                            self._prefix_match(req)
+                            if self._prefix_index is not None
+                            else None
+                        )
+                        if hit is not None:
+                            # prefix-cache hit: the suffix-only admit path
+                            # (splice + short prefill) beats riding a
+                            # batched FULL prefill with its bucket-mates
+                            slot = next(free_iter)
+                            try:
+                                self._admit(slot, req, hit=hit)
+                            except Exception as e:  # noqa: BLE001 - bad request
+                                logger.exception("admit failed")
+                                if not req.future.done():
+                                    req.future.set_exception(e)
+                            continue
                         by_bucket.setdefault(
                             self._bucket(len(req.tokens)), []
                         ).append(req)
@@ -1119,7 +1356,11 @@ class ContinuousBatcher:
                             and s.dispatched >= s.request.max_new_tokens
                         ]
                         for slot in freed:
-                            self._active.pop(slot)
+                            s = self._active.pop(slot)
+                            # pre-freed lanes never reach _finish; this is
+                            # the only point their prompt K/V can publish
+                            # before the lane's next occupant splices over
+                            self._maybe_publish(slot, s)
                             self._pos_host.pop(slot, None)
                         if freed:
                             self._masks_dirty = True

@@ -1,0 +1,240 @@
+"""Radix index for the cross-request prefix KV cache.
+
+Production generate() traffic is dominated by shared prefixes (system
+prompts, few-shot templates, graph-injected preambles — DeepServe,
+arxiv 2501.14417), and prefill is the continuous batcher's dominant
+non-decode device cost. This module is the HOST side of the prefix
+cache: a radix tree over prompt token IDs whose slab-bearing nodes
+reference device-resident K/V blocks (stacked per-layer slabs, the
+``cache_one`` layout ``[L, 1, KV, Tb, Dh]``) published by completed
+requests.
+
+The index is deliberately device-agnostic — a "slab" is any opaque
+object plus a byte count — so insert/match/split/evict and the LRU byte
+budget are unit-testable on CPU without JAX. The scheduler thread owns
+all mutation; eviction simply drops the tree's reference and lets the
+device buffer die with Python refcounting, so an admit that matched a
+slab moments before an evict keeps it alive for exactly as long as the
+splice needs it (eviction can never race an admit into a dangling
+buffer).
+
+Invariant the batcher relies on: a slab stored for prompt ``t[0:n]``
+holds valid K/V for EVERY prefix of ``t`` — so any match depth
+``m <= n`` can be served by splicing the whole slab and overwriting
+positions ``>= m`` (the splice target's residue beyond ``m`` is never
+readable before being rewritten, the same residue invariant that lets
+decode lanes be reused without scrubbing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class _Node:
+    """One radix edge: ``edge`` tokens leading from the parent. A node
+    with ``slab`` is an eviction unit: it owns a published K/V block and
+    its byte bill; interior nodes created by edge splits carry none."""
+
+    edge: Tuple[int, ...]
+    parent: Optional["_Node"] = None
+    children: Dict[int, "_Node"] = dataclasses.field(default_factory=dict)
+    slab: Any = None
+    slab_bytes: int = 0
+    slab_tokens: int = 0  # real prompt length the slab covers
+    last_used: int = 0
+
+
+class RadixPrefixIndex:
+    """Longest-prefix match + LRU byte budget over published K/V slabs.
+
+    All methods are plain Python over host token lists; slabs are opaque.
+    Single-writer (the scheduler thread); readers of ``total_bytes`` /
+    ``node_count`` from other threads see torn-but-harmless ints.
+    """
+
+    def __init__(self, budget_bytes: int):
+        self.budget_bytes = int(budget_bytes)
+        self.root = _Node(edge=())
+        self.total_bytes = 0
+        self._clock = 0
+
+    # -- internals ---------------------------------------------------------
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    @staticmethod
+    def _common(a, b) -> int:
+        n = min(len(a), len(b))
+        i = 0
+        while i < n and a[i] == b[i]:
+            i += 1
+        return i
+
+    def _slab_node(self, node: _Node) -> Optional[_Node]:
+        """Slab-bearing node in ``node``'s subtree with the SMALLEST
+        covered prompt (every descendant's slab covers the prefix ending
+        at ``node``, so any is correct — but the splice cost scales with
+        the donor slab's bucket, so the shortest covering slab is the
+        cheapest donor)."""
+        best = None
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if n.slab is not None and (
+                best is None or n.slab_tokens < best.slab_tokens
+            ):
+                best = n
+            stack.extend(n.children.values())
+        return best
+
+    def _slab_nodes(self) -> List[_Node]:
+        out = []
+        stack = [self.root]
+        while stack:
+            n = stack.pop()
+            if n.slab is not None:
+                out.append(n)
+            stack.extend(n.children.values())
+        return out
+
+    def _prune(self, node: _Node) -> None:
+        """Remove slab-less leaves up the ancestry (never the root)."""
+        while (
+            node is not None
+            and node is not self.root
+            and node.slab is None
+            and not node.children
+        ):
+            parent = node.parent
+            if parent is not None:
+                parent.children.pop(node.edge[0], None)
+            node = parent
+
+    # -- queries -----------------------------------------------------------
+
+    def _walk(self, tokens) -> Tuple[int, Optional[_Node], List[_Node]]:
+        """Shared radix descent: ``(depth, carrier, path)`` where
+        ``carrier`` is the deepest node whose subtree covers ``depth``
+        (possibly entered mid-edge) and ``path`` is every node traversed.
+        ``match`` and ``covered_len`` differ only in what they do with
+        this — one walker keeps the edge-split/mid-edge subtleties in one
+        place."""
+        node, depth = self.root, 0
+        carrier = None
+        path: List[_Node] = []
+        while depth < len(tokens):
+            child = node.children.get(tokens[depth])
+            if child is None:
+                break
+            k = self._common(child.edge, tokens[depth:])
+            if k == 0:
+                break
+            depth += k
+            carrier = child
+            path.append(child)
+            if k < len(child.edge):
+                break
+            node = child
+        return depth, carrier, path
+
+    def match(self, tokens) -> Tuple[int, Any]:
+        """Longest cached prefix of ``tokens``: returns ``(depth, slab)``
+        where ``slab`` holds valid K/V for positions ``[0, depth)``, or
+        ``(0, None)``. Touches the LRU clock on the serving slab's node
+        and its slab-bearing ancestors (their content was used too)."""
+        depth, carrier, path = self._walk(tokens)
+        if depth == 0 or carrier is None:
+            return 0, None
+        slab_node = self._slab_node(carrier)
+        if slab_node is None:
+            return 0, None
+        stamp = self._tick()
+        slab_node.last_used = stamp
+        for n in path:
+            if n.slab is not None:
+                n.last_used = stamp
+        return depth, slab_node.slab
+
+    def covered_len(self, tokens) -> int:
+        """Longest prefix of ``tokens`` some stored slab covers, WITHOUT
+        touching the LRU clock (the publish-dedup probe)."""
+        depth, carrier, _path = self._walk(tokens)
+        if carrier is None or self._slab_node(carrier) is None:
+            return 0
+        return depth
+
+    # -- mutation ----------------------------------------------------------
+
+    def insert(self, tokens, slab, nbytes: int) -> int:
+        """Publish ``slab`` (K/V for the whole of ``tokens``) under the
+        radix path, splitting edges as needed, then evict LRU slab nodes
+        until the byte budget holds. Returns the number of slabs evicted.
+        Re-publishing an exact existing path is a no-op (the stored slab
+        already holds identical K/V)."""
+        tokens = tuple(tokens)
+        node, depth = self.root, 0
+        while depth < len(tokens):
+            child = node.children.get(tokens[depth])
+            if child is None:
+                new = _Node(edge=tokens[depth:], parent=node)
+                node.children[tokens[depth]] = new
+                node = new
+                depth = len(tokens)
+                break
+            k = self._common(child.edge, tokens[depth:])
+            if k < len(child.edge):
+                # split child's edge at k; `mid` ends exactly at depth+k
+                mid = _Node(edge=child.edge[:k], parent=node)
+                node.children[tokens[depth]] = mid
+                child.edge = child.edge[k:]
+                child.parent = mid
+                mid.children[child.edge[0]] = child
+                node = mid
+                depth += k
+                continue
+            node = child
+            depth += k
+        if node.slab is not None:
+            node.last_used = self._tick()
+            return 0
+        node.slab = slab
+        node.slab_bytes = int(nbytes)
+        node.slab_tokens = len(tokens)
+        node.last_used = self._tick()
+        self.total_bytes += node.slab_bytes
+        return self._evict_to_budget()
+
+    def _evict_to_budget(self) -> int:
+        evicted = 0
+        while self.total_bytes > self.budget_bytes:
+            nodes = self._slab_nodes()
+            if not nodes:
+                break
+            victim = min(nodes, key=lambda n: n.last_used)
+            self.total_bytes -= victim.slab_bytes
+            victim.slab = None
+            victim.slab_bytes = 0
+            victim.slab_tokens = 0
+            evicted += 1
+            self._prune(victim)
+        return evicted
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def node_count(self) -> int:
+        n, stack = 0, [self.root]
+        while stack:
+            node = stack.pop()
+            n += 1
+            stack.extend(node.children.values())
+        return n - 1  # root is bookkeeping, not content
+
+    @property
+    def slab_count(self) -> int:
+        return len(self._slab_nodes())

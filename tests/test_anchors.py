@@ -1,6 +1,12 @@
 """Anchors (components/anchors.py): the reference's default explainer
 family (alibi anchors, seldondeployment_explainers.go:32-187) rebuilt
-black-box — rule + precision + coverage for non-differentiable models."""
+black-box — rule + precision + coverage for non-differentiable models.
+
+Also home to repo ANCHOR tests: assertions that load-bearing artifacts
+(bench scenarios the driver's acceptance gates read) cannot silently
+disappear from the tree."""
+
+import os
 
 import numpy as np
 import pytest
@@ -114,3 +120,23 @@ def test_sklearn_iris_anchor_behind_explain_route(tmp_path, rest_client):
     # mention a petal measurement
     assert any("petal" in rule for rule in out["anchors"][0]["anchor"])
     assert out["prediction"] == int(clf.predict(iris.data[:1])[0])
+
+
+def test_bench_shared_prefix_scenario_anchor():
+    """The ``llm_1b_shared_prefix`` bench scenario is an acceptance
+    artifact (prefix-cache speedup + greedy-identity are read from the
+    bench output): it must stay wired through the model tier, and the
+    numbers-table generator must know its key — this anchor fails if
+    either silently drops it."""
+    import seldon_core_tpu.modelbench as modelbench
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    mb_src = open(modelbench.__file__).read()
+    assert 'results["llm_1b_shared_prefix"]' in mb_src
+    assert hasattr(modelbench, "bench_generate_shared_prefix")
+    gen_src = open(os.path.join(root, "tools", "gen_arch_numbers.py")).read()
+    assert "llm_1b_shared_prefix" in gen_src
+    # bench.py's final stdout line must stay the compact parseable
+    # summary (the harness parses the tail's last line)
+    bench_src = open(os.path.join(root, "bench.py")).read()
+    assert "compact_summary" in bench_src

@@ -494,6 +494,195 @@ def test_prompt_too_long_rejected(batcher):
         batcher.submit(list(range(64)), max_new_tokens=4)
 
 
+def test_bucket_overflow_raises_clear_error(batcher):
+    """A request longer than every prefill bucket AND max_seq fails with
+    a clear ValueError from _bucket, not an opaque downstream broadcast
+    error when the prompt is packed into a too-small array."""
+    with pytest.raises(ValueError, match="largest prefill bucket"):
+        batcher._bucket(batcher.max_seq + 1)
+    # in-range lengths still bucket normally
+    assert batcher._bucket(5) == 8
+    assert batcher._bucket(33) == batcher.max_seq  # falls back to max_seq
+
+
+def test_prefix_cache_greedy_identical_and_counts(model_and_params):
+    """The tentpole acceptance property: with the radix prefix KV cache
+    ON, greedy outputs are byte-identical to cache-off AND to the model's
+    own generate(), while repeat/shared-prefix traffic actually hits."""
+    import jax.numpy as jnp
+
+    model, params = model_and_params
+    rng = np.random.RandomState(11)
+    system = rng.randint(0, 256, 14).tolist()
+    prompts = [system + rng.randint(0, 256, 4).tolist() for _ in range(4)]
+    prompts.append(list(prompts[0]))  # exact repeat
+    # same bucket, shorter shared prefix (10 of 14 system tokens)
+    prompts.append(system[:10] + rng.randint(0, 256, 8).tolist())
+    on = ContinuousBatcher(
+        model, params, slots=2, max_seq=64, prefill_buckets=(8, 16, 32),
+        prefix_cache_hbm_bytes=1 << 26, prefix_cache_min_tokens=4,
+    )
+    off = ContinuousBatcher(
+        model, params, slots=2, max_seq=64, prefill_buckets=(8, 16, 32),
+    )
+    try:
+        got_on = [on.generate(p, max_new_tokens=8) for p in prompts]
+        got_off = [off.generate(p, max_new_tokens=8) for p in prompts]
+        assert got_on == got_off
+        expected = [
+            np.asarray(
+                model.generate(params, jnp.asarray([p], jnp.int32), 8)
+            )[0].tolist()
+            for p in prompts
+        ]
+        assert got_on == expected
+        # request 1..: prompts 2-4 share the 14-token system prefix with
+        # prompt 1's published slab, the repeat matches n-1, the
+        # partial-prefix prompt matches 10 tokens inside the slab
+        assert on.stats["prefix_hits"] >= 4
+        assert on.stats["prefix_misses"] >= 1
+        assert on.stats["prefix_tokens_saved"] > 0
+        assert on.stats["prefix_cache_bytes"] > 0
+        assert off.stats["prefix_hits"] == 0
+    finally:
+        on.close()
+        off.close()
+
+
+def test_prefix_cache_eviction_under_byte_budget(model_and_params):
+    """A budget that holds ~one slab forces LRU eviction at radix-node
+    granularity; correctness is unaffected (evicted prefixes just prefill
+    in full again)."""
+    import jax.numpy as jnp
+
+    model, params = model_and_params
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(0, 256, 12).tolist() for _ in range(4)]
+    # one slab at bucket 16 is 4KB (2 layers x k+v x [1, 2, 16, 8] f32);
+    # a 5KB budget holds exactly one — every publish evicts the previous
+    b = ContinuousBatcher(
+        model, params, slots=2, max_seq=64, prefill_buckets=(16,),
+        prefix_cache_hbm_bytes=5 << 10, prefix_cache_min_tokens=4,
+    )
+    try:
+        for p in prompts:
+            got = b.generate(p, max_new_tokens=6)
+            exp = np.asarray(
+                model.generate(params, jnp.asarray([p], jnp.int32), 6)
+            )[0].tolist()
+            assert got == exp
+        assert b.stats["prefix_evicted"] >= 2
+        assert b.stats["prefix_cache_bytes"] <= 5 << 10
+        # a re-run of the LAST prompt (still resident) hits
+        hits0 = b.stats["prefix_hits"]
+        assert b.generate(prompts[-1], max_new_tokens=6) == exp
+        assert b.stats["prefix_hits"] == hits0 + 1
+    finally:
+        b.close()
+
+
+def test_prefix_cache_with_speculation_exact(model_and_params):
+    """Prefix reuse composes with speculative decoding: target prefixes
+    come from the pool, draft prefixes are re-derived — output still
+    equals the target's own greedy decode."""
+    import jax.numpy as jnp
+
+    model, params = model_and_params
+    draft = DecoderLM(
+        vocab_size=CFG["vocab_size"], d_model=16, n_layers=1, n_heads=2,
+        n_kv_heads=1, d_ff=32, max_seq=64, dtype="float32",
+    )
+    dparams = draft.init_params(99)
+    b = ContinuousBatcher(
+        model, params, slots=2, max_seq=64, prefill_buckets=(8, 16),
+        steps_per_poll=2, draft_model=draft, draft_params=dparams,
+        speculate_tokens=3,
+        prefix_cache_hbm_bytes=1 << 26, prefix_cache_min_tokens=4,
+    )
+    try:
+        rng = np.random.RandomState(2)
+        shared = rng.randint(0, 256, 9).tolist()
+        for tail_len in (3, 4, 2):
+            p = shared + rng.randint(0, 256, tail_len).tolist()
+            got = b.generate(p, max_new_tokens=6)
+            exp = np.asarray(
+                model.generate(params, jnp.asarray([p], jnp.int32), 6)
+            )[0].tolist()
+            assert got == exp
+        assert b.stats["prefix_hits"] >= 2
+    finally:
+        b.close()
+
+
+def test_prefix_cache_on_mesh(model_and_params):
+    """The prefix pool's slabs inherit the sharded cache layout; splice +
+    suffix prefill stay exact with the KV cache sharded over the mesh."""
+    import jax
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.parallel.mesh import make_mesh
+
+    model, params = model_and_params
+    mesh = make_mesh({"seq": 2, "model": 2}, jax.devices()[:4])
+    b = ContinuousBatcher(
+        model, params, slots=2, max_seq=64, mesh=mesh, shard_cache_seq=True,
+        prefill_buckets=(8, 16),
+        prefix_cache_hbm_bytes=1 << 26, prefix_cache_min_tokens=4,
+    )
+    try:
+        rng = np.random.RandomState(3)
+        shared = rng.randint(0, 256, 10).tolist()
+        for tail_len in (3, 5):
+            p = shared + rng.randint(0, 256, tail_len).tolist()
+            exp = np.asarray(
+                model.generate(params, jnp.asarray([p], jnp.int32), 8)
+            )[0].tolist()
+            assert b.generate(p, max_new_tokens=8) == exp
+        assert b.stats["prefix_hits"] >= 1
+    finally:
+        b.close()
+
+
+def test_generateserver_surfaces_cache_hit_tokens(tmp_path):
+    """cache_hit_tokens rides the unary response (per request, in order)
+    and the stream's final event; the metrics export carries the prefix
+    counters so graph nodes report cache wins."""
+    from seldon_core_tpu.servers.generateserver import GenerateServer
+
+    d = tmp_path / "llm"
+    d.mkdir()
+    (d / "jax_config.json").write_text(
+        json.dumps({"family": "llm", "config": CFG})
+    )
+    s = GenerateServer(
+        model_uri=str(d), slots=2, steps_per_poll=2,
+        prefix_cache_hbm_bytes=1 << 26, prefix_cache_min_tokens=4,
+    )
+    try:
+        prompt = [7, 3, 9, 1, 4, 6, 2, 8]
+        body = {"prompt_tokens": [prompt], "max_new_tokens": 4}
+        first = s.predict(dict(body), [])
+        assert first["cache_hit_tokens"] == [0]  # cold pool
+        second = s.predict(dict(body), [])
+        assert second["tokens"] == first["tokens"]
+        assert second["cache_hit_tokens"] == [len(prompt) - 1]  # n-1 cap
+        handle = s.stream(dict(body))
+        chunks = list(handle.chunks)
+        assert chunks[-1]["done"] is True
+        assert chunks[-1]["cache_hit_tokens"] == len(prompt) - 1
+        keys = {m["key"]: m for m in s.metrics()}
+        assert keys["prefix_cache_hits"]["type"] == "COUNTER"
+        assert keys["prefix_tokens_saved"]["value"] > 0
+        assert keys["gen_prefill_steps"]["type"] == "COUNTER"
+        assert "prefix_cache_bytes" in keys
+        # counters export DELTAS: a second scrape with no traffic reads 0
+        keys2 = {m["key"]: m for m in s.metrics()}
+        assert keys2["prefix_cache_hits"]["value"] == 0
+    finally:
+        if s.batcher:
+            s.batcher.close()
+
+
 def test_mesh_sharded_cache(model_and_params):
     """tp (KV heads over `model`) + seq-sharded cache on the 8-device CPU
     mesh; greedy output equals the single-chip reference."""

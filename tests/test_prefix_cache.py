@@ -1,0 +1,146 @@
+"""Radix prefix index: insert/match/split/evict, LRU ordering, byte
+accounting — the host half of the cross-request prompt KV cache, exercised
+with opaque fake slabs (no JAX; tier-1 CPU)."""
+
+from seldon_core_tpu.serving.prefix_cache import RadixPrefixIndex
+
+
+def _slab(tag):
+    return {"tag": tag}
+
+
+def test_match_empty_index():
+    idx = RadixPrefixIndex(1 << 20)
+    assert idx.match([1, 2, 3]) == (0, None)
+    assert idx.total_bytes == 0
+    assert idx.node_count == 0
+
+
+def test_insert_then_exact_and_partial_match():
+    idx = RadixPrefixIndex(1 << 20)
+    s = _slab("a")
+    assert idx.insert([1, 2, 3, 4], s, 100) == 0
+    # exact
+    depth, slab = idx.match([1, 2, 3, 4])
+    assert (depth, slab) == (4, s)
+    # partial: a prefix of the stored prompt is served by the same slab
+    depth, slab = idx.match([1, 2, 9, 9])
+    assert (depth, slab) == (2, s)
+    # a query extending past the stored prompt matches to its end
+    depth, slab = idx.match([1, 2, 3, 4, 5, 6])
+    assert (depth, slab) == (4, s)
+    # disjoint
+    assert idx.match([7, 8]) == (0, None)
+    assert idx.total_bytes == 100
+
+
+def test_edge_split_creates_shared_interior_node():
+    idx = RadixPrefixIndex(1 << 20)
+    a, b = _slab("a"), _slab("b")
+    idx.insert([1, 2, 3, 4], a, 100)
+    idx.insert([1, 2, 7, 8], b, 100)
+    # shared prefix [1,2] became an interior split node (no slab of its
+    # own) with two slab-bearing children
+    assert idx.node_count == 3
+    assert idx.slab_count == 2
+    assert idx.total_bytes == 200
+    d, s = idx.match([1, 2, 3, 9])
+    assert (d, s) == (3, a)
+    d, s = idx.match([1, 2, 7, 8])
+    assert (d, s) == (4, b)
+    # the shared interior prefix is served by either child's slab
+    d, s = idx.match([1, 2])
+    assert d == 2 and s in (a, b)
+
+
+def test_covered_len_and_republish_noop():
+    idx = RadixPrefixIndex(1 << 20)
+    idx.insert([1, 2, 3], _slab("a"), 50)
+    assert idx.covered_len([1, 2, 3]) == 3
+    assert idx.covered_len([1, 2, 3, 4]) == 3
+    assert idx.covered_len([1, 9]) == 1
+    # re-publishing the exact path neither duplicates bytes nor evicts
+    assert idx.insert([1, 2, 3], _slab("dup"), 50) == 0
+    assert idx.total_bytes == 50
+    assert idx.slab_count == 1
+
+
+def test_lru_eviction_order_and_byte_budget():
+    idx = RadixPrefixIndex(250)
+    a, b, c = _slab("a"), _slab("b"), _slab("c")
+    idx.insert([1, 1, 1], a, 100)
+    idx.insert([2, 2, 2], b, 100)
+    # touch `a` so `b` becomes the LRU victim
+    assert idx.match([1, 1, 1])[1] is a
+    evicted = idx.insert([3, 3, 3], c, 100)
+    assert evicted == 1
+    assert idx.total_bytes == 200
+    assert idx.match([2, 2, 2]) == (0, None)  # b gone
+    assert idx.match([1, 1, 1])[1] is a
+    assert idx.match([3, 3, 3])[1] is c
+
+
+def test_eviction_prunes_leaf_but_keeps_live_subtree():
+    idx = RadixPrefixIndex(1 << 20)
+    a, b = _slab("a"), _slab("b")
+    idx.insert([1, 2, 3, 4], a, 100)
+    idx.insert([1, 2, 7, 8], b, 100)
+    idx.match([1, 2, 7, 8])  # a is now LRU
+    idx.budget_bytes = 150
+    assert idx._evict_to_budget() == 1
+    assert idx.total_bytes == 100
+    # a's branch pruned; b's still matches through the split node
+    assert idx.match([1, 2, 3, 4]) == (0, None) or idx.match([1, 2, 3, 4])[1] is b
+    d, s = idx.match([1, 2, 7, 8])
+    assert (d, s) == (4, b)
+
+
+def test_oversized_slab_evicts_itself():
+    idx = RadixPrefixIndex(10)
+    assert idx.insert([1, 2], _slab("big"), 100) == 1
+    assert idx.total_bytes == 0
+    assert idx.match([1, 2]) == (0, None)
+    assert idx.node_count == 0  # pruned back to empty
+
+
+def test_byte_accounting_across_churn():
+    idx = RadixPrefixIndex(1 << 20)
+    for i in range(10):
+        idx.insert([i, i + 1, i + 2], _slab(i), 10 * (i + 1))
+    assert idx.total_bytes == sum(10 * (i + 1) for i in range(10))
+    idx.budget_bytes = 100
+    idx._evict_to_budget()
+    assert idx.total_bytes <= 100
+    # remaining slabs are the most recently inserted ones (LRU order)
+    assert idx.match([9, 10, 11])[0] == 3
+
+
+def test_match_prefers_smallest_covering_slab():
+    """When several stored prompts cover a shared prefix, the match serves
+    the SHORTEST one — splice cost scales with the donor slab's bucket."""
+    idx = RadixPrefixIndex(1 << 20)
+    long_, short = _slab("long"), _slab("short")
+    idx.insert(list(range(100)), long_, 100)
+    idx.insert(list(range(8)) + [200, 201], short, 10)
+    # query shares only the first 8 tokens; both slabs cover them
+    d, s = idx.match(list(range(8)) + [77])
+    assert d == 8 and s is short
+
+
+def test_interior_slab_survives_deeper_inserts():
+    """A stored short prompt stays matchable after a longer prompt
+    extends its path (the radix split keeps both as slab nodes)."""
+    idx = RadixPrefixIndex(1 << 20)
+    short, long_ = _slab("short"), _slab("long")
+    idx.insert([5, 6], short, 10)
+    idx.insert([5, 6, 7, 8], long_, 10)
+    assert idx.slab_count == 2
+    d, s = idx.match([5, 6])
+    assert (d, s) == (2, short)
+    d, s = idx.match([5, 6, 7, 8, 9])
+    assert (d, s) == (4, long_)
+    # evicting the deep entry keeps the short one serving its prefix
+    idx.budget_bytes = 10
+    idx.match([5, 6])  # short most-recent
+    assert idx._evict_to_budget() == 1
+    assert idx.match([5, 6, 7, 8])[1] is short
