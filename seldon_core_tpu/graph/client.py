@@ -91,12 +91,21 @@ class InProcessClient(UnitClient):
 
 
 class RestClient(UnitClient):
-    """Keep-alive HTTP/1.1 client on raw asyncio streams (no aiohttp in image)."""
+    """Keep-alive HTTP/1.1 client on raw asyncio streams (no aiohttp in image).
 
-    def __init__(self, host: str, port: int, timeout: float = DEFAULT_TIMEOUT_S):
+    ``retries`` is the INNER connection-level attempt count (the
+    reference's hardcoded 3). When a resilience RetryPolicy wraps this
+    client, the executor passes ``retries=1`` so the two layers don't
+    stack multiplicatively (3 policy retries x 3 transport retries = 12
+    connects per request against a down unit, with the breaker seeing
+    only a third of the real failures)."""
+
+    def __init__(self, host: str, port: int, timeout: float = DEFAULT_TIMEOUT_S,
+                 retries: int = RETRIES):
         self.host = host
         self.port = port
         self.timeout = timeout
+        self.retries = max(1, int(retries))
         self._pool: asyncio.Queue = asyncio.Queue()
 
     async def _connection(self):
@@ -192,7 +201,7 @@ class RestClient(UnitClient):
             body = json.dumps(jsonable(message), separators=(",", ":")).encode()
             ctype = "application/json"
         last_err: Optional[Exception] = None
-        for attempt in range(RETRIES):
+        for attempt in range(self.retries):
             try:
                 return await asyncio.wait_for(
                     self._request(path, body, ctype), self.timeout
@@ -204,7 +213,9 @@ class RestClient(UnitClient):
                 logger.warning(
                     "REST %s:%d%s attempt %d failed: %s", self.host, self.port, path, attempt, e
                 )
-        raise UnitCallError(503, f"unit unreachable after {RETRIES} tries: {last_err}")
+        raise UnitCallError(
+            503, f"unit unreachable after {self.retries} tries: {last_err}"
+        )
 
     async def ready(self) -> bool:
         try:
@@ -271,10 +282,32 @@ class GrpcClient(UnitClient):
             )
         return self._stubs[method]
 
+    # gRPC status -> wire status, so retry/breaker classification (and the
+    # engine's error mapping) treat gRPC units exactly like REST ones —
+    # AioRpcError itself carries no int ``status`` and would otherwise
+    # make every resilience policy a silent no-op on GRPC transports
+    _GRPC_STATUS_HTTP = {
+        "UNAVAILABLE": 503,
+        "DEADLINE_EXCEEDED": 504,
+        "RESOURCE_EXHAUSTED": 429,
+        "UNIMPLEMENTED": 501,
+        "INVALID_ARGUMENT": 400,
+        "NOT_FOUND": 404,
+    }
+
     async def call(self, method: str, message: Dict[str, Any]) -> Dict[str, Any]:
+        import grpc
+
         stub, req_cls = self._stub(method)
         proto_req = json_to_proto(message, req_cls)
-        resp = await stub(proto_req, timeout=self.timeout)
+        try:
+            resp = await stub(proto_req, timeout=self.timeout)
+        except grpc.aio.AioRpcError as e:
+            code = e.code()
+            status = self._GRPC_STATUS_HTTP.get(code.name, 500)
+            raise UnitCallError(
+                status, f"gRPC {code.name}: {e.details()}"
+            ) from e
         return proto_to_json(resp)
 
     async def ready(self) -> bool:
@@ -290,7 +323,20 @@ class GrpcClient(UnitClient):
 
 
 class UnitCallError(RuntimeError):
+    """A unit call failed with a wire status.
+
+    The resilience layer (resilience/) attaches two optional fields when
+    it converts its own failures at the executor boundary:
+
+    * ``meta`` — the request's PARTIAL accumulated meta (requestPath up
+      to the failing hop) for 504/503 attribution in error bodies;
+    * ``retry_after_s`` — the estimated wait behind a 429 load shed,
+      surfaced to clients as the ``Retry-After`` header.
+    """
+
     def __init__(self, status: int, info: str):
         super().__init__(info)
         self.status = status
         self.info = info
+        self.meta: Optional[Dict[str, Any]] = None
+        self.retry_after_s: Optional[float] = None

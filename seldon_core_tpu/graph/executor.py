@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import asyncio
 import importlib
+import logging
 import time
 import uuid
 from typing import Any, Dict, List, Optional
@@ -37,20 +38,33 @@ import numpy as np
 from .client import GrpcClient, InProcessClient, RestClient, UnitCallError, UnitClient
 from .spec import PredictorSpec, PredictiveUnit, UnitType, PREPACKAGED_SERVERS
 from .units import BUILTIN_IMPLEMENTATIONS
+from ..resilience import (
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    FaultInjector,
+    HedgePolicy,
+    ResilientClient,
+    RetryPolicy,
+    stamp_meta,
+)
+
+logger = logging.getLogger(__name__)
 
 
 class RequestCtx:
     """Per-request meta accumulator (the reference used ConcurrentHashMaps
     on the bean, PredictiveUnitBean.java:82-96)."""
 
-    __slots__ = ("puid", "tags", "metrics", "routing", "request_path")
+    __slots__ = ("puid", "tags", "metrics", "routing", "request_path", "deadline")
 
-    def __init__(self, puid: str):
+    def __init__(self, puid: str, deadline: Optional[Deadline] = None):
         self.puid = puid
         self.tags: Dict[str, Any] = {}
         self.metrics: List[Dict] = []
         self.routing: Dict[str, int] = {}
         self.request_path: Dict[str, str] = {}
+        self.deadline = deadline
 
     def absorb(self, unit_name: str, response: Dict[str, Any]) -> None:
         meta = response.get("meta") or {}
@@ -133,6 +147,7 @@ class GraphExecutor:
         inprocess_workers: int = 32,
         mesh=None,
         metrics=None,
+        faults: Optional[FaultInjector] = None,
     ):
         """registry: unit name -> user object for INPROCESS units that are
         neither builtin implementations nor prepackaged servers.
@@ -156,10 +171,14 @@ class GraphExecutor:
         # rest-read-timeout, grpc-read-timeout [ms] and
         # grpc-max-message-size [bytes] from pod annotations)
         ann = getattr(spec, "annotations", None) or {}
+        self._ann = ann
         self._rest_timeout = _ann_seconds(ann, "seldon.io/rest-read-timeout", timeout_s)
         self._grpc_timeout = _ann_seconds(ann, "seldon.io/grpc-read-timeout", timeout_s)
         self._grpc_max_message = _ann_int(ann, "seldon.io/grpc-max-message-size")
         self._batching = batching or {}
+        # deterministic fault injection (tests, degraded-mode bench): an
+        # explicit injector wins; else SELDON_FAULTS env config; else None
+        self._faults = faults if faults is not None else FaultInjector.from_env()
         self._mesh = mesh
         self._metrics = metrics
         self._pool = ThreadPoolExecutor(
@@ -176,10 +195,24 @@ class GraphExecutor:
 
     def _make_client(self, unit: PredictiveUnit) -> UnitClient:
         transport = (unit.endpoint.transport or "INPROCESS").upper()
+        retry = RetryPolicy.from_annotations(self._ann, unit.name)
+        breaker = CircuitBreaker.from_annotations(self._ann, unit.name)
+        hedge = HedgePolicy.from_annotations(
+            self._ann, unit.name, unit.endpoint.transport, unit.type
+        )
+        resilient = retry is not None or breaker is not None or hedge is not None
+        # ONLY a configured RetryPolicy replaces the transport's inner
+        # 3-connect loop (else 3 policy retries x 3 connects = 12 attempts
+        # against a down unit). Breaker-only and hedge-only configs keep
+        # the inner loop: removing it with nothing replacing it would turn
+        # transient connect blips the baseline absorbs into client-visible
+        # 503s — the breaker then counts LOGICAL call outcomes, which is
+        # what callers experience.
         if transport in ("REST", "HTTP"):
             client: UnitClient = RestClient(
                 unit.endpoint.service_host, unit.endpoint.service_port,
                 self._rest_timeout,
+                **({"retries": 1} if retry is not None else {}),
             )
         elif transport == "GRPC":
             client = GrpcClient(
@@ -189,12 +222,25 @@ class GraphExecutor:
             )
         else:
             client = InProcessClient(self._resolve_object(unit), executor=self._pool)
+        # fault injection hugs the transport: everything above (batching,
+        # retries, breaker, hedging) sees injected faults exactly where
+        # real unit failures would surface
+        if self._faults is not None:
+            client = self._faults.wrap(client, unit.name)
         if unit.name in self._batching and (unit.type in (None, UnitType.MODEL)):
             from .batching import MicroBatchingClient
 
             client = MicroBatchingClient(
                 client, metrics=self._metrics, unit=unit.name,
                 **self._batching[unit.name],
+            )
+        # resilience policies (annotation-gated, off by default): only
+        # wrap when at least one is active so unconfigured graphs keep
+        # their exact client objects — the happy path must not change
+        if resilient:
+            client = ResilientClient(
+                client, unit=unit.name, retry=retry, breaker=breaker,
+                hedge=hedge, metrics=self._metrics,
             )
         return client
 
@@ -244,18 +290,57 @@ class GraphExecutor:
 
     # -- predict path -------------------------------------------------------
 
-    async def predict(self, message: Dict[str, Any]) -> Dict[str, Any]:
+    async def predict(
+        self, message: Dict[str, Any], deadline: Optional[Deadline] = None
+    ) -> Dict[str, Any]:
         meta_in = message.get("meta") or {}
         puid = meta_in.get("puid") or uuid.uuid4().hex
-        ctx = RequestCtx(puid)
+        ctx = RequestCtx(puid, deadline=deadline)
         ctx.tags.update(meta_in.get("tags") or {})
-        out = await self._get_output(self.root, message, ctx)
+        try:
+            out = await self._get_output(self.root, message, ctx)
+        except UnitCallError as e:
+            # every mid-graph failure gets hop attribution, not just the
+            # resilience-converted ones: a plain 503 from a dead REST unit
+            # is the failure operators most need the partial path for
+            if e.meta is None:
+                e.meta = ctx.to_meta()
+            raise
+        except Exception as e:
+            # resilience-layer failures (DeadlineExceeded 504, BreakerOpen
+            # 503, ShedError 429, InjectedFault ...) carry a wire status;
+            # surface them as UnitCallError with the PARTIAL meta attached
+            # — a 504's requestPath shows exactly how far the walk got
+            status = getattr(e, "status", None)
+            if not isinstance(status, int):
+                raise
+            err = UnitCallError(status, str(e))
+            err.meta = ctx.to_meta()
+            retry_after = getattr(e, "retry_after_s", None)
+            if retry_after is not None:
+                err.retry_after_s = retry_after
+            raise err from e
         out["meta"] = ctx.to_meta()
         return out
 
     async def _call(self, rt: UnitRuntime, method: str, message, ctx: RequestCtx):
         from ..tracing import get_tracer
 
+        deadline = ctx.deadline
+        if deadline is not None:
+            if deadline.expired():
+                raise DeadlineExceeded(
+                    f"deadline exhausted before {rt.name}.{method}"
+                )
+            # re-encode the remaining budget into the hop's meta so
+            # IN-PROCESS components see it via their meta argument (the
+            # generate server's admit-queue shed reads it). Remote hops
+            # are excluded: the Meta proto has no deadline field and
+            # strict ParseDict would reject the key — their budget is
+            # enforced as the clamped call timeout below instead.
+            transport = (rt.unit.endpoint.transport or "INPROCESS").upper()
+            if transport not in ("REST", "HTTP", "GRPC") and method != "aggregate":
+                message = stamp_meta(message, deadline)
         # span per graph hop (reference: async span re-activation,
         # PredictiveUnitBean.java:85-118)
         with get_tracer().span(
@@ -263,7 +348,22 @@ class GraphExecutor:
             tags={"unit": rt.name, "method": method,
                   "transport": rt.unit.endpoint.transport},
         ):
-            response = await rt.client.call(method, message)
+            if isinstance(rt.client, ResilientClient):
+                coro = rt.client.call(method, message, deadline=deadline)
+            else:
+                coro = rt.client.call(method, message)
+            if deadline is None:
+                response = await coro
+            else:
+                # the remaining budget IS the per-call timeout: a slow hop
+                # is cut off at the deadline instead of spending the whole
+                # budget and starving every hop after it
+                try:
+                    response = await asyncio.wait_for(coro, deadline.remaining())
+                except asyncio.TimeoutError:
+                    raise DeadlineExceeded(
+                        f"unit {rt.name}.{method} ran past the request deadline"
+                    ) from None
         ctx.absorb(rt.name, response)
         return response
 
@@ -328,8 +428,21 @@ class GraphExecutor:
     async def _feedback_walk(self, rt: UnitRuntime, feedback: Dict[str, Any], routing):
         try:
             await rt.client.call("send_feedback", feedback)
-        except UnitCallError:
-            pass  # units without the hook are fine (reference: doSendFeedback:288)
+        except Exception as e:
+            # status-less exceptions are engine bugs and must surface
+            if not isinstance(e, UnitCallError) and not isinstance(
+                getattr(e, "status", None), int
+            ):
+                raise
+            # units without the hook are fine (reference: doSendFeedback:288)
+            # — but a real failure silently vanishing makes reward loss
+            # undiagnosable, so count every drop per unit while keeping
+            # the lenient walk
+            if self._metrics is not None:
+                self._metrics.counter_inc(
+                    "seldon_engine_feedback_errors", {"unit": rt.name}
+                )
+            logger.debug("feedback to unit %s dropped: %s", rt.name, e)
         if not rt.children:
             return
         branch = routing.get(rt.name)
@@ -342,11 +455,16 @@ class GraphExecutor:
     # -- readiness ----------------------------------------------------------
 
     async def ready(self) -> bool:
-        """All units reachable (reference: SeldonGraphReadyChecker.java:45-115)."""
+        """All units reachable (reference: SeldonGraphReadyChecker.java:45-115).
+
+        A client whose ready() RAISES (connection refused at startup, DNS
+        not yet resolving) is simply not ready — it must not crash the
+        readiness loop that would otherwise keep polling it to health."""
         checks = await asyncio.gather(
-            *(rt.client.ready() for rt in self._walk(self.root))
+            *(rt.client.ready() for rt in self._walk(self.root)),
+            return_exceptions=True,
         )
-        return all(checks)
+        return all(c is True for c in checks)
 
     def _walk(self, rt: UnitRuntime):
         yield rt

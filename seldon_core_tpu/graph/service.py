@@ -24,8 +24,10 @@ import time
 from typing import Any, Dict, Optional
 
 from ..http_server import HTTPServer, Request, Response, error_body
+from ..metrics import Ewma
 from ..payload import json_to_proto, proto_to_json
 from ..proto import prediction_pb2 as pb
+from ..resilience import DEADLINE_HEADER, Deadline, ShedError, deadline_from_request
 from .client import UnitCallError
 from .engine_metrics import REGISTRY, MetricsRegistry
 from .executor import GraphExecutor
@@ -83,6 +85,7 @@ class EngineApp:
         request_logger: Optional[RequestLogger] = None,
         batching: Optional[Dict[str, Dict]] = None,
         mesh=None,
+        faults=None,
     ):
         if batching is None:
             # annotation-driven config, the reference's feature-flag idiom
@@ -92,7 +95,8 @@ class EngineApp:
             batching = batching_from_annotations(spec)
         self.spec = spec
         self.executor = GraphExecutor(
-            spec, registry=registry, batching=batching, mesh=mesh, metrics=metrics
+            spec, registry=registry, batching=batching, mesh=mesh, metrics=metrics,
+            faults=faults,
         )
         self.metrics = metrics
         self.request_logger = request_logger or RequestLogger()
@@ -116,6 +120,23 @@ class EngineApp:
         self.max_inflight = _ann_int(
             getattr(spec, "annotations", None) or {}, "seldon.io/max-inflight"
         ) or 0
+        # deadline budgets + deadline-aware load shedding: the observed
+        # per-request service time (EWMA) turns queue depth into an
+        # expected wait; a request whose remaining budget is below it is
+        # shed with 429 BEFORE any graph work (shed-before-work).
+        # ``seldon.io/shed-on-deadline: "false"`` opts out.
+        self._ann = getattr(spec, "annotations", None) or {}
+        self._service_ewma = Ewma(alpha=0.1)
+        # shed decisions need a LIVE estimate: only admitted requests
+        # update the EWMA, so a shed-everything state would freeze it and
+        # latch the 429 forever. When nothing has been admitted within
+        # the probe window, one request is let through to re-measure.
+        self._shed_probe_s = 5.0
+        self._last_admit_t = 0.0
+        self.shed_on_deadline = (
+            str(self._ann.get("seldon.io/shed-on-deadline", "true")).lower()
+            != "false"
+        )
 
     def _inflight_add(self, n: int) -> None:
         with self._inflight_lock:
@@ -138,6 +159,27 @@ class EngineApp:
 
     # -- core entrypoints (shared by REST and gRPC fronts) ------------------
 
+    def _shed_wait_s(self, deadline: Optional[Deadline]) -> Optional[float]:
+        """Expected completion time when it already exceeds the request's
+        remaining budget (the shed-before-work decision), else None.
+        Expected time = queue wait (inflight over capacity x observed
+        service time) + one service time; with no max-inflight cap there
+        is no queue — only a request that cannot finish even unqueued
+        (service estimate alone over budget) is shed."""
+        if deadline is None or not self.shed_on_deadline:
+            return None
+        ewma = self._service_ewma.value
+        if ewma <= 0.0:
+            return None  # no estimate yet: never shed blind
+        if time.monotonic() - self._last_admit_t > self._shed_probe_s:
+            # stale estimate (everything recently shed, or idle): admit a
+            # probe so the EWMA re-tracks reality — otherwise a transient
+            # slowdown could latch the deployment into 429s forever
+            return None
+        queue_factor = (self.inflight / self.max_inflight) if self.max_inflight else 0.0
+        est = (queue_factor + 1.0) * ewma
+        return est if est > deadline.remaining() else None
+
     async def predict(self, message: Dict[str, Any],
                       headers: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
         from ..tracing import get_tracer
@@ -152,19 +194,55 @@ class EngineApp:
                 429, f"over capacity: {self.inflight} in-flight "
                 f"(seldon.io/max-inflight={self.max_inflight})"
             )
+        deadline = deadline_from_request(headers, self._ann)
+        est = self._shed_wait_s(deadline)
+        if est is not None:
+            self.metrics.counter_inc("seldon_api_engine_server_rejected", labels)
+            self.metrics.counter_inc("seldon_engine_load_shed", labels)
+            err = UnitCallError(
+                429,
+                f"deadline {deadline.remaining_ms()}ms below estimated "
+                f"completion {est * 1000:.0f}ms — shed before work",
+            )
+            err.retry_after_s = est
+            raise err
+        self._last_admit_t = time.monotonic()
         self._inflight_add(1)
+        completed = False
         try:
             with get_tracer().span(
                 "predictions", tags={"deployment": self.spec.name}, headers=headers
             ):
-                out = await self.executor.predict(message)
+                # positional-compatible call when no deadline is in play
+                # (test doubles and subclasses wrap predict(message))
+                if deadline is None:
+                    out = await self.executor.predict(message)
+                else:
+                    out = await self.executor.predict(message, deadline=deadline)
+            completed = True
         except UnitCallError as e:
             self.metrics.counter_inc("seldon_api_engine_server_errors", labels)
+            if e.status == 504:
+                self.metrics.counter_inc("seldon_engine_deadline_exceeded", labels)
+            elif e.status == 429:
+                # only downstream sheds reach here (the engine-level shed
+                # raised before the try): a batcher admit-queue rejection
+                # must land in the same shed series the gate feeds, or
+                # dashboards undercount the unary hot path
+                self.metrics.counter_inc("seldon_engine_load_shed", labels)
             raise
         finally:
             self._inflight_add(-1)
+            dur = time.perf_counter() - t0
+            # the shed gate's estimate tracks SUCCESSFUL service time
+            # only: a deadline-capped 504 lasts exactly the deadline and
+            # a downstream 429 returns in microseconds — feeding either
+            # in would drag the estimate toward the failure path and
+            # defeat shed-before-work for the very traffic it protects
+            if completed:
+                self._service_ewma.update(dur)
             self.metrics.observe(
-                "seldon_api_engine_server_requests_seconds", time.perf_counter() - t0, labels
+                "seldon_api_engine_server_requests_seconds", dur, labels
             )
         self.metrics.counter_inc("seldon_api_engine_server_requests", labels)
         self.metrics.record_custom((out.get("meta") or {}).get("metrics"), labels)
@@ -239,18 +317,16 @@ class EngineApp:
             "engine-rest", max_body_bytes=max_body, read_timeout_s=read_timeout
         )
 
-        if self.max_inflight:
+        if self.max_inflight or self.shed_on_deadline:
             labels = {"deployment": self.spec.name}
 
             def admission_gate(method: str, path: str, headers) -> Optional[Response]:
                 # shed load from the HEADERS: a rejected request's body is
                 # discarded unparsed (see HTTPServer.early_gate). predict()
                 # re-checks, so gate races only cost a parse, not capacity.
-                if (
-                    method == "POST"
-                    and path == "/api/v0.1/predictions"
-                    and self.inflight >= self.max_inflight
-                ):
+                if method != "POST" or path != "/api/v0.1/predictions":
+                    return None
+                if self.max_inflight and self.inflight >= self.max_inflight:
                     self.metrics.counter_inc(
                         "seldon_api_engine_server_rejected", labels
                     )
@@ -262,6 +338,31 @@ class EngineApp:
                         ),
                         429,
                         headers={"Retry-After": "1"},
+                    )
+                # deadline-aware shed, also from the headers: the budget
+                # rides Seldon-Deadline-Ms, so an unmeetable request is
+                # answered without even reading its body. Only an EXPLICIT
+                # header sheds here (the annotation default is handled in
+                # predict(), which sees every route) — and without one the
+                # hot path skips the deadline parse entirely
+                if headers.get(DEADLINE_HEADER) is None:
+                    return None
+                deadline = deadline_from_request(headers, self._ann)
+                est = self._shed_wait_s(deadline)
+                if est is not None:
+                    self.metrics.counter_inc(
+                        "seldon_api_engine_server_rejected", labels
+                    )
+                    self.metrics.counter_inc("seldon_engine_load_shed", labels)
+                    return Response(
+                        error_body(
+                            429,
+                            f"deadline {deadline.remaining_ms()}ms below "
+                            f"estimated completion {est * 1000:.0f}ms — "
+                            "shed before work",
+                        ),
+                        429,
+                        headers={"Retry-After": str(max(1, int(est + 0.5)))},
                     )
                 return None
 
@@ -289,8 +390,19 @@ class EngineApp:
             try:
                 out = await self.predict(body, headers=req.headers)
             except UnitCallError as e:
-                hdrs = {"Retry-After": "1"} if e.status == 429 else None
-                return Response(error_body(e.status, e.info), e.status, headers=hdrs)
+                hdrs = None
+                if e.status == 429:
+                    after = getattr(e, "retry_after_s", None)
+                    hdrs = {"Retry-After": str(max(1, int(after + 0.5)))
+                            if after else "1"}
+                err = error_body(e.status, e.info)
+                # a mid-graph failure (504 deadline, 503 breaker) reports
+                # the PARTIAL requestPath — how far the walk got — so tail
+                # failures are attributable to a hop, not just a status
+                meta = getattr(e, "meta", None)
+                if meta:
+                    err["meta"] = meta
+                return Response(err, e.status, headers=hdrs)
             if binary:
                 return Response(
                     json_to_proto(out).SerializeToString(),
@@ -379,6 +491,16 @@ class EngineApp:
                 # stream() validates AND submits eagerly — malformed bodies
                 # and closed batchers 400 here, before any bytes go out
                 handle = target.stream(body)
+            except ShedError as e:
+                # admit-queue shed: same 429 + Retry-After contract as the
+                # unary path, decided before any stream bytes exist
+                self.metrics.counter_inc(
+                    "seldon_engine_load_shed", {"deployment": self.spec.name}
+                )
+                return Response(
+                    error_body(429, str(e)), 429,
+                    headers={"Retry-After": str(max(1, int(e.retry_after_s + 0.5)))},
+                )
             except (ValueError, RuntimeError) as e:
                 return Response(error_body(400, str(e)), 400)
 
@@ -450,10 +572,14 @@ class EngineApp:
                 out = await app.predict(proto_to_json(request))
                 return json_to_proto(out)
             except UnitCallError as e:
-                code = (
-                    grpc.StatusCode.RESOURCE_EXHAUSTED
-                    if e.status == 429 else grpc.StatusCode.INTERNAL
-                )
+                if e.status == 429:
+                    code = grpc.StatusCode.RESOURCE_EXHAUSTED
+                elif e.status == 504:
+                    code = grpc.StatusCode.DEADLINE_EXCEEDED
+                elif e.status == 503:
+                    code = grpc.StatusCode.UNAVAILABLE
+                else:
+                    code = grpc.StatusCode.INTERNAL
                 await context.abort(code, e.info)
 
         async def feedback_rpc(request: pb.Feedback, context):

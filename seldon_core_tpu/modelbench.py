@@ -89,10 +89,12 @@ class EngineHarness:
         name: str = "bench",
         batching: Optional[Dict[str, Any]] = None,
         annotations: Optional[Dict[str, str]] = None,
+        faults=None,
     ):
         # ``batching`` is ONE unit's MicroBatcher kwargs (max_batch/
         # timeout_ms/...); it is wrapped as {unit_name: batching} for
-        # EngineApp, which takes the per-unit mapping form.
+        # EngineApp, which takes the per-unit mapping form. ``faults`` is
+        # a resilience.FaultInjector for degraded-mode scenarios.
         from .graph.service import EngineApp
         from .graph.spec import PredictorSpec, default_predictor
 
@@ -109,6 +111,7 @@ class EngineHarness:
             spec,
             registry={unit_name: component},
             batching={unit_name: batching} if batching else None,
+            faults=faults,
         )
         self.http_port = free_port()
         self.grpc_port = free_port()
@@ -1109,6 +1112,179 @@ def bench_generate_shared_prefix(
     return result
 
 
+def bench_degraded(
+    root: str,
+    seconds: float = 6.0,
+    concurrency: int = 8,
+    prompt_len: int = 32,
+    max_new_tokens: int = 32,
+    slots: int = 8,
+    steps_per_poll: int = 8,
+    config: Optional[Dict[str, Any]] = None,
+    cache_seq: Optional[int] = None,
+    error_rate: float = 0.3,
+    latency_ms: float = 20.0,
+    retries: int = 3,
+    label: str = "llm-degraded",
+) -> Dict[str, Any]:
+    """Degraded-mode serving: ONE slow+flaky graph node (the generate
+    MODEL unit, fault-injected with ``error_rate`` errors + ``latency_ms``
+    added latency per attempt), measured with the circuit breaker ON vs
+    OFF on otherwise identical servers — both runs in one entry, same
+    fault seed, so the comparison is same-session and same-schedule.
+
+    Per mode: success rate (requests completing despite the faults, via
+    the per-unit retry policy), throughput over completed requests, and
+    latency percentiles. 503/429 answers (exhausted retries, or the
+    breaker failing fast while open) count as rejections, not errors —
+    the engine answered; the load generator backs off like a real client.
+    Greedy outputs of the two modes must be byte-identical: resilience
+    knobs gate admission and routing, never computation."""
+    import http.client
+
+    from .resilience import FaultInjector
+    from .servers.generateserver import GenerateServer
+
+    cfg = dict(config or {})
+    cfg.setdefault("max_seq", max(256, 2 * (prompt_len + max_new_tokens)))
+    model_dir = write_model_dir(root, "llm", cfg)
+    prompt = list(range(1, prompt_len + 1))
+    body = json.dumps(
+        {
+            "jsonData": {
+                "prompt_tokens": [prompt],
+                "max_new_tokens": max_new_tokens,
+                "temperature": 0.0,
+            }
+        }
+    ).encode()
+    headers = {"Content-Type": "application/json", "Connection": "keep-alive"}
+    fault_rules = [
+        {
+            "unit": "model", "method": "predict",
+            "error_rate": error_rate, "latency_ms": latency_ms,
+        }
+    ]
+
+    def run_mode(breaker_on: bool) -> Tuple[Dict[str, Any], List[int]]:
+        component = GenerateServer(
+            model_uri=model_dir, slots=slots, steps_per_poll=steps_per_poll,
+            **({"max_seq": cache_seq} if cache_seq else {}),
+            warmup_prompt_lens=[prompt_len],
+            warmup_max_new_tokens=max_new_tokens,
+        )
+        component.load()
+        annotations = {
+            "seldon.io/retries": str(retries),
+            "seldon.io/retry-backoff-ms": "5",
+        }
+        if breaker_on:
+            # tuned so a 30%-flaky (not dead) node keeps serving: the
+            # trip threshold sits ~3 sigma above the fault rate for the
+            # window size, and min-calls = window keeps a freshly-closed
+            # breaker from re-tripping on its first few samples
+            annotations.update(
+                {
+                    "seldon.io/breaker": "true",
+                    "seldon.io/breaker-window": "32",
+                    "seldon.io/breaker-error-rate": "0.6",
+                    "seldon.io/breaker-min-calls": "32",
+                    "seldon.io/breaker-open-ms": "250",
+                }
+            )
+        injector = FaultInjector(fault_rules, seed=11)
+        # byte-identity probe: ONE direct greedy pass before any traffic
+        # (deterministic — the threaded loop must not race to capture it)
+        greedy_tokens: List[int] = component.predict(
+            {"prompt_tokens": [prompt], "max_new_tokens": max_new_tokens,
+             "temperature": 0.0}, [],
+        )["tokens"][0]
+        harness = EngineHarness(
+            component, annotations=annotations, faults=injector,
+        ).start()
+        port = harness.http_port
+        mismatches = [0]
+
+        def make_call():
+            conn = http.client.HTTPConnection("127.0.0.1", port)
+
+            def call() -> int:
+                conn.request("POST", "/api/v0.1/predictions", body, headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+                if resp.status in (429, 503):
+                    # answered-from-policy (shed / retries exhausted /
+                    # breaker open): the client backs off and retries
+                    raise Backoff(0.02)
+                if resp.status != 200:
+                    raise RuntimeError(
+                        f"degraded bench HTTP {resp.status}: {payload[:200]}"
+                    )
+                toks = json.loads(payload)["jsonData"]["tokens"][0]
+                # every served response under faults+retries+breaker must
+                # equal the fault-free greedy reference (int += is atomic
+                # enough under the GIL for a diagnostic counter)
+                if toks != greedy_tokens:
+                    mismatches[0] += 1
+                return len(toks) - prompt_len
+
+            return call
+
+        try:
+            stats = closed_loop(make_call, seconds, concurrency, warmup_calls=1)
+        finally:
+            harness.stop()
+            if component.batcher is not None:
+                component.batcher.close()
+        rejects = stats.get("admission_rejects", 0)
+        stats["tokens_per_s"] = stats.pop("rows_per_s")
+        stats["success_rate"] = round(
+            stats["requests"] / max(stats["requests"] + rejects, 1), 4
+        )
+        stats["breaker"] = "on" if breaker_on else "off"
+        # device-work accounting: unit attempts actually made (an open
+        # breaker's fail-fast answers make none) and injected error count
+        attempts = injector._calls.get(("model", "predict"), 0)
+        stats["unit_attempts"] = attempts
+        stats["injected_errors"] = injector.injected["errors"]
+        stats["attempts_per_request"] = round(
+            attempts / max(stats["requests"] + rejects, 1), 3
+        )
+        stats["greedy_mismatches"] = mismatches[0]
+        return stats, greedy_tokens
+
+    on, greedy_on = run_mode(True)
+    off, greedy_off = run_mode(False)
+    return {
+        "model": label,
+        "transport": "engine REST, continuous batching, fault-injected",
+        "scenario": (
+            f"MODEL unit with {error_rate:.0%} injected errors + "
+            f"{latency_ms:.0f}ms added latency, {retries} retries"
+        ),
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new_tokens,
+        "slots": slots,
+        # headline = breaker-on numbers; the breaker-off twin alongside
+        "tokens_per_s": on["tokens_per_s"],
+        "req_per_s": on["req_per_s"],
+        "requests": on["requests"],
+        "p50_ms": on["p50_ms"],
+        "p99_ms": on["p99_ms"],
+        "success_rate": on["success_rate"],
+        "breaker_on": on,
+        "breaker_off": off,
+        # identical across modes AND every served response in both fault
+        # runs matched the fault-free greedy reference
+        "greedy_identical": (
+            bool(greedy_on)
+            and greedy_on == greedy_off
+            and on["greedy_mismatches"] == 0
+            and off["greedy_mismatches"] == 0
+        ),
+    }
+
+
 def run_model_tier(
     seconds: float = 8.0,
     tiny: bool = False,
@@ -1166,6 +1342,15 @@ def run_model_tier(
                     "n_kv_heads": 2, "d_ff": 128, "max_seq": 64,
                 },
                 peak=peak,
+            )
+            # degraded-mode harness proof (chip runs the llm_1b variant)
+            results["llm_degraded"] = bench_degraded(
+                root, seconds=seconds, concurrency=2, prompt_len=4,
+                max_new_tokens=8, slots=2, latency_ms=5.0,
+                config={
+                    "vocab_size": 256, "d_model": 64, "n_layers": 2,
+                    "n_heads": 2, "n_kv_heads": 2, "d_ff": 128, "max_seq": 64,
+                },
             )
         else:
             # the raw-image path is transfer-bound and the most sensitive
@@ -1424,6 +1609,18 @@ def run_model_tier(
                 seconds=max(seconds, 10.0), concurrency=16,
                 slots=16, steps_per_poll=16, cache_seq=640,
                 config=big_cfg, peak=peak, hbm_gb_s=hbm,
+            )
+            # degraded-mode serving at flagship scale: the generate unit
+            # made slow+flaky (30% injected errors, +20ms per attempt),
+            # 3-retry policy, breaker on vs off in one entry — the tail
+            # behavior a unit failure actually produces under load, and
+            # the greedy byte-identity proof that resilience knobs never
+            # change computed outputs
+            results["llm_1b_degraded"] = bench_degraded(
+                root, label="llm-1.26b-degraded",
+                seconds=max(seconds, 8.0), concurrency=8, prompt_len=128,
+                max_new_tokens=64, slots=8, steps_per_poll=16,
+                cache_seq=256, config=big_cfg,
             )
             # long-context serving, small decoder: the fast-step regime
             # where the per-burst host sync is the enemy — spp 32 buys a

@@ -1,0 +1,41 @@
+"""Graph-native resilience: deadline budgets, retries, circuit breakers,
+hedged calls, load shedding, and deterministic fault injection.
+
+The reference Seldon Core owned only the happy path — retries, timeouts
+and outlier ejection were Istio/K8s sidecar concerns. The TPU-native
+engine has no sidecar (ICI/DCN *is* the pod network), so the data plane
+owns tail behavior itself. Everything here is annotation-gated and off by
+default: an unconfigured graph keeps its exact pre-existing clients and
+byte-identical outputs.
+
+Wiring (see graph/executor.py): per unit,
+
+    base transport client
+      -> FaultyClient        (only when SELDON_FAULTS / faults= target it)
+      -> MicroBatchingClient (only when micro-batching is on)
+      -> ResilientClient     (only when retries/breaker/hedge configured)
+
+with the per-request Deadline carried on RequestCtx and enforced as every
+hop's call timeout, and load shedding at the engine's admission gate and
+the continuous batcher's admit queue (shed-before-work).
+"""
+
+from .breaker import BreakerOpen, CircuitBreaker  # noqa: F401
+from .deadline import (  # noqa: F401
+    ANNOTATION_DEADLINE_MS,
+    DEADLINE_HEADER,
+    Deadline,
+    DeadlineExceeded,
+    deadline_from_request,
+    deadline_s_from_meta,
+    stamp_meta,
+)
+from .faults import FaultInjector, FaultRule, FaultyClient, InjectedFault  # noqa: F401
+from .policy import (  # noqa: F401
+    HedgePolicy,
+    IDEMPOTENT_METHODS,
+    ResilientClient,
+    RetryPolicy,
+    ShedError,
+    is_retryable,
+)

@@ -1,0 +1,169 @@
+"""Deterministic fault injection for graph units.
+
+Wraps any ``UnitClient`` to inject latency, errors, and hangs per
+unit+method, driven by config (or the ``SELDON_FAULTS`` env var) and a
+seed. Every random draw comes from a per-(unit, method) ``random.Random``
+stream seeded from ``(seed, unit, method)``, so a fault schedule is
+reproducible regardless of which other units run concurrently — the
+property that makes retry/breaker/deadline behavior testable hermetically
+and bench degraded-mode scenarios repeatable.
+
+Rule fields (all optional):
+
+  unit          unit name or "*" (default "*")
+  method        predict/transform_input/... or "*" (default "*")
+  fail_first    fail the first N calls outright (deterministic ramps)
+  error_rate    probability of an injected error per call
+  error_status  status of injected errors (default 503, a retryable
+                transport-style failure; 500 models an app error)
+  latency_ms    added latency per call (plus uniform jitter_ms)
+  jitter_ms     uniform extra latency in [0, jitter_ms)
+  hang_rate     probability of hanging for hang_s (default 3600 — only a
+                deadline or transport timeout gets the caller out)
+
+Env wiring: ``SELDON_FAULTS`` holds the JSON config
+(``{"seed": 7, "rules": [{...}]}``) or ``@/path/to/faults.json``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import os
+import random
+from typing import Dict, List, Optional, Tuple
+
+
+class InjectedFault(RuntimeError):
+    """An injected unit failure; carries a wire status like UnitCallError
+    so the resilience layers (and the engine's error mapping) treat it
+    exactly like the real failure it models."""
+
+    def __init__(self, status: int, info: str):
+        super().__init__(info)
+        self.status = status
+        self.info = info
+
+
+@dataclasses.dataclass
+class FaultRule:
+    unit: str = "*"
+    method: str = "*"
+    fail_first: int = 0
+    error_rate: float = 0.0
+    error_status: int = 503
+    latency_ms: float = 0.0
+    jitter_ms: float = 0.0
+    hang_rate: float = 0.0
+    hang_s: float = 3600.0
+
+    def matches(self, unit: str, method: str) -> bool:
+        return self.unit in ("*", unit) and self.method in ("*", method)
+
+
+class FaultInjector:
+    def __init__(self, rules, seed: int = 0):
+        self.seed = int(seed)
+        self.rules: List[FaultRule] = [
+            r if isinstance(r, FaultRule) else FaultRule(**r) for r in rules
+        ]
+        self._rngs: Dict[Tuple[str, str], random.Random] = {}
+        self._calls: Dict[Tuple[str, str], int] = {}
+        # observability for tests/bench: what actually got injected
+        self.injected = {"errors": 0, "hangs": 0, "latency_calls": 0}
+
+    @classmethod
+    def from_env(cls, env=None) -> Optional["FaultInjector"]:
+        blob = (env or os.environ).get("SELDON_FAULTS")
+        if not blob:
+            return None
+        if blob.startswith("@"):
+            with open(blob[1:]) as f:
+                blob = f.read()
+        cfg = json.loads(blob)
+        return cls(cfg.get("rules") or [], seed=cfg.get("seed", 0))
+
+    def _rng(self, unit: str, method: str) -> random.Random:
+        key = (unit, method)
+        rng = self._rngs.get(key)
+        if rng is None:
+            rng = self._rngs[key] = random.Random(f"{self.seed}/{unit}/{method}")
+        return rng
+
+    def wraps(self, unit: str) -> bool:
+        return any(r.unit in ("*", unit) for r in self.rules)
+
+    def wrap(self, client, unit: str):
+        """FaultyClient around ``client`` when any rule targets ``unit``,
+        else the client unchanged (zero overhead off the fault path)."""
+        return FaultyClient(client, unit, self) if self.wraps(unit) else client
+
+    async def perturb(self, unit: str, method: str) -> None:
+        """Apply every matching rule before the real call: deterministic
+        fail-first ramp, then hang, then latency, then error — each draw
+        consumed from the (unit, method) stream in a fixed order so one
+        rule's draws never shift another's."""
+        # ONE call-count tick per perturb, not per matching rule: with two
+        # rules matching the same unit+method, a per-rule tick would halve
+        # every fail_first ramp and double the attempt accounting
+        key = (unit, method)
+        n = self._calls.get(key, 0)
+        self._calls[key] = n + 1
+        for rule in self.rules:
+            if not rule.matches(unit, method):
+                continue
+            rng = self._rng(unit, method)
+            if n < rule.fail_first:
+                self.injected["errors"] += 1
+                raise InjectedFault(
+                    rule.error_status,
+                    f"injected fault: {unit}.{method} call {n} "
+                    f"(fail_first={rule.fail_first})",
+                )
+            if rule.hang_rate and rng.random() < rule.hang_rate:
+                self.injected["hangs"] += 1
+                await asyncio.sleep(rule.hang_s)
+            if rule.latency_ms or rule.jitter_ms:
+                self.injected["latency_calls"] += 1
+                extra = rule.jitter_ms * rng.random() if rule.jitter_ms else 0.0
+                await asyncio.sleep((rule.latency_ms + extra) / 1000.0)
+            if rule.error_rate and rng.random() < rule.error_rate:
+                self.injected["errors"] += 1
+                raise InjectedFault(
+                    rule.error_status,
+                    f"injected fault: {unit}.{method} "
+                    f"(error_rate={rule.error_rate})",
+                )
+
+
+class FaultyClient:
+    """UnitClient wrapper that consults the injector before delegating."""
+
+    def __init__(self, inner, unit: str, injector: FaultInjector):
+        self.inner = inner
+        self.unit = unit
+        self.injector = injector
+
+    @property
+    def user_object(self):
+        return getattr(self.inner, "user_object", None)
+
+    def accepts_device_arrays(self) -> bool:
+        # keep the micro-batcher's device fast path visible through the
+        # wrap: a fault-injected bench must measure the same data path
+        probe = getattr(self.inner, "accepts_device_arrays", None)
+        return bool(probe is not None and probe())
+
+    def device_put(self, arr):
+        return self.inner.device_put(arr)
+
+    async def call(self, method: str, message):
+        await self.injector.perturb(self.unit, method)
+        return await self.inner.call(method, message)
+
+    async def ready(self) -> bool:
+        return await self.inner.ready()
+
+    async def close(self) -> None:
+        await self.inner.close()

@@ -32,6 +32,12 @@ Model URI layout: same ``jax_config.json`` as jaxserver with
                      ``cache_hit_tokens``.
     prefix_cache_min_tokens
                      shortest prefix worth caching or reusing (default 16)
+    admit_queue_limit
+                     max queued-not-admitted requests before submits are
+                     shed with 429 (0 = uncapped). Queued requests with a
+                     deadline (meta ``deadlineMs``) are additionally shed
+                     when the queue's expected wait exceeds it — see
+                     docs/operate.md "Resilience"
 
 Request (jsonData)::
 
@@ -50,6 +56,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
 
 from ..user_model import SeldonComponent
@@ -83,6 +90,7 @@ class GenerateServer(SeldonComponent):
         draft_uri: Optional[str] = None,
         prefix_cache_hbm_bytes: int = 0,
         prefix_cache_min_tokens: int = 16,
+        admit_queue_limit: int = 0,
         warmup_prompt_lens: Optional[Sequence[int]] = None,
         warmup_max_new_tokens: int = 0,
         **kwargs,
@@ -102,6 +110,7 @@ class GenerateServer(SeldonComponent):
         self._draft_uri = draft_uri
         self._prefix_cache_hbm_bytes = int(prefix_cache_hbm_bytes)
         self._prefix_cache_min_tokens = int(prefix_cache_min_tokens)
+        self._admit_queue_limit = int(admit_queue_limit)
         # cumulative scheduler stats ship as true counters (deltas)
         # through Meta.metrics
         from ..metrics import CounterDeltas
@@ -205,6 +214,7 @@ class GenerateServer(SeldonComponent):
             speculate_tokens=self._speculate_tokens,
             prefix_cache_hbm_bytes=self._prefix_cache_hbm_bytes,
             prefix_cache_min_tokens=self._prefix_cache_min_tokens,
+            admit_queue_limit=self._admit_queue_limit,
         )
         if self._warmup_prompt_lens:
             # compile-before-listen: every prefill/insert/burst variant the
@@ -264,8 +274,58 @@ class GenerateServer(SeldonComponent):
                     "generate expects jsonData {prompt_tokens|prompt, ...} or strData"
                 )
         token_lists, text_mode, kw = self._parse_prompts(body)
-        futures = [self.batcher.submit(toks, **kw) for toks in token_lists]
-        results = [f.result(timeout=600.0) for f in futures]
+        # remaining deadline budget rides the request meta (stamped per
+        # hop by the graph executor): the batcher sheds the submit when
+        # its admit queue cannot meet it (ShedError -> engine 429)
+        from ..resilience import DeadlineExceeded, deadline_s_from_meta
+
+        deadline_s = deadline_s_from_meta(meta)
+        import time as _time
+
+        expires_at = (
+            _time.monotonic() + deadline_s if deadline_s is not None else None
+        )
+        futures = []
+        try:
+            for toks in token_lists:
+                futures.append(
+                    self.batcher.submit(toks, deadline_s=deadline_s, **kw)
+                )
+        except Exception:
+            # a multi-prompt request is all-or-nothing: whatever failed a
+            # later submit (shed 429, over-long prompt 400, closed
+            # batcher), cancel the prompts already queued so the error
+            # never leaves orphaned device work decoding for a response
+            # nobody will collect
+            for f in futures:
+                f.cancel()
+            raise
+        try:
+            results = []
+            for f in futures:
+                # wait no longer than the request's own budget: the 504 is
+                # the engine's answer either way, and an abandoned wait
+                # would pin this worker thread (and the decode lane) for
+                # the full 600s fallback
+                timeout = 600.0
+                if expires_at is not None:
+                    timeout = max(0.001, expires_at - _time.monotonic())
+                results.append(f.result(timeout=timeout))
+        except FuturesTimeout:
+            for f in futures:
+                f.cancel()  # reclaims queued slots and mid-decode lanes
+            if deadline_s is None:
+                raise  # the 600s safety fallback fired, not a budget
+            raise DeadlineExceeded(
+                f"generate ran past its {deadline_s * 1000:.0f}ms budget"
+            )
+        except Exception:
+            # one prompt failed mid-flight (admit error set on its
+            # future): all-or-nothing here too — reclaim the siblings
+            # before surfacing the error
+            for f in futures:
+                f.cancel()
+            raise
         out: Dict[str, Any] = {"tokens": results}
         if text_mode:
             out["text"] = [
@@ -341,6 +401,8 @@ class GenerateServer(SeldonComponent):
             delta("gen_prefill_tokens", s["prefill_tokens"]),
             delta("gen_decode_steps", s["steps"]),
         ]
+        if s.get("shed"):
+            out.append(delta("gen_shed_total", s["shed"]))
         if self.batcher._prefix_index is not None:
             out.extend([
                 delta("prefix_cache_hits", s["prefix_hits"]),

@@ -43,10 +43,12 @@ config 5 "Llama-2-7B generate() with engine-side dynamic batching").
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import logging
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -118,6 +120,7 @@ class ContinuousBatcher:
         speculate_tokens: int = 4,
         prefix_cache_hbm_bytes: int = 0,
         prefix_cache_min_tokens: int = 16,
+        admit_queue_limit: int = 0,
     ):
         import jax
         import jax.numpy as jnp
@@ -157,6 +160,15 @@ class ContinuousBatcher:
         ) or (self.max_seq,)
 
         self._queue: "queue.Queue[GenRequest]" = queue.Queue()
+        # -- admit-queue load shedding (shed-before-work) -----------------
+        # hard cap on queued-not-admitted requests (0 = uncapped), plus a
+        # deadline-aware shed: completion timestamps of finished requests
+        # give an observed service rate, and a submit whose expected queue
+        # wait (depth / rate) already exceeds its remaining deadline is
+        # rejected NOW — before its prefill occupies the device for a
+        # response nobody will wait for
+        self.admit_queue_limit = max(0, int(admit_queue_limit))
+        self._finish_times = collections.deque(maxlen=32)
         self._active: Dict[int, _Slot] = {}
         # device copies of the lane masks; re-uploaded only when lane
         # membership changes (every host->device transfer pays the
@@ -196,6 +208,7 @@ class ContinuousBatcher:
             "prefill_steps": 0, "prefill_tokens": 0,
             "prefix_hits": 0, "prefix_misses": 0, "prefix_evicted": 0,
             "prefix_tokens_saved": 0, "prefix_cache_bytes": 0,
+            "shed": 0,
         }
 
         # -- device state ----------------------------------------------------
@@ -646,6 +659,48 @@ class ContinuousBatcher:
 
     # -- public api ----------------------------------------------------------
 
+    def observed_rate(self) -> Optional[float]:
+        """Finished requests per second over the recent completion window
+        (None until two completions exist — never shed blind)."""
+        times = list(self._finish_times)
+        if len(times) < 2:
+            return None
+        span = times[-1] - times[0]
+        if span <= 0:
+            return None
+        return (len(times) - 1) / span
+
+    def _shed_check(self, deadline_s: Optional[float]) -> None:
+        """Admit-queue shedding, BEFORE the request costs any device work:
+        an explicit queue cap, and the deadline-aware rule (expected queue
+        wait = depth / observed completion rate > remaining budget)."""
+        depth = self._queue.qsize()
+        if self.admit_queue_limit and depth >= self.admit_queue_limit:
+            from ..resilience import ShedError
+
+            rate = self.observed_rate()
+            self.stats["shed"] += 1
+            raise ShedError(
+                f"admit queue full ({depth} >= {self.admit_queue_limit})",
+                retry_after_s=(depth / rate) if rate else 1.0,
+            )
+        if deadline_s is None or depth == 0:
+            return
+        rate = self.observed_rate()
+        if rate is None:
+            return
+        est_wait = depth / rate
+        if est_wait > deadline_s:
+            from ..resilience import ShedError
+
+            self.stats["shed"] += 1
+            raise ShedError(
+                f"deadline {deadline_s * 1000:.0f}ms below estimated queue "
+                f"wait {est_wait * 1000:.0f}ms ({depth} queued at "
+                f"{rate:.2f} req/s) — shed before work",
+                retry_after_s=est_wait,
+            )
+
     def submit(
         self,
         tokens: Sequence[int],
@@ -654,6 +709,7 @@ class ContinuousBatcher:
         eos_id: Optional[int] = None,
         seed: int = 0,
         on_tokens=None,
+        deadline_s: Optional[float] = None,
     ) -> Future:
         if self._stop.is_set():
             raise RuntimeError("batcher is closed")
@@ -661,6 +717,7 @@ class ContinuousBatcher:
             raise ValueError("empty prompt")
         if len(tokens) >= self.max_seq:
             raise ValueError(f"prompt of {len(tokens)} exceeds max_seq {self.max_seq}")
+        self._shed_check(deadline_s)
         budget = self.max_seq - len(tokens)
         req = GenRequest(
             tokens=list(map(int, tokens)),
@@ -1075,6 +1132,9 @@ class ContinuousBatcher:
         if not s.request.future.done():
             s.request.future.set_result(s.request.tokens + s.emitted)
         self.stats["finished"] += 1
+        # completion timestamp feeds the observed service rate that the
+        # admit-queue shed uses for its expected-wait estimate
+        self._finish_times.append(time.monotonic())
 
     def _finish(self, slot: int) -> None:
         s = self._active.pop(slot)
