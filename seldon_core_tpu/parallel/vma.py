@@ -27,7 +27,10 @@ def pvary(x, axes):
 def vma_of(x) -> frozenset:
     import jax
 
-    aval = jax.typeof(x)
+    if hasattr(jax, "typeof"):
+        aval = jax.typeof(x)
+    else:  # jax < 0.6: no jax.typeof; core.get_aval is the same lookup
+        aval = jax.core.get_aval(x)
     return getattr(aval, "vma", frozenset())
 
 

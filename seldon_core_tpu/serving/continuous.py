@@ -34,6 +34,29 @@ calls"). This scheduler closes that gap the TPU way:
   The attention READ is bounded by a static bucket covering the deepest
   lane's position (host-tracked, no sync) — decode cost follows the live
   prefix, not the allocated cache.
+* **Depth-aware sub-bursts** (``depth_groups``): at mixed prefix depths a
+  single burst bounds EVERY lane's read by the deepest lane's bucket, so
+  shallow lanes stream (and mask away) slab they never attend to. With
+  grouping on, live lanes are partitioned by attention bucket and the
+  poll dispatches one gathered sub-burst per group — each group's cache
+  read narrows to its OWN bucket. A sub-burst gathers its lanes' cache
+  prefixes into a ``[Gb, KV, bucket, Dh]`` slab (Gb = pow2 group-size
+  bucket, so one executable exists per (Gb, bucket) pair), runs the same
+  fused step scan, and scatters state back; a cost model (extra
+  sub-burst ~= one more param read per step vs. the modeled KV-read
+  saving) merges groups that aren't worth splitting. Groups are
+  re-planned every poll, so lanes re-pack automatically as their
+  prefixes deepen across bucket boundaries.
+* **Chunked prefill interleave** (``prefill_chunk``): a long-prompt
+  admission no longer stalls every decode lane for a full prompt-length
+  forward. The prompt is split into ``prefill_chunk``-token slices
+  executed BETWEEN decode polls (``DecoderLM.prefill_chunk`` extends a
+  staging slab without re-reading the prefix — the slab lives OUTSIDE
+  the decode cache, so in-flight bursts never see a half-built prompt
+  and the decode executables stay bit-identical to the whole-prompt
+  path); only the final slice samples the first token, and the finished
+  slab goes through the ordinary lane insert. Decode keeps its burst
+  cadence while long prompts trickle in.
 * With a mesh, params/cache shard over the ``model`` axis (KV heads) and
   optionally the ``seq`` axis (cache length) — long prompts span ICI.
 
@@ -76,6 +99,23 @@ class GenRequest:
 
 
 @dataclasses.dataclass
+class _ChunkJob:
+    """A long-prompt admission mid-chunked-prefill: the slot is reserved
+    but not yet decoding; one chunk advances per scheduler poll. The
+    prompt K/V accumulate in a STAGING slab (cache_one layout) outside
+    the decode cache, spliced into the lane only when complete."""
+
+    request: GenRequest
+    slot: int
+    next_start: int  # absolute position of the next chunk's first token
+    slab: Any  # {"k","v"} stacked [L, 1, KV, bucket, Dh]
+    bucket: int
+    # prompt tokens already covered by a spliced prefix-cache slab
+    # (chunking then starts at the splice point)
+    hit_tokens: int = 0
+
+
+@dataclasses.dataclass
 class _Slot:
     request: GenRequest
     emitted: List[int] = dataclasses.field(default_factory=list)
@@ -103,6 +143,11 @@ class ContinuousBatcher:
     generated token list. A single scheduler thread owns the device loop.
     """
 
+    # floor for attn_bucket: cache reads must stay MXU/VPU-tileable on
+    # TPU. Tests lower it (via the class attribute) to exercise depth
+    # grouping at tiny cache lengths on CPU.
+    MIN_ATTN_BUCKET = 64
+
     def __init__(
         self,
         model,
@@ -121,6 +166,9 @@ class ContinuousBatcher:
         prefix_cache_hbm_bytes: int = 0,
         prefix_cache_min_tokens: int = 16,
         admit_queue_limit: int = 0,
+        depth_groups: int = 0,
+        depth_group_split_bytes: Optional[int] = None,
+        prefill_chunk: int = 0,
     ):
         import jax
         import jax.numpy as jnp
@@ -143,9 +191,19 @@ class ContinuousBatcher:
         self.pipeline_depth = max(1, int(pipeline_depth))
         # attention-read bucket granularity: the per-burst cache read is
         # rounded up to a multiple of this. Smaller = tighter KV reads at
-        # deep prefixes but more burst executables (one per bucket); must
-        # keep the read MXU/VPU-tileable, so 64 is the practical floor
-        self.attn_bucket = max(64, int(attn_bucket))
+        # deep prefixes but more burst executables (one per bucket); 64
+        # is the practical TPU floor (the read must stay MXU/VPU-
+        # tileable), enforced via the MIN_ATTN_BUCKET class attribute so
+        # production configs keep the historical clamp while CPU tests
+        # lower it to exercise the depth-grouping machinery at tiny
+        # cache lengths
+        self.attn_bucket = max(type(self).MIN_ATTN_BUCKET, int(attn_bucket))
+        # depth-aware sub-bursts: max sub-bursts per poll (0/1 = off —
+        # the single-burst path is byte-identical to pre-grouping code)
+        self.depth_groups = max(0, int(depth_groups))
+        # chunked prefill: prompt tokens per interleaved prefill slice
+        # (0 = off; prompts whose bucket fits one chunk never chunk)
+        self.prefill_chunk = max(0, int(prefill_chunk))
         # speculative decoding: a cheap draft proposes `speculate_tokens`
         # tokens per round and ONE target chunk forward verifies them.
         # Exact for any draft: greedy lanes emit the target's argmax
@@ -202,14 +260,36 @@ class ContinuousBatcher:
         # prefill_steps/prefill_tokens split device prefill work out from
         # decode steps (the prefix cache's win shows up as prefill_tokens
         # dropping while prefix_tokens_saved climbs)
+        # burst_reads/burst_read_bytes: modeled HBM read traffic of
+        # dispatched decode (sub)bursts — params once per step plus each
+        # lane-row's bucketed KV read (spec rounds are excluded: their
+        # draft/verify byte model lives in modelbench's round-true MBU).
+        # group_* feed the depth-grouping occupancy gauge: real lanes vs
+        # pow2-bucket pad rows across grouped sub-bursts.
+        # lane_steps = sum over dispatched (sub)bursts of k x rows — the
+        # occupancy denominator. With grouping OFF it equals steps x
+        # slots; with grouping ON a sub-burst contributes only its
+        # gathered rows, so occupancy stays comparable across configs
+        # (steps alone would halve apparent occupancy whenever a poll
+        # splits into two sub-bursts)
         self.stats = {
             "admitted": 0, "finished": 0, "cancelled": 0, "steps": 0,
+            "lane_steps": 0,
             "tokens": 0, "spec_rounds": 0, "spec_emitted": 0,
-            "prefill_steps": 0, "prefill_tokens": 0,
+            "prefill_steps": 0, "prefill_tokens": 0, "prefill_chunks": 0,
             "prefix_hits": 0, "prefix_misses": 0, "prefix_evicted": 0,
             "prefix_tokens_saved": 0, "prefix_cache_bytes": 0,
             "shed": 0,
+            "burst_reads": 0, "burst_read_bytes": 0,
+            "group_bursts": 0, "group_lanes": 0, "group_pad_lanes": 0,
         }
+        # test/debug hook: set to a list and every dispatched decode
+        # (sub)burst appends {"lanes", "attn_len", "need"} — the
+        # scheduler-level proof that no lane's read bound exceeds its
+        # group's bucket
+        self.trace_groups: Optional[List[Dict[str, Any]]] = None
+        # chunked-prefill jobs in flight, keyed by reserved slot
+        self._chunked: Dict[int, _ChunkJob] = {}
 
         # -- device state ----------------------------------------------------
         # The persistent KV cache lives UNSTACKED: per-layer [S, KV, T, Dh]
@@ -475,8 +555,126 @@ class ContinuousBatcher:
                 for name in ("k", "v")
             }
 
+        # -- depth-aware grouped sub-burst -----------------------------------
+        def group_burst(params, cache, cur_tok, pos, temps, keys, lane_ix,
+                        n_real, k, attn_len):
+            """k fused decode steps over a GATHERED lane group: lane_ix
+            ([Gb] int32, DISTINCT lanes; rows >= n_real are pads) selects
+            the group, each lane's cache prefix [0, attn_len) is gathered
+            into a [Gb, KV, attn_len, Dh] slab, the burst scans over the
+            slab, and state scatters back. The read per step is the
+            GROUP's bucket, not the batch max — the whole point. Pads are
+            parked at position attn_len so their K/V writes fall out of
+            bounds and are dropped (jax scatter semantics); their lanes'
+            slabs round-trip bit-identical, so padding with lanes of
+            other (deeper) groups is safe in any dispatch order. One
+            executable per (Gb, attn_len) pair; gather+scatter cost
+            ~4/k of the group's per-burst read, amortised by the scan."""
+            act = jnp.arange(lane_ix.shape[0], dtype=jnp.int32) < n_real
+            g_tok = cur_tok[lane_ix]
+            g_pos = jnp.where(act, pos[lane_ix], attn_len)
+            g_temps = temps[lane_ix]
+            g_keys = keys[lane_ix]
+            g_ks = [layer[lane_ix, :, :attn_len, :] for layer in cache["k"]]
+            g_vs = [layer[lane_ix, :, :attn_len, :] for layer in cache["v"]]
+
+            def body(carry, _):
+                ks, vs, tok, p, kk = carry
+                nxt, p, ks, vs, kk = fused_step(
+                    params, ks, vs, tok, p, act, g_temps, kk, None
+                )
+                return (ks, vs, nxt, p, kk), nxt
+
+            (g_ks, g_vs, tok_out, g_pos, g_keys), toks = lax.scan(
+                body, (g_ks, g_vs, g_tok, g_pos, g_keys), None, length=k
+            )
+            toks = jnp.concatenate([g_tok[None, :], toks], axis=0)
+            new = {
+                "k": [
+                    layer.at[lane_ix, :, :attn_len, :].set(g)
+                    for layer, g in zip(cache["k"], g_ks)
+                ],
+                "v": [
+                    layer.at[lane_ix, :, :attn_len, :].set(g)
+                    for layer, g in zip(cache["v"], g_vs)
+                ],
+            }
+            # pads (inactive rows) must not leak burst-local state back
+            # into lanes that belong to OTHER groups' bursts
+            cur_tok = cur_tok.at[lane_ix].set(
+                jnp.where(act, tok_out, cur_tok[lane_ix])
+            )
+            pos = pos.at[lane_ix].set(jnp.where(act, g_pos, pos[lane_ix]))
+            keys = keys.at[lane_ix].set(
+                jnp.where(act[:, None], g_keys, keys[lane_ix])
+            )
+            return toks, cur_tok, pos, new, keys
+
+        # -- chunked prefill (interleaved with decode polls) -----------------
+        def chunk_prefill_step(params, slab, tokens, start_pos, last_index,
+                               seed, temp, attn_len, is_last):
+            """One prompt chunk into a STAGING slab (cache_one layout,
+            outside the decode cache — in-flight bursts can never touch a
+            half-built prompt, and the decode executables stay bit-exact
+            vs the whole-prompt path). The FINAL chunk (static
+            ``is_last``) additionally samples the first token exactly
+            like prefill_one — same PRNG derivation, so chunked and
+            unchunked admits emit identical streams; the finished slab
+            then goes through the ORDINARY lane insert."""
+            logits, slab = model.prefill_chunk(
+                params, slab, tokens, start_pos, attn_len,
+                last_index=last_index, want_logits=is_last,
+            )
+            if not is_last:
+                zero = jnp.zeros((), jnp.int32)
+                return slab, zero, jax.random.PRNGKey(0)
+            key = jax.random.PRNGKey(seed)
+            key, sub = jax.random.split(key)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            sampled = jax.random.categorical(
+                sub, logits / jnp.maximum(temp, 1e-6), axis=-1
+            ).astype(jnp.int32)
+            first = jnp.where(temp > 0, sampled, greedy)
+            return slab, first[0], key
+
+        def splice_slab(slab, donor):
+            # prefix-cache hit under chunking: the donor's K/V land at the
+            # head of the staging slab, chunking resumes at the match
+            # point (donor bucket <= prompt bucket per _prefix_match)
+            return {
+                name: lax.dynamic_update_slice(
+                    slab[name], donor[name], (0, 0, 0, 0, 0)
+                )
+                for name in ("k", "v")
+            }
+
         self._burst_fn = jax.jit(
             fused_burst, donate_argnums=(1,), static_argnums=(7, 8)
+        )
+        self._group_burst_fn = jax.jit(
+            group_burst, donate_argnums=(1,), static_argnums=(8, 9)
+        )
+        self._chunk_fn = jax.jit(
+            chunk_prefill_step, donate_argnums=(1,), static_argnums=(7, 8)
+        )
+        self._splice_fn = jax.jit(splice_slab, donate_argnums=(0,))
+        # depth-grouping cost model: a separate sub-burst re-reads the
+        # params every step; splitting a shallower group off only pays
+        # when its modeled KV-read saving per step beats that (override
+        # via depth_group_split_bytes — tests force 0 to always split)
+        self._kv_key_bytes = 2 * sum(
+            layer.dtype.itemsize * layer.shape[1] * layer.shape[3]
+            for layer in self._cache["k"]
+        )
+        self._param_bytes = sum(
+            leaf.nbytes
+            for leaf in jax.tree_util.tree_leaves(self.params)
+            if hasattr(leaf, "nbytes")
+        )
+        self._group_split_bytes = (
+            int(depth_group_split_bytes)
+            if depth_group_split_bytes is not None
+            else self._param_bytes
         )
         self._insert_fn = jax.jit(insert, donate_argnums=(0,))
         self._prefill_fn = jax.jit(prefill_one)
@@ -860,6 +1058,29 @@ class ContinuousBatcher:
                     self._draft_cache = self._draft_insert_fn(
                         self._draft_cache, dslab, 0
                     )
+        if self.prefill_chunk > 0:
+            # chunked-prefill executables: one per (bucket, chunk offset,
+            # is_last) the declared prompt shapes can touch. A shorter
+            # real prompt in the same bucket takes its final chunk at an
+            # earlier offset, so BOTH variants compile at every offset.
+            C = self.prefill_chunk
+            for bucket in buckets:
+                if bucket <= C:
+                    continue
+                slab = self._new_slab(bucket)
+                for start in range(0, bucket, C):
+                    start = min(start, bucket - C)
+                    attn_len = min(bucket, self._attn_need(start + C))
+                    for is_last in (False, True):
+                        buf = jnp.zeros((1, C), jnp.int32)
+                        slab, _first, _key = self._chunk_fn(
+                            self.params, slab, buf,
+                            jnp.int32(start), jnp.int32(C - 1),
+                            jnp.int32(0), jnp.float32(0.0),
+                            attn_len, is_last,
+                        )
+                        slab["k"].block_until_ready()
+                del slab
         if self._prefix_index is not None:
             # prefix-cache executables: extract per donor bucket, and the
             # suffix prefill + splice per (donor, suffix<=donor) bucket
@@ -867,6 +1088,15 @@ class ContinuousBatcher:
             # suffix compiles on first use; it is the rare shape)
             for d in buckets:
                 slab = self._extract_fn(self._cache, 0, d)
+                if self.prefill_chunk > 0:
+                    # chunked-hit splice executables: donor slab into a
+                    # fresh staging slab, one per (donor, prompt bucket)
+                    # pair the declared shapes can take — compiled here,
+                    # never inline on the scheduler thread
+                    for b in buckets:
+                        if b >= d and b > self.prefill_chunk:
+                            out = self._splice_fn(self._new_slab(b), slab)
+                            out["k"].block_until_ready()
                 for s_b in buckets:
                     if s_b > d:
                         continue
@@ -913,6 +1143,25 @@ class ContinuousBatcher:
                     )
                 )
                 toks.block_until_ready()
+                if self.depth_groups > 1:
+                    # grouped sub-burst variants: every pow2 group-size
+                    # bucket at this attention bucket (mixed-depth polls
+                    # pick any of them; compile-before-listen holds)
+                    gb = 1
+                    gbs = [self.slots]
+                    while gb < self.slots:
+                        gbs.append(gb)
+                        gb <<= 1
+                    for gb in sorted(set(gbs)):
+                        lane_ix = jnp.arange(gb, dtype=jnp.int32)
+                        toks, self._cur_tok, self._pos, self._cache, self._keys = (
+                            self._group_burst_fn(
+                                self.params, self._cache, self._cur_tok,
+                                self._pos, temps, self._keys, lane_ix,
+                                0, k, attn_len,
+                            )
+                        )
+                        toks.block_until_ready()
         # warm left garbage in cur_tok/pos; reset the host-visible lane
         # state so the first admissions start from a clean slate (the
         # device cache needs no scrub — see residue invariant above)
@@ -960,6 +1209,191 @@ class ContinuousBatcher:
             f"({self.prefill_buckets[-1]}) and max_seq ({self.max_seq}); "
             "raise max_seq or shorten the prompt"
         )
+
+    def _attn_need(self, hi: int) -> int:
+        """Smallest attn_bucket multiple covering position ``hi`` (clamped
+        to the cache length)."""
+        ab = self.attn_bucket
+        return min(self.max_seq, -(-hi // ab) * ab)
+
+    def _plan_groups(self, adv: int):
+        """Partition live lanes into <= depth_groups sub-bursts by
+        attention-read bucket. Returns ``([(lanes, bucket)], need)`` with
+        groups shallow-first; ``need[slot]`` is the lane's OWN bucket.
+
+        Packing: one candidate group per distinct bucket, then adjacent
+        groups merge shallow-into-deep while the modeled per-step cost of
+        keeping them split (an extra param read — _group_split_bytes)
+        exceeds the KV-read saving (lanes x bucket gap x _kv_key_bytes),
+        or while the group count exceeds the cap. Merging always prefers
+        filling the cheapest gap first, so a lane spills to a deeper
+        bucket only when the cost model says the split isn't worth it."""
+        need = {
+            slot: self._attn_need(self._pos_host[slot] + adv)
+            for slot in self._active
+        }
+        groups = [
+            ([s for s in sorted(need) if need[s] == b], b)
+            for b in sorted(set(need.values()))
+        ]
+        if self.depth_groups <= 1 or len(groups) == 1:
+            if len(groups) > 1:
+                groups = [(sorted(need), max(need.values()))]
+            return groups, need
+        while len(groups) > 1:
+            best_i, best_delta = None, None
+            for i in range(len(groups) - 1):
+                lanes_s, b_s = groups[i]
+                _, b_d = groups[i + 1]
+                # per-step cost of MERGING group i into its deeper
+                # neighbour, minus the param read the merge saves
+                delta = (
+                    len(lanes_s) * (b_d - b_s) * self._kv_key_bytes
+                    - self._group_split_bytes
+                )
+                if best_delta is None or delta < best_delta:
+                    best_i, best_delta = i, delta
+            if len(groups) > self.depth_groups or best_delta < 0:
+                lanes_s, _ = groups.pop(best_i)
+                lanes_d, b_d = groups[best_i]
+                groups[best_i] = (sorted(lanes_d + lanes_s), b_d)
+            else:
+                break
+        return groups, need
+
+    def _group_size_bucket(self, n: int) -> int:
+        """pow2 group-size bucket (one sub-burst executable per size)."""
+        g = 1
+        while g < n:
+            g <<= 1
+        return min(g, self.slots)
+
+    def _draft_admit(self, slot: int, req: GenRequest) -> None:
+        """Give the draft its prompt K/V prefix (speculation only). Draft
+        prefixes are RE-DERIVED from the full prompt, never cached or
+        chunked — the draft forward is cheap by construction."""
+        import jax.numpy as jnp
+
+        n = len(req.tokens)
+        prompt = np.zeros((1, self._bucket(n)), np.int32)
+        prompt[0, :n] = req.tokens
+        dcache_one = self._draft_prefill_fn(
+            self._draft_params, jnp.asarray(prompt),
+            jnp.asarray([n - 1], jnp.int32),
+        )
+        self._draft_cache = self._draft_insert_fn(
+            self._draft_cache, dcache_one, slot
+        )
+
+    def _new_slab(self, bucket: int):
+        """Fresh staging slab in the cache_one layout the lane insert
+        consumes: ``{"k","v"}`` of ``[L, 1, KV, bucket, Dh]``."""
+        import jax.numpy as jnp
+
+        cfg = self.model.cfg
+        shape = (cfg.n_layers, 1, cfg.n_kv_heads, bucket, cfg.head_dim)
+        dt = jnp.dtype(getattr(self.model, "compute_dtype", cfg.dtype))
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    def _start_chunked(self, slot: int, req: GenRequest, hit=None) -> None:
+        """Reserve ``slot`` and queue the prompt for interleaved chunked
+        prefill. On a prefix-cache hit the donor slab lands at the head
+        of the staging slab and chunking starts at the splice point —
+        rounded DOWN to the chunk grid: chunk offsets must stay at
+        multiples of ``prefill_chunk`` so every (offset, attn_len)
+        executable is one warm() precompiled (an off-grid start would
+        jit-compile inline on the scheduler thread, stalling every decode
+        lane mid-serving). The [aligned, match) overlap is recomputed and
+        overwrites the donor splice with the same tokens at the same
+        absolute positions — idempotent, at most one chunk's extra work."""
+        bucket = self._bucket(len(req.tokens))
+        slab = self._new_slab(bucket)
+        start = 0
+        if hit is not None:
+            # a real radix hit, even when alignment leaves nothing to
+            # splice (match < one chunk): counted as a hit with its true
+            # (aligned) savings so cache telemetry stays honest under
+            # chunking
+            m, donor = hit
+            start = (m // self.prefill_chunk) * self.prefill_chunk
+            if start > 0:
+                slab = self._splice_fn(slab, donor)
+            req.cache_hit_tokens = start
+            self.stats["prefix_hits"] += 1
+            self.stats["prefix_tokens_saved"] += start
+        elif self._prefix_index is not None:
+            self.stats["prefix_misses"] += 1
+        self._chunked[slot] = _ChunkJob(
+            request=req, slot=slot, next_start=start, slab=slab,
+            bucket=bucket, hit_tokens=start,
+        )
+
+    def _advance_chunks(self) -> None:
+        """Run ONE prefill chunk for every pending chunked admission (the
+        interleave: a chunk per job per decode poll). The final chunk
+        samples the first token on device and the finished slab goes
+        through the ORDINARY lane insert, so activation is exactly a
+        whole-prompt admit (same deferred-first mechanics, same insert
+        executable, bit-identical decode from there on)."""
+        import jax.numpy as jnp
+
+        C = self.prefill_chunk
+        for slot in list(self._chunked):
+            job = self._chunked[slot]
+            req = job.request
+            if req.future.cancelled():
+                del self._chunked[slot]
+                self.stats["cancelled"] += 1
+                continue
+            n = len(req.tokens)
+            start = job.next_start
+            is_last = start + C >= n
+            if is_last:
+                # the padded chunk must stay inside the slab; sliding the
+                # start back re-writes identical K/V (same tokens, same
+                # absolute positions) — idempotent by construction
+                start = max(0, min(start, job.bucket - C))
+            end = min(start + C, n)
+            buf = np.zeros((1, C), np.int32)
+            buf[0, : end - start] = req.tokens[start:end]
+            attn_len = min(job.bucket, self._attn_need(start + C))
+            try:
+                job.slab, first, lane_key = self._chunk_fn(
+                    self.params, job.slab, jnp.asarray(buf),
+                    jnp.int32(start), jnp.int32(n - 1 - start),
+                    jnp.int32(req.seed), jnp.float32(req.temperature),
+                    attn_len, is_last,
+                )
+                if is_last:
+                    self._cache, self._cur_tok, self._pos, self._keys = (
+                        self._insert_fn(
+                            self._cache, job.slab, slot, first, n, lane_key,
+                            self._cur_tok, self._pos, self._keys,
+                        )
+                    )
+            except Exception as e:  # noqa: BLE001 - bad request/device state
+                logger.exception("chunked prefill failed")
+                del self._chunked[slot]
+                if not req.future.done():
+                    req.future.set_exception(e)
+                continue
+            self.stats["prefill_steps"] += 1
+            # positions COMPUTED, incl. pad and slide-back overlap — the
+            # same convention as the bucketed full prefill (which counts
+            # its whole bucket): prefill_tokens is a device-work proxy,
+            # not a real-prompt-token count
+            self.stats["prefill_tokens"] += C
+            self.stats["prefill_chunks"] += 1
+            if is_last:
+                if self.speculate_tokens > 0:
+                    self._draft_admit(slot, req)
+                del self._chunked[slot]
+                self._active[slot] = _Slot(request=req)
+                self._pos_host[slot] = n
+                self._masks_dirty = True
+                self.stats["admitted"] += 1
+            else:
+                job.next_start = end
 
     def _prefix_match(self, req: GenRequest):
         """Longest usable cached prefix for this prompt: ``(m, slab)`` or
@@ -1063,20 +1497,10 @@ class ContinuousBatcher:
             self.stats["prefill_tokens"] += bucket
         if self.speculate_tokens > 0:
             # the draft needs the prompt's K/V prefix too so its proposals
-            # attend over the real context. Draft prefixes are RE-DERIVED
-            # from the full prompt, never cached: the radix pool holds only
-            # target K/V (a hit still pays the cheap draft prefill, and the
-            # pool never doubles its footprint for the thin draft)
-            if hit is not None:
-                prompt = np.zeros((1, self._bucket(n)), np.int32)
-                prompt[0, :n] = req.tokens
-            dcache_one = self._draft_prefill_fn(
-                self._draft_params, jnp.asarray(prompt),
-                jnp.asarray([n - 1], jnp.int32),
-            )
-            self._draft_cache = self._draft_insert_fn(
-                self._draft_cache, dcache_one, slot
-            )
+            # attend over the real context (see _draft_admit: re-derived
+            # from the full prompt, never cached — the radix pool holds
+            # only target K/V)
+            self._draft_admit(slot, req)
         # no host read here: prefill + insert stay fully async; the first
         # token reaches the host with the next burst's sync
         self._active[slot] = _Slot(request=req)
@@ -1189,12 +1613,14 @@ class ContinuousBatcher:
         when the lane was pre-freed and re-admitted before this read. A
         request whose output is already complete (``credit_done``) is
         skipped: its remaining rows are overshoot decode, dropped by
-        design."""
+        design. ``snapshot[slot] = (s, start_row, col)`` — col is the
+        lane's COLUMN in this burst's token matrix (its gathered row for
+        a depth-group sub-burst, the slot id for a whole-batch burst)."""
         host_toks = np.asarray(toks_dev)  # the burst's one host sync
-        for slot, (s, start) in snapshot.items():
+        for slot, (s, start, col) in snapshot.items():
             if s.credit_done:
                 continue
-            if self._credit(s, host_toks[start:, slot]):
+            if self._credit(s, host_toks[start:, col]):
                 if self._active.get(slot) is s:
                     self._finish(slot)
                 else:
@@ -1244,7 +1670,8 @@ class ContinuousBatcher:
                 # same-bucket admissions are grouped so m lanes share one
                 # batched prefill forward (pow2 chunks bound executables)
                 wave: List[GenRequest] = []
-                while len(self._active) + len(wave) < self.slots:
+                busy = len(self._active) + len(self._chunked)
+                while busy + len(wave) < self.slots:
                     try:
                         req = self._queue.get_nowait()
                     except queue.Empty:
@@ -1255,8 +1682,10 @@ class ContinuousBatcher:
                     wave.append(req)
                 if wave:
                     free_iter = iter(
-                        i for i in range(self.slots) if i not in self._active
+                        i for i in range(self.slots)
+                        if i not in self._active and i not in self._chunked
                     )
+                    chunk_size = self.prefill_chunk
                     by_bucket: Dict[int, List[GenRequest]] = {}
                     for req in wave:
                         hit = (
@@ -1264,6 +1693,23 @@ class ContinuousBatcher:
                             if self._prefix_index is not None
                             else None
                         )
+                        n = len(req.tokens)
+                        if chunk_size and (
+                            (hit is None and self._bucket(n) > chunk_size)
+                            or (hit is not None and n - hit[0] > chunk_size)
+                        ):
+                            # long prefill: reserve the lane and trickle
+                            # the prompt in between decode polls instead
+                            # of stalling every lane for one forward
+                            slot = next(free_iter)
+                            try:
+                                self._start_chunked(slot, req, hit=hit)
+                            except Exception as e:  # noqa: BLE001 - bad request
+                                logger.exception("chunked admit failed")
+                                self._chunked.pop(slot, None)
+                                if not req.future.done():
+                                    req.future.set_exception(e)
+                            continue
                         if hit is not None:
                             # prefix-cache hit: the suffix-only admit path
                             # (splice + short prefill) beats riding a
@@ -1308,13 +1754,18 @@ class ContinuousBatcher:
                                 for req in chunk:
                                     if not req.future.done():
                                         req.future.set_exception(e)
-                if not self._active and not pending:
+                if not self._active and not pending and not self._chunked:
                     try:
                         req = self._queue.get(timeout=0.05)
                     except queue.Empty:
                         continue
                     self._queue.put(req)
                     continue
+                if self._chunked:
+                    # the interleave: one prefill chunk per pending long
+                    # admission, then the decode burst below — decode
+                    # lanes keep their cadence while long prompts land
+                    self._advance_chunks()
                 if self._active:
                     if self._masks_dirty:
                         for i in range(self.slots):
@@ -1345,22 +1796,27 @@ class ContinuousBatcher:
                     # per-burst worst-case position advance (spec rounds can
                     # emit up to gamma+1 tokens each)
                     adv = k * (self.speculate_tokens + 1 if self._spec_burst_fn else 1)
-                    # attention-read bucket: the smallest 128-multiple that
-                    # covers every active lane's end-of-burst position
-                    # (host-tracked, no sync). One executable per bucket.
-                    hi = max(self._pos_host[i] for i in self._active) + adv
-                    ab = self.attn_bucket
-                    attn_len = min(self.max_seq, -(-hi // ab) * ab)
-                    # snapshot BEFORE dispatch: tokens of this burst belong to
-                    # these occupants, whatever the host learns later
-                    snapshot = {}
-                    for slot, s in self._active.items():
-                        first = s.first_pending
-                        snapshot[slot] = (s, 0 if first else 1)
-                        s.first_pending = False
-                        s.dispatched += k + (1 if first else 0)
-                        self._pos_host[slot] += adv
+                    # attention-read bucket: the smallest attn_bucket
+                    # multiple covering every active lane's end-of-burst
+                    # position (host-tracked, no sync). One executable per
+                    # bucket. With depth grouping, each sub-burst narrows
+                    # to ITS lanes' bucket instead (plan below).
+                    attn_len = self._attn_need(
+                        max(self._pos_host[i] for i in self._active) + adv
+                    )
                     if self._spec_burst_fn is not None:
+                        # snapshot BEFORE dispatch: tokens of this burst
+                        # belong to these occupants, whatever the host
+                        # learns later. (Spec bursts stay whole-batch:
+                        # their per-round advance is data-dependent and
+                        # the verify pass already amortises param reads.)
+                        snapshot = {}
+                        for slot, s in self._active.items():
+                            first = s.first_pending
+                            snapshot[slot] = (s, 0 if first else 1)
+                            s.first_pending = False
+                            s.dispatched += k + (1 if first else 0)
+                            self._pos_host[slot] += adv
                         caches = {
                             "k": self._cache["k"], "v": self._cache["v"],
                             "dk": self._draft_cache["k"],
@@ -1377,6 +1833,7 @@ class ContinuousBatcher:
                         self._cache = {"k": nc["k"], "v": nc["v"]}
                         self._draft_cache = {"k": nc["dk"], "v": nc["dv"]}
                         self.stats["steps"] += k
+                        self.stats["lane_steps"] += k * self.slots
                         for t in (start_tok, toks, counts):
                             try:
                                 t.copy_to_host_async()
@@ -1384,21 +1841,80 @@ class ContinuousBatcher:
                                 pass
                         pending.append(("spec", (start_tok, toks, counts, snapshot, k)))
                     else:
-                        toks, self._cur_tok, self._pos, self._cache, self._keys = (
-                            self._burst_fn(
-                                self.params, self._cache, self._cur_tok, self._pos,
-                                active_dev, temps_dev, self._keys, k, attn_len,
+                        groups, need = self._plan_groups(adv)
+                        # per-lane bookkeeping happens per SUB-burst: a
+                        # lane's tokens are credited against the column it
+                        # occupied in the burst that decoded it
+                        for lanes, g_bucket in groups:
+                            snapshot = {}
+                            for col, slot in enumerate(lanes):
+                                s = self._active[slot]
+                                first = s.first_pending
+                                snapshot[slot] = (s, 0 if first else 1, col)
+                                s.first_pending = False
+                                s.dispatched += k + (1 if first else 0)
+                                self._pos_host[slot] += adv
+                            if len(groups) == 1:
+                                # single depth group: the exact pre-grouping
+                                # whole-batch path — no gather, columns are
+                                # lane ids
+                                for slot in lanes:
+                                    snapshot[slot] = (
+                                        snapshot[slot][0], snapshot[slot][1],
+                                        slot,
+                                    )
+                                rows = self.slots
+                                toks, self._cur_tok, self._pos, self._cache, self._keys = (
+                                    self._burst_fn(
+                                        self.params, self._cache,
+                                        self._cur_tok, self._pos,
+                                        active_dev, temps_dev, self._keys,
+                                        k, g_bucket,
+                                    )
+                                )
+                            else:
+                                gb = self._group_size_bucket(len(lanes))
+                                pads = [
+                                    i for i in range(self.slots)
+                                    if i not in snapshot
+                                ][: gb - len(lanes)]
+                                lane_ix = jnp.asarray(
+                                    lanes + pads, jnp.int32
+                                )
+                                rows = gb
+                                toks, self._cur_tok, self._pos, self._cache, self._keys = (
+                                    self._group_burst_fn(
+                                        self.params, self._cache,
+                                        self._cur_tok, self._pos,
+                                        temps_dev, self._keys, lane_ix,
+                                        len(lanes), k, g_bucket,
+                                    )
+                                )
+                                self.stats["group_bursts"] += 1
+                                self.stats["group_lanes"] += len(lanes)
+                                self.stats["group_pad_lanes"] += gb - len(lanes)
+                            self.stats["steps"] += k
+                            self.stats["lane_steps"] += k * rows
+                            self.stats["burst_reads"] += 1
+                            self.stats["burst_read_bytes"] += k * (
+                                self._param_bytes
+                                + rows * g_bucket * self._kv_key_bytes
                             )
-                        )
-                        self.stats["steps"] += k
-                        # start the device->host token copy NOW; by the time
-                        # the host reads this burst (pipeline_depth dispatches
-                        # later) the transfer has usually landed
-                        try:
-                            toks.copy_to_host_async()
-                        except AttributeError:  # non-jax array (test doubles)
-                            pass
-                        pending.append(("plain", (toks, snapshot)))
+                            if self.trace_groups is not None:
+                                self.trace_groups.append({
+                                    "lanes": tuple(lanes),
+                                    "attn_len": g_bucket,
+                                    "need": {i: need[i] for i in lanes},
+                                    "grouped": len(groups) > 1,
+                                })
+                            # start the device->host token copy NOW; by the
+                            # time the host reads this burst (pipeline_depth
+                            # dispatches later) the transfer has landed
+                            try:
+                                toks.copy_to_host_async()
+                            except AttributeError:  # non-jax (test doubles)
+                                pass
+                            pending.append(("plain", (toks, snapshot)))
                         # PREDICTIVE FREE: a lane whose eos-less budget is
                         # now fully covered by dispatched bursts is done —
                         # the host needn't observe the tokens to know it.
@@ -1462,8 +1978,14 @@ class ContinuousBatcher:
             # without this sweep their callers would block forever
             for _mode, payload in pending:
                 snap = payload[3] if _mode == "spec" else payload[1]
-                for s, _start in snap.values():
+                for entry in snap.values():
+                    s = entry[0]
                     if not s.request.future.done():
                         s.request.future.set_exception(err)
+            # chunked admissions hold reserved lanes but no _active entry
+            for slot in list(self._chunked):
+                job = self._chunked.pop(slot)
+                if not job.request.future.done():
+                    job.request.future.set_exception(err)
             self._drain_queue(err)
             raise

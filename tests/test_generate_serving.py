@@ -1102,3 +1102,235 @@ def test_stream_speculation_mesh_compose(tmp_path):
     finally:
         if s.batcher:
             s.batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# Depth-aware scheduling (PR 3 tentpole): grouped sub-bursts + chunked
+# prefill must never change greedy output — on vs off, under speculation,
+# under the prefix cache — and the scheduler must provably never read a
+# lane past its own group's bucket.
+# ---------------------------------------------------------------------------
+
+MIXED_PROMPTS = [(3, 8), (40, 8), (5, 12), (35, 6), (9, 10), (28, 4)]
+
+
+@pytest.fixture(autouse=True)
+def _sub_tile_attn_buckets():
+    """Lower the MXU-tileability clamp for this module's tests: depth
+    grouping needs several attention buckets inside a 64-token cache,
+    which production's 64 floor forbids (by design)."""
+    old = ContinuousBatcher.MIN_ATTN_BUCKET
+    ContinuousBatcher.MIN_ATTN_BUCKET = 16
+    yield
+    ContinuousBatcher.MIN_ATTN_BUCKET = old
+
+
+def _mixed_run(model, params, **kw):
+    rng = np.random.RandomState(17)
+    prompts = [rng.randint(0, 256, n).tolist() for n, _ in MIXED_PROMPTS]
+    b = ContinuousBatcher(
+        model, params, slots=4, max_seq=64, prefill_buckets=(8, 16, 32),
+        attn_bucket=16, steps_per_poll=2, **kw
+    )
+    b.trace_groups = []
+    try:
+        import time
+
+        futures = []
+        for i, (p, (_, m)) in enumerate(zip(prompts, MIXED_PROMPTS)):
+            futures.append(b.submit(p, max_new_tokens=m))
+            if i % 2 == 1:
+                time.sleep(0.03)  # stagger so depths genuinely mix
+        out = [f.result(timeout=120) for f in futures]
+    finally:
+        b.close()
+    return prompts, out, dict(b.stats), b.trace_groups
+
+
+def test_depth_grouping_greedy_identical(model_and_params):
+    """Depth-grouped sub-bursts emit exactly the single-burst scheduler's
+    tokens AND the model's own generate() — while genuinely splitting
+    bursts (group_bursts > 0 with the cost model forced to always
+    split)."""
+    import jax.numpy as jnp
+
+    model, params = model_and_params
+    prompts, off, _, _ = _mixed_run(model, params)
+    _, on, stats, trace = _mixed_run(
+        model, params, depth_groups=4, depth_group_split_bytes=0
+    )
+    assert on == off
+    assert stats["group_bursts"] > 0
+    assert any(t["grouped"] for t in trace)
+    for p, got, (_, m) in zip(prompts, on, MIXED_PROMPTS):
+        exp = np.asarray(
+            model.generate(params, jnp.asarray([p], jnp.int32), m)
+        )[0].tolist()
+        assert got == exp
+
+
+def test_chunked_prefill_greedy_identical(model_and_params):
+    """Chunked prefill (long prompts trickling in between decode polls)
+    is byte-identical to whole-prompt prefill, and really chunks."""
+    model, params = model_and_params
+    _, off, _, _ = _mixed_run(model, params)
+    _, on, stats, _ = _mixed_run(model, params, prefill_chunk=16)
+    assert on == off
+    assert stats["prefill_chunks"] > 0
+    # both knobs together, still identical
+    _, both, bstats, _ = _mixed_run(
+        model, params, prefill_chunk=16, depth_groups=4,
+        depth_group_split_bytes=0,
+    )
+    assert both == off
+    assert bstats["prefill_chunks"] > 0 and bstats["group_bursts"] > 0
+
+
+def test_depth_knobs_with_speculation_exact(model_and_params):
+    """Speculation composes with both knobs: output still equals the
+    target's own greedy decode (chunked prompts feed the draft's full
+    prefill at activation; spec bursts stay whole-batch by design)."""
+    import jax.numpy as jnp
+
+    model, params = model_and_params
+    draft = DecoderLM(
+        vocab_size=CFG["vocab_size"], d_model=16, n_layers=1, n_heads=2,
+        n_kv_heads=1, d_ff=32, max_seq=64, dtype="float32",
+    )
+    dparams = draft.init_params(99)
+    _, out, stats, _ = _mixed_run(
+        model, params, draft_model=draft, draft_params=dparams,
+        speculate_tokens=3, depth_groups=4, depth_group_split_bytes=0,
+        prefill_chunk=16,
+    )
+    rng = np.random.RandomState(17)
+    for (n, m), got in zip(MIXED_PROMPTS, out):
+        p = rng.randint(0, 256, n).tolist()
+        exp = np.asarray(
+            model.generate(params, jnp.asarray([p], jnp.int32), m)
+        )[0].tolist()
+        assert got == exp
+    assert stats["prefill_chunks"] > 0
+
+
+def test_depth_knobs_with_prefix_cache_exact(model_and_params):
+    """Prefix-cache hits splice the donor slab and CHUNK the remaining
+    prompt; outputs stay byte-identical to the model's own generate()
+    and hits still register."""
+    import jax.numpy as jnp
+
+    model, params = model_and_params
+    rng = np.random.RandomState(23)
+    shared = rng.randint(0, 256, 20).tolist()
+    prompts = [shared + rng.randint(0, 256, t).tolist() for t in (4, 6, 25, 3)]
+    b = ContinuousBatcher(
+        model, params, slots=2, max_seq=64, prefill_buckets=(8, 16, 32),
+        attn_bucket=16, steps_per_poll=2,
+        prefix_cache_hbm_bytes=1 << 26, prefix_cache_min_tokens=4,
+        depth_groups=4, depth_group_split_bytes=0, prefill_chunk=16,
+    )
+    try:
+        for p in prompts:
+            got = b.generate(p, max_new_tokens=6)
+            exp = np.asarray(
+                model.generate(params, jnp.asarray([p], jnp.int32), 6)
+            )[0].tolist()
+            assert got == exp
+        assert b.stats["prefix_hits"] >= 2
+        assert b.stats["prefill_chunks"] > 0
+    finally:
+        b.close()
+
+
+def test_group_read_bounds_never_exceed_own_bucket(model_and_params):
+    """Scheduler-level invariant: every dispatched sub-burst's read bound
+    equals the deepest need INSIDE that group, and with the cost model
+    forced to always split, no lane ever rides a burst whose bound
+    exceeds its OWN bucket."""
+    model, params = model_and_params
+    _, _, _, trace = _mixed_run(
+        model, params, depth_groups=8, depth_group_split_bytes=0
+    )
+    assert trace
+    for t in trace:
+        assert t["attn_len"] == max(t["need"].values())
+        for lane, need in t["need"].items():
+            assert need <= t["attn_len"]
+        if t["grouped"]:
+            # forced-split mode: a group only holds lanes of ONE bucket,
+            # so no shallow lane pays a deeper lane's read
+            assert len(set(t["need"].values())) == 1
+
+
+def test_group_repack_as_prefixes_cross_buckets(model_and_params):
+    """As a lane's prefix deepens across attn-bucket boundaries its group
+    bucket must follow (groups are re-planned every poll): the same lane
+    appears in sub-bursts of strictly increasing attn_len, and co-tenants
+    at different depths stay in different groups until they converge."""
+    import time
+
+    model, params = model_and_params
+    b = ContinuousBatcher(
+        model, params, slots=2, max_seq=64, prefill_buckets=(8, 32),
+        attn_bucket=16, steps_per_poll=2,
+        depth_groups=4, depth_group_split_bytes=0,
+    )
+    b.trace_groups = []
+    try:
+        deep = b.submit(list(range(1, 30)), max_new_tokens=20)  # starts ~29
+        time.sleep(0.05)
+        shallow = b.submit([5, 6, 7], max_new_tokens=30)  # starts ~3
+        deep.result(timeout=120)
+        shallow.result(timeout=120)
+    finally:
+        b.close()
+    trace = b.trace_groups
+    # the shallow lane's read bound walked UP bucket by bucket
+    shallow_lens = [
+        t["attn_len"] for t in trace
+        if t["grouped"] and len(t["lanes"]) == 1 and max(t["need"].values()) < 48
+    ]
+    assert shallow_lens, "expected dedicated shallow-group dispatches"
+    assert shallow_lens == sorted(shallow_lens)
+    assert len(set(shallow_lens)) >= 2, "bound never re-packed upward"
+    # while split, every grouped dispatch kept each lane within its bucket
+    for t in trace:
+        assert t["attn_len"] == max(t["need"].values())
+
+
+def test_generateserver_depth_knobs_and_metrics(tmp_path):
+    """Knob plumbing + observability: GenerateServer forwards the depth
+    knobs, serves identically to a knobs-off server, and exports the
+    per-burst read-bytes and group-occupancy counters."""
+    from seldon_core_tpu.servers.generateserver import GenerateServer
+
+    d = tmp_path / "llm"
+    d.mkdir()
+    (d / "jax_config.json").write_text(
+        json.dumps({"family": "llm", "config": CFG})
+    )
+    plain = GenerateServer(model_uri=str(d), slots=2, steps_per_poll=2,
+                           attn_bucket=16)
+    tuned = GenerateServer(
+        model_uri=str(d), slots=2, steps_per_poll=2, attn_bucket=16,
+        depth_groups=2, prefill_chunk=16, depth_group_split_bytes=0,
+    )
+    try:
+        body = {"prompt_tokens": [list(range(1, 30)), [5, 17, 42]],
+                "max_new_tokens": 8}
+        out_plain = plain.predict(dict(body), [])
+        out_tuned = tuned.predict(dict(body), [])
+        assert out_plain["tokens"] == out_tuned["tokens"]
+        assert tuned.batcher.prefill_chunk == 16
+        assert tuned.batcher.depth_groups == 2
+        keys = {m["key"]: m for m in tuned.metrics()}
+        assert keys["gen_burst_reads"]["type"] == "COUNTER"
+        assert keys["gen_burst_read_bytes"]["value"] > 0
+        assert keys["gen_prefill_chunks"]["value"] > 0
+        if "gen_group_occupancy" in keys:
+            assert 0 < keys["gen_group_occupancy"]["value"] <= 1
+    finally:
+        if plain.batcher:
+            plain.batcher.close()
+        if tuned.batcher:
+            tuned.batcher.close()

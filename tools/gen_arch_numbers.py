@@ -1,13 +1,19 @@
 #!/usr/bin/env python
-"""Regenerate ARCHITECTURE.md's numbers table from the newest BENCH_r*.json.
+"""Regenerate ARCHITECTURE.md's numbers table from BASELINE.json ONLY.
 
-One source of truth: the driver-captured bench file. Run after every
-round; the table between the GEN-NUMBERS markers is replaced wholesale.
+One source of truth (VERDICT r5 #7): earlier rounds spliced the table
+from the newest BENCH_r*.json tail with BASELINE.json backfill, and a
+mid-session capture once published headline numbers that disagreed with
+the end-of-round BASELINE — two artifacts in one repo stating different
+numbers for the same tier. Now the table reads exactly one capture —
+``BASELINE.json`` ``published`` / ``published_fronts`` (stamped
+atomically by bench.py at capture time) — and the provenance line names
+the source file, the capture keys, and the capture timestamp, so any
+future divergence is attributable on sight.
 
     python tools/gen_arch_numbers.py
 """
 
-import glob
 import json
 import os
 import re
@@ -18,215 +24,69 @@ BEGIN = "<!-- GEN-NUMBERS:BEGIN (tools/gen_arch_numbers.py) -->"
 END = "<!-- GEN-NUMBERS:END -->"
 
 
-def latest_bench():
-    files = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")))
-    if not files:
-        sys.exit("no BENCH_r*.json found")
-    return files[-1], json.load(open(files[-1]))
-
-
 def fmt(n, nd=0):
     if n is None:
         return "—"
     return f"{n:,.{nd}f}"
 
 
-def _extract_obj(text, key):
-    """Brace-match the JSON object following 'key":' in possibly
-    head-truncated text (the driver stores only the TAIL of stdout, so
-    even the key itself may be cut — callers pass suffixes too)."""
-    m = re.search(r'%s"\s*:\s*\{' % re.escape(key), text)
-    if not m:
-        return {}
-    i = m.end() - 1
-    depth = 0
-    for j in range(i, len(text)):
-        if text[j] == "{":
-            depth += 1
-        elif text[j] == "}":
-            depth -= 1
-            if depth == 0:
-                try:
-                    return json.loads(text[i:j + 1])
-                except ValueError:
-                    return {}
-    return {}
-
-
-def rows_from(bench, bench_mtime=None):
-    tail = bench.get("tail")
-    if isinstance(tail, str):
-        lines = [ln for ln in tail.strip().splitlines() if ln.strip()]
-        line = lines[-1]
-        try:
-            payload = json.loads(line)
-        except ValueError:
-            payload = None
-        if isinstance(payload, dict) and payload.get("compact"):
-            # bench.py's final line is the compact harness summary; the
-            # FULL single-line dump sits right above it — use it when the
-            # capture kept it, else keep the compact skeleton (published
-            # backfill below fills in the detail)
-            for prev in reversed(lines[:-1]):
-                try:
-                    cand = json.loads(prev)
-                except ValueError:
-                    continue
-                if isinstance(cand, dict) and "model_tier" in cand and not cand.get("compact"):
-                    payload = cand
-                    break
-            if not isinstance(payload.get("model_tier"), dict):
-                payload["model_tier"] = {}
-            else:
-                # the over-budget compact fallback stores bare numbers:
-                # rows/s for the image/encoder tiers, tokens/s for the
-                # generate tiers — rewrap under the key finish_rows reads
-                def _rewrap(key, v):
-                    if isinstance(v, dict):
-                        return v
-                    rate = ("rows_per_s"
-                            if key.startswith(("resnet", "bert"))
-                            else "tokens_per_s")
-                    return {rate: v}
-
-                payload["model_tier"] = {
-                    k: _rewrap(k, v)
-                    for k, v in payload["model_tier"].items()
-                }
-        if payload is None:
-            # head-truncated capture: recover the named sub-objects and
-            # scalars that survive in the tail
-            payload = {"model_tier": _extract_obj(line, "model_tier"),
-                       "binary_front": _extract_obj(line, "binary_front")
-                       or _extract_obj(line, "ary_front"),
-                       "grpc_front": _extract_obj(line, "grpc_front")
-                       or _extract_obj(line, "rpc_front")}
-            if not payload["model_tier"]:
-                # even the model_tier key was cut: pick up whichever tier
-                # sub-objects survive verbatim in the tail
-                tiers = {}
-                for key in ("resnet50_rest", "resnet50_device", "bert_grpc",
-                            "bert_grpc_latency", "llm_generate", "llm_1b",
-                            "llm_1b_latency", "llm_1b_spec",
-                            "llm_generate_long", "llm_1b_long",
-                            "llm_1b_shared_prefix"):
-                    obj = _extract_obj(line, key)
-                    if obj:
-                        tiers[key] = obj
-                payload["model_tier"] = tiers
-            m = re.search(r'"unit": "req/s", "vs_baseline": ([0-9.]+)', line)
-            if m:
-                payload["vs_baseline"] = float(m.group(1))
-            m = re.search(r'"value": ([0-9.]+), "unit": "req/s", "vs_baseline"', line)
-            if m:
-                payload["value"] = float(m.group(1))
-    else:
-        payload = bench
-    mt = payload.get("model_tier", {})
-    # Fallback (VERDICT r4 #4/#5): tail recovery can lose tiers the driver
-    # truncated away. BASELINE.json["published"] is the SAME capture
-    # (bench.py writes it in-run), so any tier missing from the tail is
-    # taken from there; the front headlines likewise ride in
-    # "published_fronts". The table can never drop tiers again.
-    try:
-        with open(os.path.join(ROOT, "BASELINE.json")) as f:
-            baseline = json.load(f)
-    except Exception:
-        baseline = {}
+def load_capture():
+    """The one coherent capture: BASELINE.json published (+ fronts)."""
+    path = os.path.join(ROOT, "BASELINE.json")
+    with open(path) as f:
+        baseline = json.load(f)
     published = baseline.get("published") or {}
     fronts = baseline.get("published_fronts") or {}
-    if (
-        published.get("captured_at")
-        and published.get("captured_at") == fronts.get("captured_at")
-        # recency: a BENCH file materially newer than the stamped capture
-        # means the driver ran after the last BASELINE write (e.g. bench
-        # crashed pre-publish) — then the BENCH tail stays primary and
-        # published only backfills, preserving "driver file is the source
-        # of truth"
-        and (
-            bench_mtime is None
-            or published["captured_at"] >= bench_mtime - 3600
-        )
-    ):
-        # a stamped published capture is ONE coherent session (bench.py
-        # writes tiers + fronts together); prefer it wholesale over
-        # splicing tiers from different rounds — a driver-truncated tail
-        # mixed with backfill would pair numbers from different tunnel
-        # sessions in one table (VERDICT r4 #4/#5)
-        import datetime as _dt
+    if not published:
+        sys.exit("BASELINE.json has no 'published' capture — run bench.py")
+    mt = {
+        k: v for k, v in published.items()
+        if k not in ("device", "captured_at") and isinstance(v, dict)
+    }
+    stamps = {published.get("captured_at"), fronts.get("captured_at") or
+              published.get("captured_at")}
+    return mt, fronts, published.get("captured_at"), len(stamps) == 1
 
-        mt = {k: v for k, v in published.items()
-              if k not in ("device", "captured_at") and isinstance(v, dict)}
-        payload = dict(payload)
-        payload["model_tier"] = mt
-        payload["binary_front"] = fronts.get("binary_front")
-        payload["grpc_front"] = fronts.get("grpc_front")
-        stub = fronts.get("stub_rest") or {}
-        payload["value"] = stub.get("value")
-        payload["vs_baseline"] = stub.get("vs_baseline")
+
+def provenance(captured_at, coherent):
+    import datetime as _dt
+
+    stamp = "unknown time"
+    if captured_at:
         stamp = _dt.datetime.fromtimestamp(
-            published["captured_at"], _dt.timezone.utc
-        ).strftime("%Y-%m-%d %H:%M")
-        payload["_backfill_note"] = (
-            f"one coherent in-round capture from BASELINE.json published "
-            f"({stamp} UTC, stamped by bench.py); the newest BENCH_r*.json "
-            "is the driver's independent capture of the same tiers"
+            captured_at, _dt.timezone.utc
+        ).strftime("%Y-%m-%d %H:%M UTC")
+    line = (
+        f"*(generated from `BASELINE.json` keys `published` + "
+        f"`published_fronts`, captured {stamp} by bench.py — the single "
+        "source of truth for this table; do not edit by hand)*"
+    )
+    if not coherent:
+        line += (
+            "\n\n*WARNING: `published` and `published_fronts` carry "
+            "different capture stamps — rerun bench.py for one coherent "
+            "capture.*"
         )
-        payload["_source"] = "published"
-        return finish_rows(payload, mt)
-    backfilled = []
-    if isinstance(mt, dict):
-        for key, tier in published.items():
-            if key in ("device", "captured_at") or not isinstance(tier, dict):
-                continue
-            cur = mt.get(key)
-            if not cur:
-                mt[key] = tier
-                backfilled.append(key)
-            elif payload.get("compact") and isinstance(cur, dict):
-                # compact skeleton tier: published fills in the detail,
-                # the compact line's own numbers win where both exist
-                mt[key] = {**tier, **cur}
-    for key in ("binary_front", "grpc_front"):
-        if not payload.get(key) and fronts.get(key):
-            payload[key] = fronts[key]
-            backfilled.append(key)
-    if payload.get("value") is None and fronts.get("stub_rest"):
-        payload["value"] = fronts["stub_rest"].get("value")
-        payload.setdefault("vs_baseline", fronts["stub_rest"].get("vs_baseline"))
-        backfilled.append("stub_rest")
-    if backfilled:
-        # provenance note rides with the table: same capture when bench.py
-        # stamped published + published_fronts in the run that produced the
-        # BENCH file, otherwise the note names the splice
-        same = published.get("captured_at") == fronts.get("captured_at")
-        payload["_backfill_note"] = (
-            f"{len(backfilled)} entr{'y' if len(backfilled) == 1 else 'ies'} "
-            f"({', '.join(sorted(backfilled))}) recovered from "
-            "BASELINE.json published"
-            + (" (same capture)" if same else
-               " (NOTE: published/published_fronts carry different "
-               "capture stamps)")
-        )
-    return finish_rows(payload, mt)
+    return line
 
 
-def finish_rows(payload, mt):
+def rows_from(mt, fronts):
     rows = []
-    if payload.get("value") is not None:
+    stub = fronts.get("stub_rest") or {}
+    if stub.get("value") is not None:
         rows.append((
             "Stub engine REST (1 core)",
-            f"{fmt(payload.get('value'))} req/s",
-            f"{payload.get('vs_baseline', '—')}x the reference's 16-core number",
+            f"{fmt(stub.get('value'))} req/s",
+            f"{stub.get('vs_baseline', '—')}x the reference's 16-core number",
         ))
-    b = payload.get("binary_front") or {}
+    b = fronts.get("binary_front") or {}
     if b:
         rows.append((
             "Binary protobuf front",
             f"{fmt(b.get('value'))} req/s",
             f"{b.get('vs_grpc_baseline', '—')}x the reference's gRPC headline",
         ))
-    g = payload.get("grpc_front") or {}
+    g = fronts.get("grpc_front") or {}
     if g:
         rows.append((
             "Native gRPC front",
@@ -261,19 +121,29 @@ def finish_rows(payload, mt):
         ))
     bl = mt.get("bert_grpc_latency") or {}
     if bl:
+        svc = bl.get("device_service_ms")
+        svc_note = (
+            f"; device service {svc} ms/row" if svc
+            else "; device service withheld (non-positive slope)"
+            if "device_service_ms" in bl else ""
+        )
         rows.append((
             "BERT-base, latency tier",
             f"p50 {fmt(bl.get('p50_ms'), 1)} ms, p99 {fmt(bl.get('p99_ms'), 1)} ms",
             f"{bl.get('concurrency', '—')} closed-loop lanes, single-row "
-            "requests — service latency, not queueing",
+            f"requests — service latency, not queueing{svc_note}",
         ))
     g = mt.get("llm_generate") or {}
     if g:
         mbu = f", MBU {g['mbu_pct']}%" if g.get("mbu_pct") is not None else ""
+        floor = (
+            f"; {g['pct_of_dispatch_floor']}% of the dispatch floor"
+            if g.get("pct_of_dispatch_floor") is not None else ""
+        )
         rows.append((
             "generate(), 0.2B decoder",
             f"{fmt(g.get('tokens_per_s'))} tok/s{mbu}",
-            f"continuous batching, {g.get('slots', '—')} lanes",
+            f"continuous batching, {g.get('slots', '—')} lanes{floor}",
         ))
     g1 = mt.get("llm_1b") or {}
     if g1:
@@ -302,9 +172,10 @@ def finish_rows(payload, mt):
         ))
     gl = mt.get("llm_generate_long") or {}
     if gl:
+        mbu = f", MBU {gl['mbu_pct']}%" if gl.get("mbu_pct") is not None else ""
         rows.append((
             f"generate(), {fmt(gl.get('prompt_len'))}-token prompts",
-            f"{fmt(gl.get('tokens_per_s'))} tok/s",
+            f"{fmt(gl.get('tokens_per_s'))} tok/s{mbu}",
             "flash prefill + live-prefix decode reads",
         ))
     gp = mt.get("llm_1b_shared_prefix") or {}
@@ -323,27 +194,19 @@ def finish_rows(payload, mt):
         rows.append((
             f"generate(), 1.26B x {fmt(g1l.get('prompt_len'))}-token prompts",
             f"{fmt(g1l.get('tokens_per_s'))} tok/s{mbu}",
-            "long context at flagship scale (grouped ~2k-key cache reads)",
+            "long context at flagship scale (depth-aware bursts; "
+            "ablation grid in BENCH)",
         ))
-    return rows, payload.get("_backfill_note"), payload.get("_source")
+    return rows
 
 
 def main():
-    path, bench = latest_bench()
-    rows, note, src = rows_from(bench, bench_mtime=os.path.getmtime(path))
-    source = (
-        "`BASELINE.json` published"
-        if src == "published"
-        else f"`{os.path.basename(path)}`"
-    )
-    lines = [BEGIN,
-             f"*(generated from {source} — do not edit by hand)*",
+    mt, fronts, captured_at, coherent = load_capture()
+    rows = rows_from(mt, fronts)
+    lines = [BEGIN, provenance(captured_at, coherent),
              "", "| Tier | Published | Reading |", "|---|---|---|"]
     for tier, published, reading in rows:
         lines.append(f"| {tier} | {published} | {reading} |")
-    if note:
-        lines.append("")
-        lines.append(f"*{note}*")
     lines.append(END)
     block = "\n".join(lines)
     arch = os.path.join(ROOT, "ARCHITECTURE.md")
@@ -354,7 +217,7 @@ def main():
     else:
         sys.exit("ARCHITECTURE.md is missing the GEN-NUMBERS markers")
     open(arch, "w").write(text)
-    print(f"regenerated numbers table from {os.path.basename(path)}")
+    print("regenerated numbers table from BASELINE.json published")
 
 
 if __name__ == "__main__":
