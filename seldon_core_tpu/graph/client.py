@@ -58,9 +58,19 @@ class InProcessClient(UnitClient):
         self._executor = executor
 
     async def call(self, method: str, message: Dict[str, Any]) -> Dict[str, Any]:
+        import contextvars
+
         fn = getattr(seldon_methods, method)
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(self._executor, fn, self.user_object, message)
+        # run under a COPY of the caller's context: run_in_executor does
+        # not propagate contextvars, which would strand the active trace
+        # span on the event loop — in-process components (the generate
+        # server threading request timelines into its scheduler) need the
+        # graph-hop span visible on the worker thread
+        ctx = contextvars.copy_context()
+        return await loop.run_in_executor(
+            self._executor, ctx.run, fn, self.user_object, message
+        )
 
     def accepts_device_arrays(self) -> bool:
         """True when this unit is an in-process JAXComponent with a compiled

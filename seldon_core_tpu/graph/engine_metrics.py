@@ -76,6 +76,17 @@ class MetricsRegistry:
         "gen_prefill_tokens": ("seldon_engine_generate_step_tokens", "prefill"),
     }
 
+    # generate SLO TIMERs (per completed request, shipped by the generate
+    # server's metrics() hook) additionally land in first-class latency
+    # histograms per graph node: TTFT, TPOT/inter-token latency, and
+    # admit-queue wait — the DeepServe-style SLO vocabulary, measurable
+    # straight off /prometheus instead of reconstructed from request p50s
+    _SLO_TIMERS = {
+        "gen_ttft_ms": "seldon_engine_generate_ttft_seconds",
+        "gen_tpot_ms": "seldon_engine_generate_tpot_seconds",
+        "gen_queue_wait_ms": "seldon_engine_generate_queue_wait_seconds",
+    }
+
     def record_custom(self, metrics: List[Dict], labels: Dict[str, str] | None = None):
         """Sink for Meta.metrics emitted by components
         (reference: PredictiveUnitBean.addCustomMetrics:318-344)."""
@@ -95,6 +106,9 @@ class MetricsRegistry:
                 self.gauge_set(f"seldon_custom_{key}", val, tags)
             elif mtype == "TIMER":
                 self.observe(f"seldon_custom_{key}", val / 1000.0, tags)
+                slo = self._SLO_TIMERS.get(key)
+                if slo is not None:
+                    self.observe(slo, val / 1000.0, tags)
 
     def quantile(self, name: str, q: float, labels: Dict[str, str] | None = None) -> float:
         """Approximate quantile from histogram buckets (for tests/bench)."""

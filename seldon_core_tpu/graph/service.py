@@ -446,9 +446,37 @@ class EngineApp:
             return Response(self.metrics.expose(), content_type="text/plain; version=0.0.4")
 
         async def traces(req: Request) -> Response:
+            # filterable span buffer: ?operation=<substring>&limit=<N most
+            # recent spans>&since_us=<epoch us> — a 4096-span ring is
+            # inspectable without dumping it whole
             from ..tracing import get_tracer
 
-            return Response(get_tracer().export_jaeger())
+            return Response(get_tracer().export_jaeger(
+                operation=req.params().get("operation"),
+                limit=req.int_param("limit"),
+                since_us=req.int_param("since_us"),
+            ))
+
+        async def flightrecorder(req: Request) -> Response:
+            # scheduler flight recorder of every in-process unit exposing
+            # one (the generate server's continuous batcher): per-poll
+            # batch/group/chunk decisions + SLO reservoir summary, keyed
+            # by unit name. ?limit= caps entries per unit.
+            limit = req.int_param("limit")
+            units: Dict[str, Any] = {}
+            for rt in self.executor._walk(self.executor.root):
+                target = getattr(rt.client, "user_object", None)
+                dump_fn = getattr(target, "flight_dump", None)
+                if dump_fn is None:
+                    continue
+                dump = dump_fn(limit)
+                if dump is not None:
+                    units[rt.name] = dump
+            if not units:
+                return Response(
+                    error_body(404, "no unit exposes a flight recorder"), 404
+                )
+            return Response({"units": units})
 
         app.add_route("/api/v0.1/predictions", predictions)
         app.add_route("/api/v1.0/predictions", predictions)
@@ -536,6 +564,7 @@ class EngineApp:
         app.add_route("/metrics", prometheus)
         app.add_route("/prometheus", prometheus)
         app.add_route("/traces", traces)
+        app.add_route("/flightrecorder", flightrecorder)
         return app
 
     # -- gRPC front ---------------------------------------------------------

@@ -69,6 +69,19 @@ class Request:
         self.headers = headers
         self.body = body
 
+    def params(self) -> Dict[str, str]:
+        """Query string as a flat dict (last value wins per key)."""
+        if not self.query:
+            return {}
+        return {k: v[-1] for k, v in parse_qs(self.query).items()}
+
+    def int_param(self, key: str) -> Optional[int]:
+        """One integer query param, or None when absent/malformed."""
+        try:
+            return int(self.params()[key])
+        except (KeyError, TypeError, ValueError):
+            return None
+
     def json(self):
         """Decode the payload: JSON body, form-encoded ``json=``, query
         ``json=``, or multipart/form-data (reference: the engine accepts

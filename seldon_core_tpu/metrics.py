@@ -4,6 +4,29 @@ Parity with reference: python/seldon_core/metrics.py:8-88 (COUNTER/GAUGE/
 TIMER dicts validated then merged into the response meta), consumed by the
 engine's metrics sink (reference:
 engine/src/main/java/io/seldon/engine/metrics/CustomMetricsManager.java:27-70).
+
+The delta contract
+------------------
+
+The engine sink **sums** every COUNTER value it receives per response
+(``engine_metrics.record_custom``). A component that keeps cumulative
+totals (the continuous batcher's scheduler counters) must therefore ship
+the *increment since its last export*, never the running total — a total
+re-shipped on every scrape would grow the engine series quadratically.
+:class:`CounterDeltas` is the one sanctioned way to do that conversion:
+one instance per component, ``delta = deltas.counter(key, running_total)``
+per export. Rules:
+
+* COUNTER = a delta produced by ``CounterDeltas.counter`` (monotonic
+  source total; the first export ships the whole total as its delta);
+* GAUGE = a level (cache bytes, occupancy, acceptance rate) — ship the
+  current value, the sink overwrites;
+* TIMER = one duration sample in **milliseconds** — the sink divides by
+  1000 into a seconds histogram (one sample per event, e.g. the generate
+  server's per-completion TTFT/TPOT/queue-wait triple).
+
+The generate server's ``metrics()`` hook is the reference implementation
+of all three.
 """
 
 from __future__ import annotations

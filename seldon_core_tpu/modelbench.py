@@ -778,6 +778,7 @@ def bench_generate(
     prefill_chunk: int = 0,
     greedy_probe: int = 0,
     dispatch_floor: bool = False,
+    recorder_probe: bool = False,
 ) -> Dict[str, Any]:
     """DecoderLM generate() through engine REST + continuous batcher.
 
@@ -793,7 +794,15 @@ def bench_generate(
     generations through a knobs-OFF twin server are byte-identical to the
     knobs-on server's (scheduling must never change temperature-0
     output). ``dispatch_floor`` adds the dispatch-bound tokens/s ceiling
-    (see measure_dispatch_floor_us)."""
+    (see measure_dispatch_floor_us).
+
+    The entry always carries the SLO phase breakdown (``slo``: queue-wait
+    / TTFT / TPOT percentiles over the measured window, from the
+    batcher's completed-request reservoir). ``recorder_probe`` adds the
+    flight-recorder overhead guard: two same-session windows with the
+    scheduler flight recorder ON vs OFF plus a greedy byte-identity
+    check — the published ``flight_recorder_probe.overhead_pct`` is what
+    the <=2% leave-it-on budget is audited against."""
     import http.client
 
     from .servers.generateserver import GenerateServer
@@ -873,12 +882,20 @@ def bench_generate(
     # wall cost of re-running the whole bench entry
     windows: List[Tuple[Dict[str, Any], Dict[str, Any]]] = []
     k_burst = component.batcher._k
+    recorder_stats: Optional[Dict[str, Any]] = None
     try:
         for _ in range(max(1, runs)):
             bstats0: Dict[str, Any] = {}
+
+            def window_start():
+                bstats0.update(component.batcher.stats)
+                # SLO reservoir re-opened with the window so the published
+                # phase breakdown excludes warmup completions
+                component.batcher.slo_recent.clear()
+
             w = closed_loop(
                 make_call, seconds, concurrency, warmup_calls=2,
-                on_window_start=lambda: bstats0.update(component.batcher.stats),
+                on_window_start=window_start,
             )
             # window-diff of the scheduler counters: warmup generations ran
             # nearly solo and would bias occupancy low if counted
@@ -886,7 +903,40 @@ def bench_generate(
                 key: v - bstats0.get(key, 0)
                 for key, v in component.batcher.stats.items()
             }
+            w["slo"] = component.batcher.slo_summary()
             windows.append((w, bw))
+        if recorder_probe and component.batcher.flight is not None:
+            # leave-it-on guard: ON vs OFF windows on the SAME loaded
+            # server (same session, same compile caches), plus a direct
+            # greedy byte-identity check across the toggle — recording
+            # must never change outputs and must stay within ~2% tokens/s
+            flight = component.batcher.flight
+            probe_body = {"prompt_tokens": [prompt],
+                          "max_new_tokens": max_new_tokens,
+                          "temperature": 0.0}
+            probe_s = max(1.0, seconds / 2.0)
+            ref_on = component.predict(dict(probe_body), [])["tokens"][0]
+            w_on = closed_loop(make_call, probe_s, concurrency, warmup_calls=1)
+            flight.enabled = False
+            try:
+                ref_off = component.predict(dict(probe_body), [])["tokens"][0]
+                w_off = closed_loop(
+                    make_call, probe_s, concurrency, warmup_calls=1
+                )
+            finally:
+                flight.enabled = True
+            recorder_stats = {
+                "recorder_on_tokens_per_s": w_on["rows_per_s"],
+                "recorder_off_tokens_per_s": w_off["rows_per_s"],
+                "overhead_pct": round(
+                    100.0
+                    * (w_off["rows_per_s"] - w_on["rows_per_s"])
+                    / max(w_off["rows_per_s"], 1e-9),
+                    2,
+                ),
+                "greedy_identical": ref_on == ref_off,
+                "seconds_per_mode": round(probe_s, 2),
+            }
     finally:
         harness.stop()
         if component.batcher is not None:
@@ -959,6 +1009,8 @@ def bench_generate(
     if greedy_identical is not None:
         stats["greedy_identical"] = greedy_identical
         stats["greedy_probe"] = len(probe_prompts)
+    if recorder_stats is not None:
+        stats["flight_recorder_probe"] = recorder_stats
     if dispatch_floor:
         # dispatch-floor roofline (VERDICT r5 #2/#6): a burst can never
         # beat one host round trip, so tokens/s <= slots x k / floor.
@@ -1542,6 +1594,7 @@ def run_model_tier(
                 },
                 peak=peak,
                 dispatch_floor=True,
+                recorder_probe=True,
             )
             # degraded-mode harness proof (chip runs the llm_1b variant)
             results["llm_degraded"] = bench_degraded(
@@ -1667,6 +1720,7 @@ def run_model_tier(
                 peak=peak,
                 hbm_gb_s=hbm,
                 dispatch_floor=True,
+                recorder_probe=True,
             )
             # flagship scale: a 1.26B-param llama-architecture decoder
             # (BASELINE.json config 5's class), bf16-resident, measured at

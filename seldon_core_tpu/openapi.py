@@ -235,7 +235,12 @@ def engine_spec(served_paths=None) -> Dict[str, Any]:
         "/inflight": {"get": _probe_op("Live-request gauge (drain step 2)", "lifecycle")},
         "/prometheus": {"get": _probe_op("Prometheus metrics", "observability")},
         "/metrics": {"get": _probe_op("Prometheus metrics", "observability")},
-        "/traces": {"get": _probe_op("Jaeger-JSON trace export", "observability")},
+        "/traces": {"get": _probe_op(
+            "Jaeger-JSON trace export (?operation=&limit=&since_us=)",
+            "observability")},
+        "/flightrecorder": {"get": _probe_op(
+            "Scheduler flight-recorder dump (generate graphs; ?limit=)",
+            "observability")},
         "/openapi.json": {"get": _probe_op("This document", "meta")},
     }
     return _reconcile(doc, served_paths)

@@ -52,6 +52,12 @@ Model URI layout: same ``jax_config.json`` as jaxserver with
                      decode polls (0 = off) — a 1,792-token admit no
                      longer stalls every decode lane for one
                      prompt-length forward
+    flight_recorder  scheduler flight-recorder capacity: the batcher
+                     keeps this many per-poll decision records in a
+                     bounded drop-oldest ring, dumped at the engine's
+                     ``/flightrecorder`` route (0 = off; default 512 —
+                     cheap enough to leave on, see docs/operate.md
+                     "Observability")
 
 Request (jsonData)::
 
@@ -108,6 +114,7 @@ class GenerateServer(SeldonComponent):
         depth_groups: int = 0,
         depth_group_split_bytes: Optional[int] = None,
         prefill_chunk: int = 0,
+        flight_recorder: int = 512,
         warmup_prompt_lens: Optional[Sequence[int]] = None,
         warmup_max_new_tokens: int = 0,
         **kwargs,
@@ -134,6 +141,7 @@ class GenerateServer(SeldonComponent):
             if depth_group_split_bytes is not None else None
         )
         self._prefill_chunk = int(prefill_chunk)
+        self._flight_recorder = int(flight_recorder)
         # cumulative scheduler stats ship as true counters (deltas)
         # through Meta.metrics
         from ..metrics import CounterDeltas
@@ -241,6 +249,7 @@ class GenerateServer(SeldonComponent):
             depth_groups=self._depth_groups,
             depth_group_split_bytes=self._depth_group_split_bytes,
             prefill_chunk=self._prefill_chunk,
+            flight_recorder_capacity=self._flight_recorder,
         )
         if self._warmup_prompt_lens:
             # compile-before-listen: every prefill/insert/burst variant the
@@ -412,15 +421,34 @@ class GenerateServer(SeldonComponent):
     def tags(self) -> Dict:
         return {"server": "generateserver"}
 
+    def flight_dump(self, limit: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """Scheduler flight-recorder export (the ``/flightrecorder`` route's
+        payload): the per-poll decision ring plus the SLO reservoir summary
+        and a scheduler-stat snapshot, so one dump is enough to attribute a
+        tail-latency regression. None when the recorder is off/not loaded."""
+        if self.batcher is None or self.batcher.flight is None:
+            return None
+        out = self.batcher.flight.dump(limit)
+        out["slo"] = self.batcher.slo_summary()
+        out["stats"] = {k: v for k, v in self.batcher.stats.items()}
+        return out
+
     def metrics(self) -> List[Dict]:
+        """Meta.metrics hook: every cumulative scheduler total ships as a
+        COUNTER **delta** through one CounterDeltas instance (the engine
+        sink sums counter values per response — see metrics.CounterDeltas
+        for the contract), SLO samples ship as per-completion TIMERs the
+        engine folds into TTFT/TPOT/queue-wait histograms, and only true
+        levels (cache bytes, occupancy, acceptance) ship as GAUGEs."""
         if self.batcher is None:
             return []
         s = self.batcher.stats
         delta = self._deltas.counter
         out = [
-            {"type": "GAUGE", "key": "gen_tokens_total", "value": float(s["tokens"])},
-            {"type": "GAUGE", "key": "gen_steps_total", "value": float(s["steps"])},
-            {"type": "GAUGE", "key": "gen_finished_total", "value": float(s["finished"])},
+            delta("gen_tokens", s["tokens"]),
+            delta("gen_steps", s["steps"]),
+            delta("gen_finished", s["finished"]),
+            delta("gen_admitted", s["admitted"]),
             # prefill-vs-decode split: per-node cache wins show up as
             # prefill step/token counters flattening while decode keeps pace
             delta("gen_prefill_steps", s["prefill_steps"]),
@@ -469,4 +497,22 @@ class GenerateServer(SeldonComponent):
                     "value": round(s["spec_emitted"] / s["spec_rounds"], 4),
                 }
             )
+        # SLO samples: one TIMER triple per request completed since the
+        # last export (drained, bounded by the pending ring). The engine
+        # sink turns TIMER ms into seconds histograms per graph node —
+        # TTFT/TPOT/queue-wait become first-class series there
+        # (engine_metrics._SLO_TIMERS).
+        pending = self.batcher.slo_pending
+        while pending:
+            try:
+                queue_wait, ttft, tpot = pending.popleft()
+            except IndexError:  # raced another exporter thread
+                break
+            out.append({"type": "TIMER", "key": "gen_queue_wait_ms",
+                        "value": round(queue_wait * 1e3, 4)})
+            out.append({"type": "TIMER", "key": "gen_ttft_ms",
+                        "value": round(ttft * 1e3, 4)})
+            if tpot is not None:
+                out.append({"type": "TIMER", "key": "gen_tpot_ms",
+                            "value": round(tpot * 1e3, 4)})
         return out

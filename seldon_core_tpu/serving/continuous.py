@@ -96,6 +96,25 @@ class GenRequest:
     # prefill); surfaced per-request so responses/graph nodes can report
     # cache effectiveness
     cache_hit_tokens: int = 0
+    # -- lifecycle timeline (monotonic seconds; 0.0 = not reached) --------
+    # stamped by the scheduler as the request crosses each phase boundary;
+    # feed both the SLO histograms (queue wait / TTFT / TPOT) and — when a
+    # sampled trace context rode in on ``trace`` — the retroactive
+    # per-request timeline spans. Plain float stores: no allocation, no
+    # lock, written by one thread at a time per field.
+    submit_t: float = 0.0
+    admit_t: float = 0.0
+    # lane activation: the moment the prompt K/V landed in the decode
+    # cache (post-insert) — for chunked admissions this is many polls
+    # after admit_t, so decode residency must anchor here, not at admit
+    decode_start_t: float = 0.0
+    first_tok_t: float = 0.0
+    # wall-clock anchor of submit_t (epoch microseconds) so retroactive
+    # spans can place monotonic intervals on the Jaeger timeline
+    submit_wall_us: int = 0
+    # (trace_id, parent_span_id) captured from the submitting thread's
+    # active span; None when tracing is off or the request is unsampled
+    trace: Optional[Tuple[str, str]] = None
 
 
 @dataclasses.dataclass
@@ -169,6 +188,7 @@ class ContinuousBatcher:
         depth_groups: int = 0,
         depth_group_split_bytes: Optional[int] = None,
         prefill_chunk: int = 0,
+        flight_recorder_capacity: int = 512,
     ):
         import jax
         import jax.numpy as jnp
@@ -283,6 +303,30 @@ class ContinuousBatcher:
             "burst_reads": 0, "burst_read_bytes": 0,
             "group_bursts": 0, "group_lanes": 0, "group_pad_lanes": 0,
         }
+        # SLO instrumentation: queue-wait / TTFT / TPOT samples of
+        # COMPLETED requests. ``slo_pending`` is the drain queue the
+        # serving component ships as Meta.metrics TIMERs (drop-oldest
+        # under pressure — telemetry must never grow unbounded);
+        # ``slo_recent`` is a reservoir benches/diagnostics read for
+        # percentiles. Cumulative sums ride in ``stats`` so window-diffed
+        # bench snapshots get means for free.
+        self.slo_pending: "collections.deque" = collections.deque(maxlen=4096)
+        self.slo_recent: "collections.deque" = collections.deque(maxlen=2048)
+        self.stats.update({
+            "slo_samples": 0, "queue_wait_s_sum": 0.0,
+            "ttft_s_sum": 0.0, "tpot_s_sum": 0.0,
+        })
+        # scheduler flight recorder: one structured record per poll (batch
+        # composition, depth-group plan + cost-model verdict, chunk
+        # interleave, shed events), bounded + drop-oldest, cheap enough to
+        # leave on (0 = off)
+        from .flightrecorder import FlightRecorder
+
+        self.flight: Optional[FlightRecorder] = (
+            FlightRecorder(flight_recorder_capacity)
+            if int(flight_recorder_capacity) > 0
+            else None
+        )
         # test/debug hook: set to a list and every dispatched decode
         # (sub)burst appends {"lanes", "attn_len", "need"} — the
         # scheduler-level proof that no lane's read bound exceeds its
@@ -868,6 +912,33 @@ class ContinuousBatcher:
             return None
         return (len(times) - 1) / span
 
+    def slo_summary(self) -> Optional[Dict[str, Any]]:
+        """Percentile summary (ms) of the recent completed-request SLO
+        reservoir: queue wait, TTFT, TPOT. None until a request completes."""
+        samples = list(self.slo_recent)
+        if not samples:
+            return None
+
+        def pct(vals: List[float]) -> Dict[str, float]:
+            vals = sorted(vals)
+            n = len(vals)
+            return {
+                "p50_ms": round(vals[n // 2] * 1e3, 3),
+                "p99_ms": round(vals[min(n - 1, int(n * 0.99))] * 1e3, 3),
+                "mean_ms": round(sum(vals) / n * 1e3, 3),
+            }
+
+        # single-token completions carry tpot=None (no inter-token
+        # interval exists) — excluded here exactly as the TIMER export
+        # excludes them, so /prometheus and this summary agree
+        tpots = [s[2] for s in samples if s[2] is not None]
+        return {
+            "samples": len(samples),
+            "queue_wait_ms": pct([s[0] for s in samples]),
+            "ttft_ms": pct([s[1] for s in samples]),
+            "tpot_ms": pct(tpots) if tpots else None,
+        }
+
     def _shed_check(self, deadline_s: Optional[float]) -> None:
         """Admit-queue shedding, BEFORE the request costs any device work:
         an explicit queue cap, and the deadline-aware rule (expected queue
@@ -878,6 +949,7 @@ class ContinuousBatcher:
 
             rate = self.observed_rate()
             self.stats["shed"] += 1
+            self._note_shed("queue_full", depth, rate)
             raise ShedError(
                 f"admit queue full ({depth} >= {self.admit_queue_limit})",
                 retry_after_s=(depth / rate) if rate else 1.0,
@@ -892,12 +964,33 @@ class ContinuousBatcher:
             from ..resilience import ShedError
 
             self.stats["shed"] += 1
+            self._note_shed("deadline", depth, rate)
             raise ShedError(
                 f"deadline {deadline_s * 1000:.0f}ms below estimated queue "
                 f"wait {est_wait * 1000:.0f}ms ({depth} queued at "
                 f"{rate:.2f} req/s) — shed before work",
                 retry_after_s=est_wait,
             )
+
+    def _note_shed(self, reason: str, depth: int, rate: Optional[float]) -> None:
+        """Flight-recorder + trace breadcrumbs for a shed decision (runs on
+        the SUBMITTING thread, where the request's span is still active)."""
+        if self.flight is not None and self.flight.enabled:
+            self.flight.record({
+                "type": "shed", "reason": reason, "queue": depth,
+                "rate_per_s": round(rate, 3) if rate else None,
+            })
+        from ..tracing import get_tracer
+
+        tracer = get_tracer()
+        if tracer.enabled:
+            parent = tracer.active_span()
+            if parent is not None and parent.trace_id != "0":
+                tracer.record_span(
+                    "gen.shed", parent.trace_id, parent.span_id,
+                    int(time.time() * 1e6), 0,
+                    tags={"reason": reason, "queue_depth": depth},
+                )
 
     def submit(
         self,
@@ -925,6 +1018,21 @@ class ContinuousBatcher:
             seed=int(seed),
             on_tokens=on_tokens,
         )
+        req.submit_t = time.monotonic()
+        req.submit_wall_us = int(time.time() * 1e6)
+        # capture the submitting thread's sampled trace context so the
+        # scheduler thread can parent this request's timeline spans under
+        # the serving span (the engine's graph-hop span, propagated into
+        # this thread by InProcessClient's context copy). The unsampled
+        # sentinel carries trace_id "0" and is skipped — a dropped
+        # request must not grow retroactive span fragments.
+        from ..tracing import get_tracer
+
+        tracer = get_tracer()
+        if tracer.enabled:
+            parent = tracer.active_span()
+            if parent is not None and parent.trace_id != "0":
+                req.trace = (parent.trace_id, parent.span_id)
         # callers read per-request admit metadata (cache_hit_tokens) off
         # the future after it resolves
         req.future.gen_request = req
@@ -1216,6 +1324,23 @@ class ContinuousBatcher:
         ab = self.attn_bucket
         return min(self.max_seq, -(-hi // ab) * ab)
 
+    def _emit_span(self, req: GenRequest, operation: str, start_t: float,
+                   end_t: float, tags: Optional[Dict[str, Any]] = None) -> None:
+        """Retroactive per-request timeline span, parented under the trace
+        context captured at submit(). No-op (one attribute check) for
+        untraced requests, so the scheduler hot path stays clean with
+        tracing off. Monotonic interval endpoints are placed on the wall
+        clock via the request's submit anchor."""
+        if req.trace is None:
+            return
+        from ..tracing import get_tracer
+
+        start_us = req.submit_wall_us + int((start_t - req.submit_t) * 1e6)
+        get_tracer().record_span(
+            operation, req.trace[0], req.trace[1], start_us,
+            int((end_t - start_t) * 1e6), tags=tags,
+        )
+
     def _plan_groups(self, adv: int):
         """Partition live lanes into <= depth_groups sub-bursts by
         attention-read bucket. Returns ``([(lanes, bucket)], need)`` with
@@ -1307,6 +1432,8 @@ class ContinuousBatcher:
         overwrites the donor splice with the same tokens at the same
         absolute positions — idempotent, at most one chunk's extra work."""
         bucket = self._bucket(len(req.tokens))
+        t_admit = time.monotonic()
+        req.admit_t = t_admit
         slab = self._new_slab(bucket)
         start = 0
         if hit is not None:
@@ -1326,6 +1453,11 @@ class ContinuousBatcher:
         self._chunked[slot] = _ChunkJob(
             request=req, slot=slot, next_start=start, slab=slab,
             bucket=bucket, hit_tokens=start,
+        )
+        self._emit_span(
+            req, "gen.queue_wait", req.submit_t, t_admit,
+            tags={"lane": slot, "chunked": True,
+                  "cache_hit_tokens": req.cache_hit_tokens},
         )
 
     def _advance_chunks(self) -> None:
@@ -1357,20 +1489,25 @@ class ContinuousBatcher:
             buf = np.zeros((1, C), np.int32)
             buf[0, : end - start] = req.tokens[start:end]
             attn_len = min(job.bucket, self._attn_need(start + C))
+            t_chunk = time.monotonic()
             try:
-                job.slab, first, lane_key = self._chunk_fn(
-                    self.params, job.slab, jnp.asarray(buf),
-                    jnp.int32(start), jnp.int32(n - 1 - start),
-                    jnp.int32(req.seed), jnp.float32(req.temperature),
-                    attn_len, is_last,
-                )
-                if is_last:
-                    self._cache, self._cur_tok, self._pos, self._keys = (
-                        self._insert_fn(
-                            self._cache, job.slab, slot, first, n, lane_key,
-                            self._cur_tok, self._pos, self._keys,
-                        )
+                from ..tracing import device_trace
+
+                with device_trace("gen.prefill_chunk"):
+                    job.slab, first, lane_key = self._chunk_fn(
+                        self.params, job.slab, jnp.asarray(buf),
+                        jnp.int32(start), jnp.int32(n - 1 - start),
+                        jnp.int32(req.seed), jnp.float32(req.temperature),
+                        attn_len, is_last,
                     )
+                if is_last:
+                    with device_trace("gen.lane_insert"):
+                        self._cache, self._cur_tok, self._pos, self._keys = (
+                            self._insert_fn(
+                                self._cache, job.slab, slot, first, n, lane_key,
+                                self._cur_tok, self._pos, self._keys,
+                            )
+                        )
             except Exception as e:  # noqa: BLE001 - bad request/device state
                 logger.exception("chunked prefill failed")
                 del self._chunked[slot]
@@ -1384,10 +1521,16 @@ class ContinuousBatcher:
             # not a real-prompt-token count
             self.stats["prefill_tokens"] += C
             self.stats["prefill_chunks"] += 1
+            self._emit_span(
+                req, "gen.prefill_chunk", t_chunk, time.monotonic(),
+                tags={"lane": slot, "start": start, "tokens": C,
+                      "last": is_last, "dispatch": True},
+            )
             if is_last:
                 if self.speculate_tokens > 0:
                     self._draft_admit(slot, req)
                 del self._chunked[slot]
+                req.decode_start_t = time.monotonic()
                 self._active[slot] = _Slot(request=req)
                 self._pos_host[slot] = n
                 self._masks_dirty = True
@@ -1445,7 +1588,11 @@ class ContinuousBatcher:
         # runs once per admission, not twice
         import jax.numpy as jnp
 
+        from ..tracing import device_trace
+
         n = len(req.tokens)
+        t_admit = time.monotonic()
+        req.admit_t = t_admit
         if hit is None:
             hit = self._prefix_match(req)
         if hit is not None:
@@ -1455,46 +1602,70 @@ class ContinuousBatcher:
             wb = self._bucket(n - m)
             suffix = np.zeros((1, wb), np.int32)
             suffix[0, : n - m] = req.tokens[m:]
-            first, suffix_slab, lane_key = self._prefix_prefill_fn(
-                self.params,
-                slab,
-                jnp.asarray(suffix),
-                jnp.int32(m),
-                jnp.asarray([n - 1 - m], jnp.int32),
-                jnp.int32(req.seed),
-                jnp.float32(req.temperature),
-            )
-            self._cache, self._cur_tok, self._pos, self._keys = (
-                self._insert_prefix_fn(
-                    self._cache, slab, suffix_slab, slot, jnp.int32(m),
-                    first[0], n, lane_key,
-                    self._cur_tok, self._pos, self._keys,
+            with device_trace("gen.prefill"):
+                first, suffix_slab, lane_key = self._prefix_prefill_fn(
+                    self.params,
+                    slab,
+                    jnp.asarray(suffix),
+                    jnp.int32(m),
+                    jnp.asarray([n - 1 - m], jnp.int32),
+                    jnp.int32(req.seed),
+                    jnp.float32(req.temperature),
                 )
-            )
+            t_insert = time.monotonic()
+            with device_trace("gen.lane_insert"):
+                self._cache, self._cur_tok, self._pos, self._keys = (
+                    self._insert_prefix_fn(
+                        self._cache, slab, suffix_slab, slot, jnp.int32(m),
+                        first[0], n, lane_key,
+                        self._cur_tok, self._pos, self._keys,
+                    )
+                )
             req.cache_hit_tokens = m
             self.stats["prefix_hits"] += 1
             self.stats["prefix_tokens_saved"] += m
             self.stats["prefill_steps"] += 1
             self.stats["prefill_tokens"] += wb
+            self._emit_span(
+                req, "gen.prefill", t_admit, t_insert,
+                tags={"lane": slot, "bucket": wb, "cache_hit_tokens": m,
+                      "dispatch": True},
+            )
         else:
             bucket = self._bucket(n)
             prompt = np.zeros((1, bucket), np.int32)
             prompt[0, :n] = req.tokens
-            first, cache_one, lane_key = self._prefill_fn(
-                self.params,
-                jnp.asarray(prompt),
-                jnp.asarray([n - 1], jnp.int32),
-                jnp.int32(req.seed),
-                jnp.float32(req.temperature),
-            )
-            self._cache, self._cur_tok, self._pos, self._keys = self._insert_fn(
-                self._cache, cache_one, slot, first[0], n, lane_key,
-                self._cur_tok, self._pos, self._keys,
-            )
+            with device_trace("gen.prefill"):
+                first, cache_one, lane_key = self._prefill_fn(
+                    self.params,
+                    jnp.asarray(prompt),
+                    jnp.asarray([n - 1], jnp.int32),
+                    jnp.int32(req.seed),
+                    jnp.float32(req.temperature),
+                )
+            t_insert = time.monotonic()
+            with device_trace("gen.lane_insert"):
+                self._cache, self._cur_tok, self._pos, self._keys = self._insert_fn(
+                    self._cache, cache_one, slot, first[0], n, lane_key,
+                    self._cur_tok, self._pos, self._keys,
+                )
             if self._prefix_index is not None:
                 self.stats["prefix_misses"] += 1
             self.stats["prefill_steps"] += 1
             self.stats["prefill_tokens"] += bucket
+            self._emit_span(
+                req, "gen.prefill", t_admit, t_insert,
+                tags={"lane": slot, "bucket": bucket, "dispatch": True},
+            )
+        t_inserted = time.monotonic()
+        req.decode_start_t = t_inserted
+        self._emit_span(req, "gen.lane_insert", t_insert, t_inserted,
+                        tags={"lane": slot, "dispatch": True})
+        self._emit_span(
+            req, "gen.queue_wait", req.submit_t, t_admit,
+            tags={"lane": slot,
+                  "cache_hit_tokens": req.cache_hit_tokens},
+        )
         if self.speculate_tokens > 0:
             # the draft needs the prompt's K/V prefix too so its proposals
             # attend over the real context (see _draft_admit: re-derived
@@ -1514,7 +1685,10 @@ class ContinuousBatcher:
         speculation — the draft cache path stays per-request."""
         import jax.numpy as jnp
 
+        from ..tracing import device_trace
+
         m = len(reqs)
+        t_admit = time.monotonic()
         prompts = np.zeros((m, bucket), np.int32)
         last = np.zeros((m,), np.int32)
         seeds = np.zeros((m,), np.int32)
@@ -1525,16 +1699,30 @@ class ContinuousBatcher:
             last[i] = n - 1
             seeds[i] = req.seed
             temps[i] = req.temperature
-        firsts, slab, lane_keys = self._prefill_many_fn(
-            self.params, jnp.asarray(prompts), jnp.asarray(last),
-            jnp.asarray(seeds), jnp.asarray(temps),
-        )
-        self._cache, self._cur_tok, self._pos, self._keys = self._insert_many_fn(
-            self._cache, slab, jnp.asarray(np.asarray(slots, np.int32)),
-            firsts, jnp.asarray(last + 1), lane_keys,
-            self._cur_tok, self._pos, self._keys,
-        )
+        with device_trace("gen.prefill"):
+            firsts, slab, lane_keys = self._prefill_many_fn(
+                self.params, jnp.asarray(prompts), jnp.asarray(last),
+                jnp.asarray(seeds), jnp.asarray(temps),
+            )
+        with device_trace("gen.lane_insert"):
+            self._cache, self._cur_tok, self._pos, self._keys = self._insert_many_fn(
+                self._cache, slab, jnp.asarray(np.asarray(slots, np.int32)),
+                firsts, jnp.asarray(last + 1), lane_keys,
+                self._cur_tok, self._pos, self._keys,
+            )
+        t_inserted = time.monotonic()
         for slot, req in zip(slots, reqs):
+            req.admit_t = t_admit
+            req.decode_start_t = t_inserted
+            self._emit_span(
+                req, "gen.queue_wait", req.submit_t, t_admit,
+                tags={"lane": slot, "batched": m},
+            )
+            self._emit_span(
+                req, "gen.prefill", t_admit, t_inserted,
+                tags={"lane": slot, "bucket": bucket, "batched": m,
+                      "dispatch": True},
+            )
             self._active[slot] = _Slot(request=req)
             self._pos_host[slot] = len(req.tokens)
         self._masks_dirty = True
@@ -1550,15 +1738,58 @@ class ContinuousBatcher:
         # counts abandonments (queued or mid-decode) — disjoint, so
         # finished + cancelled = all requests ever resolved
         s.credit_done = True
-        if s.request.future.cancelled():
+        req = s.request
+        now = time.monotonic()
+        if req.future.cancelled():
             self.stats["cancelled"] += 1
+            if req.admit_t:
+                # the lane was reclaimed mid-decode (client disconnect /
+                # deadline): the timeline still shows the residency it
+                # burned, attributed as a cancellation
+                self._emit_span(
+                    req, "gen.decode", req.decode_start_t or req.admit_t, now,
+                    tags={"outcome": "cancelled", "tokens": len(s.emitted)},
+                )
             return
-        if not s.request.future.done():
-            s.request.future.set_result(s.request.tokens + s.emitted)
+        # SLO sample: queue wait / TTFT / TPOT of this completed request.
+        # TTFT and queue wait are submit-anchored (what the client saw);
+        # TPOT averages the inter-token gap over the credited stream.
+        # Recorded BEFORE set_result: resolving the future wakes the
+        # predict thread, whose response path drains slo_pending via
+        # metrics() — the sample and the gen.decode span must already
+        # exist so a request's own response carries its own triple.
+        if req.submit_t:
+            n_tok = len(s.emitted)
+            first = req.first_tok_t or now
+            queue_wait = max(0.0, (req.admit_t or now) - req.submit_t)
+            ttft = max(0.0, first - req.submit_t)
+            # a 1-token generation has no inter-token interval: tpot is
+            # None so the reservoir percentiles, the TIMER export, and
+            # the span tag all skip it the same way instead of counting
+            # a meaningless 0.0 in some views but not others
+            tpot = (now - first) / (n_tok - 1) if n_tok > 1 else None
+            self.stats["slo_samples"] += 1
+            self.stats["queue_wait_s_sum"] += queue_wait
+            self.stats["ttft_s_sum"] += ttft
+            if tpot is not None:
+                self.stats["tpot_s_sum"] += tpot
+            self.slo_pending.append((queue_wait, ttft, tpot))
+            self.slo_recent.append((queue_wait, ttft, tpot))
+            if req.admit_t:
+                tags = {"outcome": "complete", "tokens": n_tok,
+                        "ttft_ms": round(ttft * 1e3, 3)}
+                if tpot is not None:
+                    tags["tpot_ms"] = round(tpot * 1e3, 3)
+                self._emit_span(
+                    req, "gen.decode", req.decode_start_t or req.admit_t,
+                    now, tags=tags,
+                )
+        if not req.future.done():
+            req.future.set_result(req.tokens + s.emitted)
         self.stats["finished"] += 1
         # completion timestamp feeds the observed service rate that the
         # admit-queue shed uses for its expected-wait estimate
-        self._finish_times.append(time.monotonic())
+        self._finish_times.append(now)
 
     def _finish(self, slot: int) -> None:
         s = self._active.pop(slot)
@@ -1589,6 +1820,10 @@ class ContinuousBatcher:
         the caller drops the rest of the burst's tokens for this lane)."""
         req = s.request
         start = len(s.emitted)
+        if start == 0 and len(tokens) and req.first_tok_t == 0.0:
+            # first span of credited tokens = the client-visible TTFT
+            # moment (a float store per REQUEST, not per token)
+            req.first_tok_t = time.monotonic()
         done = False
         for t in tokens:
             s.emitted.append(int(t))
@@ -1660,12 +1895,27 @@ class ContinuousBatcher:
 
         import jax.numpy as jnp
 
+        from ..tracing import device_trace
+
         self._started.set()
         temps = np.zeros((self.slots,), np.float32)
         # in-flight bursts, oldest first: (device tokens, lane snapshot)
         pending: "collections.deque" = collections.deque()
         try:
             while not self._stop.is_set():
+                # flight recorder: counter snapshot at poll start so the
+                # poll record carries DELTAS (what this poll did), plus the
+                # decode plan captured at dispatch below. One small dict
+                # per working poll — never per token.
+                flight = self.flight if (
+                    self.flight is not None and self.flight.enabled
+                ) else None
+                if flight is not None:
+                    f0 = (
+                        self.stats["admitted"], self.stats["prefill_chunks"],
+                        self.stats["prefix_hits"], self.stats["prefix_evicted"],
+                    )
+                poll_plan: Optional[Dict[str, Any]] = None
                 # admit as many queued requests as there are free slots —
                 # same-bucket admissions are grouped so m lanes share one
                 # batched prefill forward (pow2 chunks bound executables)
@@ -1822,14 +2072,20 @@ class ContinuousBatcher:
                             "dk": self._draft_cache["k"],
                             "dv": self._draft_cache["v"],
                         }
-                        (
-                            start_tok, toks, counts, self._cur_tok, self._pos,
-                            self._keys, nc,
-                        ) = self._spec_burst_fn(
-                            self.params, self._draft_params, caches,
-                            self._cur_tok, self._pos, active_dev, temps_dev,
-                            self._keys, k, attn_len, self._any_stoch,
-                        )
+                        with device_trace("gen.decode_burst"):
+                            (
+                                start_tok, toks, counts, self._cur_tok,
+                                self._pos, self._keys, nc,
+                            ) = self._spec_burst_fn(
+                                self.params, self._draft_params, caches,
+                                self._cur_tok, self._pos, active_dev, temps_dev,
+                                self._keys, k, attn_len, self._any_stoch,
+                            )
+                        if flight is not None:
+                            poll_plan = {
+                                "mode": "spec", "k": k, "attn_len": attn_len,
+                                "lanes": len(self._active),
+                            }
                         self._cache = {"k": nc["k"], "v": nc["v"]}
                         self._draft_cache = {"k": nc["dk"], "v": nc["dv"]}
                         self.stats["steps"] += k
@@ -1842,6 +2098,20 @@ class ContinuousBatcher:
                         pending.append(("spec", (start_tok, toks, counts, snapshot, k)))
                     else:
                         groups, need = self._plan_groups(adv)
+                        if flight is not None:
+                            # depth-group plan + cost-model verdict: the
+                            # gap between distinct need-buckets and the
+                            # dispatched group count IS how many splits
+                            # the cost model merged away this poll
+                            poll_plan = {
+                                "mode": "decode", "k": k,
+                                "groups": [
+                                    {"lanes": len(lanes), "bucket": b}
+                                    for lanes, b in groups
+                                ],
+                                "distinct_buckets": len(set(need.values())),
+                                "merged": len(set(need.values())) - len(groups),
+                            }
                         # per-lane bookkeeping happens per SUB-burst: a
                         # lane's tokens are credited against the column it
                         # occupied in the burst that decoded it
@@ -1864,14 +2134,15 @@ class ContinuousBatcher:
                                         slot,
                                     )
                                 rows = self.slots
-                                toks, self._cur_tok, self._pos, self._cache, self._keys = (
-                                    self._burst_fn(
-                                        self.params, self._cache,
-                                        self._cur_tok, self._pos,
-                                        active_dev, temps_dev, self._keys,
-                                        k, g_bucket,
+                                with device_trace("gen.decode_burst"):
+                                    toks, self._cur_tok, self._pos, self._cache, self._keys = (
+                                        self._burst_fn(
+                                            self.params, self._cache,
+                                            self._cur_tok, self._pos,
+                                            active_dev, temps_dev, self._keys,
+                                            k, g_bucket,
+                                        )
                                     )
-                                )
                             else:
                                 gb = self._group_size_bucket(len(lanes))
                                 pads = [
@@ -1882,14 +2153,15 @@ class ContinuousBatcher:
                                     lanes + pads, jnp.int32
                                 )
                                 rows = gb
-                                toks, self._cur_tok, self._pos, self._cache, self._keys = (
-                                    self._group_burst_fn(
-                                        self.params, self._cache,
-                                        self._cur_tok, self._pos,
-                                        temps_dev, self._keys, lane_ix,
-                                        len(lanes), k, g_bucket,
+                                with device_trace("gen.decode_burst"):
+                                    toks, self._cur_tok, self._pos, self._cache, self._keys = (
+                                        self._group_burst_fn(
+                                            self.params, self._cache,
+                                            self._cur_tok, self._pos,
+                                            temps_dev, self._keys, lane_ix,
+                                            len(lanes), k, g_bucket,
+                                        )
                                     )
-                                )
                                 self.stats["group_bursts"] += 1
                                 self.stats["group_lanes"] += len(lanes)
                                 self.stats["group_pad_lanes"] += gb - len(lanes)
@@ -1940,6 +2212,30 @@ class ContinuousBatcher:
                             self._pos_host.pop(slot, None)
                         if freed:
                             self._masks_dirty = True
+                if flight is not None:
+                    admitted = self.stats["admitted"] - f0[0]
+                    chunks = self.stats["prefill_chunks"] - f0[1]
+                    hits = self.stats["prefix_hits"] - f0[2]
+                    evicted = self.stats["prefix_evicted"] - f0[3]
+                    if poll_plan is not None or admitted or chunks:
+                        entry: Dict[str, Any] = {
+                            "type": "poll",
+                            "queue": self._queue.qsize(),
+                            "active": len(self._active),
+                            "chunked": len(self._chunked),
+                            "pending_bursts": len(pending),
+                        }
+                        if admitted:
+                            entry["admitted"] = admitted
+                        if chunks:
+                            entry["prefill_chunks"] = chunks
+                        if hits:
+                            entry["prefix_hits"] = hits
+                        if evicted:
+                            entry["prefix_evicted"] = evicted
+                        if poll_plan is not None:
+                            entry["plan"] = poll_plan
+                        flight.record(entry)
                 # read bursts oldest-first: always when the pipeline is full
                 # (or nothing is left to dispatch) — and OPPORTUNISTICALLY
                 # when a burst's token copy has already landed on the host

@@ -54,6 +54,10 @@ class Span:
     duration_us: int = 0
     tags: Dict[str, Any] = field(default_factory=dict)
     logs: List[Dict[str, Any]] = field(default_factory=list)
+    # uber-trace-id flags byte; bit 0 is the SAMPLED bit. Locally created
+    # spans only exist when sampled, so 1 is the default — extracted
+    # remote stubs carry whatever the upstream hop decided.
+    flags: int = 1
 
     def set_tag(self, key: str, value: Any) -> "Span":
         self.tags[key] = value
@@ -63,7 +67,7 @@ class Span:
         self.logs.append({"timestamp": int(time.time() * 1e6), "fields": fields})
 
     def context_header(self) -> str:
-        return f"{self.trace_id}:{self.span_id}:{self.parent_id or '0'}:1"
+        return f"{self.trace_id}:{self.span_id}:{self.parent_id or '0'}:{self.flags:x}"
 
 
 class Tracer:
@@ -100,9 +104,16 @@ class Tracer:
             return
         parent = self.extract(headers) if headers and TRACE_HEADER in headers else _current_span.get()
         if parent is _UNSAMPLED:
-            # inside an unsampled request: children must not re-roll the
-            # dice (they would export orphan fragments of dropped traces)
-            yield _NOOP_SPAN
+            # inside an unsampled request — locally decided OR told so by
+            # the upstream hop's flags — children must not re-roll the
+            # dice (they would export orphan fragments of dropped traces).
+            # Pin the context so nested spans and inject() see the
+            # decision even when it arrived via an extracted header.
+            token = _current_span.set(_UNSAMPLED)
+            try:
+                yield _NOOP_SPAN
+            finally:
+                _current_span.reset(token)
             return
         if parent is None and self.sample_rate < 1.0:
             # per-request head sampling: the ROOT decides; the decision is
@@ -121,6 +132,10 @@ class Tracer:
             parent_id=parent.span_id if parent else None,
             start_us=int(time.time() * 1e6),
             tags=dict(tags or {}),
+            # inherit the parent's flags byte so upstream bits beyond
+            # SAMPLED (e.g. Jaeger's DEBUG 0x2) survive the hop instead
+            # of resetting to the local default at the first child
+            flags=parent.flags if parent is not None else 1,
         )
         token = _current_span.set(s)
         t0 = time.perf_counter()
@@ -172,25 +187,86 @@ class Tracer:
     def active_span(self) -> Optional[Span]:
         return _current_span.get()
 
+    def record_span(
+        self,
+        operation: str,
+        trace_id: str,
+        parent_id: Optional[str],
+        start_us: int,
+        duration_us: int,
+        tags: Optional[Dict[str, Any]] = None,
+    ) -> Optional[Span]:
+        """Append an already-finished span with explicit timing/parentage.
+
+        The generation scheduler runs on its own thread and learns phase
+        boundaries retroactively (a request's queue wait is only known at
+        admit, its decode residency at completion), so it cannot use the
+        context-manager span() — it records finished spans against the
+        trace context captured at submit(). Sampling was already decided
+        by that context's root: a request without a sampled parent never
+        reaches here (the caller holds no trace ids for it)."""
+        if not self.enabled:
+            return None
+        s = Span(
+            operation=operation,
+            trace_id=trace_id,
+            span_id=_rand_id(),
+            parent_id=parent_id,
+            start_us=int(start_us),
+            duration_us=max(0, int(duration_us)),
+            tags=dict(tags or {}),
+        )
+        with self._lock:
+            self._spans.append(s)
+            do_flush = False
+            if self.exporter is not None:
+                self._pending.append(s)
+                do_flush = len(self._pending) >= 64
+        if do_flush:
+            self.flush()
+        return s
+
     # -- propagation --------------------------------------------------------
 
     def inject(self, headers: Dict[str, str]) -> Dict[str, str]:
         s = _current_span.get()
-        if s is not None and s is not _UNSAMPLED and self.enabled:
+        if not self.enabled or s is None:
+            return headers
+        if s is _UNSAMPLED:
+            # the root dropped this request: tell the next hop so IT does
+            # not re-sample and export orphan fragments of a dead trace.
+            # Only the flags byte carries information across the hop, but
+            # the ids must still be valid non-zero values — standard
+            # jaeger clients treat a zero trace id as a corrupted context
+            # and would fall back to starting a fresh sampled root.
+            headers[TRACE_HEADER] = f"{_rand_id()}:{_rand_id()}:0:0"
+        else:
             headers[TRACE_HEADER] = s.context_header()
         return headers
 
     @staticmethod
     def extract(headers: Dict[str, str]) -> Optional[Span]:
-        """Parse an incoming uber-trace-id into a remote parent stub."""
+        """Parse an incoming uber-trace-id into a remote parent stub.
+
+        The flags field's sampled bit is honored: a header whose upstream
+        hop decided NOT to sample yields the pinned-unsampled sentinel, so
+        this hop's spans no-op instead of re-rolling the sampling dice on
+        a request the root already dropped."""
         raw = headers.get(TRACE_HEADER) or headers.get(TRACE_HEADER.title())
         if not raw:
             return None
         parts = raw.split(":")
         if len(parts) != 4:
             return None
+        try:
+            flags = int(parts[3], 16)
+        except ValueError:
+            return None
+        if not flags & 1:
+            return _UNSAMPLED
         return Span(operation="<remote>", trace_id=parts[0], span_id=parts[1],
-                    parent_id=None if parts[2] == "0" else parts[2])
+                    parent_id=None if parts[2] == "0" else parts[2],
+                    flags=flags)
 
     # -- export -------------------------------------------------------------
 
@@ -202,10 +278,29 @@ class Tracer:
         with self._lock:
             self._spans.clear()
 
-    def export_jaeger(self) -> Dict[str, Any]:
-        """Jaeger HTTP API JSON shape: {"data": [{traceID, spans, processes}]}."""
+    def export_jaeger(
+        self,
+        operation: Optional[str] = None,
+        limit: Optional[int] = None,
+        since_us: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Jaeger HTTP API JSON shape: {"data": [{traceID, spans, processes}]}.
+
+        Filters (all optional, served as ``/traces`` query params so a
+        4096-span buffer is inspectable without dumping it whole):
+        ``operation`` keeps spans whose operation name contains the
+        substring, ``since_us`` keeps spans starting at/after the epoch
+        microsecond, ``limit`` keeps only the N most recent matching
+        spans (finish order)."""
+        spans = self.finished_spans()
+        if operation:
+            spans = [s for s in spans if operation in s.operation]
+        if since_us is not None:
+            spans = [s for s in spans if s.start_us >= since_us]
+        if limit is not None and limit >= 0:
+            spans = spans[-limit:] if limit else []
         by_trace: Dict[str, List[Span]] = {}
-        for s in self.finished_spans():
+        for s in spans:
             by_trace.setdefault(s.trace_id, []).append(s)
         data = []
         for trace_id, spans in by_trace.items():

@@ -61,7 +61,13 @@ def get_rest_microservice(
 
     def _sync(fn, *args):
         # Hooks are sync (numpy/jax); never run them on the event loop.
-        return asyncio.get_running_loop().run_in_executor(pool, fn, *args)
+        # Context-copied so the server-side trace span opened below is
+        # visible on the worker thread (the generate server reads it to
+        # parent per-request timeline spans).
+        import contextvars
+
+        ctx = contextvars.copy_context()
+        return asyncio.get_running_loop().run_in_executor(pool, ctx.run, fn, *args)
 
     PROTO_TYPES = ("application/x-protobuf", "application/octet-stream")
 
@@ -156,6 +162,16 @@ def get_rest_microservice(
     app.add_route("/pause", pause)
     app.add_route("/unpause", unpause)
     app.add_route("/openapi.json", openapi)
+    if hasattr(user_object, "flight_dump"):
+        # standalone generate servers expose their scheduler flight
+        # recorder here too (the engine serves the graph-wide twin)
+        async def flightrecorder(req: Request) -> Response:
+            dump = user_object.flight_dump(req.int_param("limit"))
+            if dump is None:
+                return Response(error_body(404, "flight recorder is off"), 404)
+            return Response(dump)
+
+        app.add_route("/flightrecorder", flightrecorder)
     return app
 
 

@@ -262,6 +262,100 @@ def test_engine_and_wrapper_spans_land_in_collector(monkeypatch):
     # tracer here, but it crossed the REST hop via uber-trace-id)
 
 
+def test_sampled_bit_honored_across_hops():
+    """The flags field of uber-trace-id carries the root's sampling
+    decision: a downstream hop must NOT re-sample a request the upstream
+    hop already dropped (it would export orphan fragments)."""
+    upstream = Tracer("up", enabled=True, sample_rate=0.0)
+    with upstream.span("root") as s:
+        headers = upstream.inject({})
+        # the dropped request still propagates a context — flags 0
+        assert headers[TRACE_HEADER].endswith(":0")
+        assert s.operation == "noop"
+    assert upstream.finished_spans() == []
+
+    downstream = Tracer("down", enabled=True, sample_rate=1.0)
+    with downstream.span("server", headers=headers):
+        with downstream.span("nested"):
+            pass
+        # nested hops inherit the drop too
+        out = downstream.inject({})
+        assert out[TRACE_HEADER].endswith(":0")
+    assert downstream.finished_spans() == []
+
+    # sampled header (flags 1) keeps working, and flags parse as hex
+    assert Tracer.extract({TRACE_HEADER: "aaaa:bbbb:0:1"}).trace_id == "aaaa"
+    assert Tracer.extract({TRACE_HEADER: "aaaa:bbbb:0:3"}).flags == 3
+    assert Tracer.extract({TRACE_HEADER: "aaaa:bbbb:0:zz"}) is None
+    with downstream.span("kept", headers={TRACE_HEADER: "aaaa:bbbb:0:1"}):
+        pass
+    assert len(downstream.finished_spans()) == 1
+
+
+def test_sampled_context_header_keeps_flags():
+    t = Tracer(enabled=True)
+    with t.span("parent") as s:
+        assert s.context_header().endswith(":1")
+
+
+def test_traces_export_filters():
+    """/traces query params: operation substring, since_us floor, limit
+    keeps the N most recent spans."""
+    import time as _time
+
+    t = Tracer("filt", enabled=True)
+    with t.span("alpha.op"):
+        pass
+    with t.span("beta.op"):
+        pass
+    _time.sleep(0.002)  # distinct start_us for the since_us cutoff
+    with t.span("alpha.other"):
+        pass
+    spans = t.finished_spans()
+
+    def ops(out):
+        return [s["operationName"] for tr in out["data"] for s in tr["spans"]]
+
+    assert sorted(ops(t.export_jaeger(operation="alpha"))) == [
+        "alpha.op", "alpha.other"
+    ]
+    assert ops(t.export_jaeger(operation="nothing")) == []
+    assert ops(t.export_jaeger(limit=1)) == ["alpha.other"]
+    cutoff = spans[-1].start_us
+    assert "beta.op" not in ops(t.export_jaeger(since_us=cutoff))
+    # no filters = everything (back compat)
+    assert len(ops(t.export_jaeger())) == 3
+
+
+def test_traces_route_query_params():
+    """The engine's /traces route parses the query string into filters."""
+    import asyncio
+
+    from seldon_core_tpu.http_server import Request
+
+    init_tracer("route-test", enabled=True)
+    tracer = get_tracer()
+    with tracer.span("keep.me"):
+        pass
+    with tracer.span("drop.me"):
+        pass
+    spec = default_predictor(
+        PredictorSpec.from_dict(
+            {"name": "p", "graph": {"name": "m",
+                                    "implementation": "SIMPLE_MODEL"}}
+        )
+    )
+    app = EngineApp(spec)
+    handler = app.rest_app().routes["/traces"]
+    resp = asyncio.run(
+        handler(Request("GET", "/traces", "operation=keep&limit=10", {}, b""))
+    )
+    out = json.loads(resp.body)
+    ops = [s["operationName"] for tr in out["data"] for s in tr["spans"]]
+    assert ops == ["keep.me"]
+    init_tracer(enabled=False)
+
+
 def test_probabilistic_sampling_gates_root_spans(monkeypatch):
     monkeypatch.setenv("TRACING", "1")
     monkeypatch.delenv("JAEGER_AGENT_HOST", raising=False)

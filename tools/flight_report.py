@@ -1,0 +1,172 @@
+#!/usr/bin/env python3
+"""Turn a scheduler flight-recorder dump into a human-readable diagnosis.
+
+Input: the JSON served at the engine's ``/flightrecorder`` route (either
+the full ``{"units": {name: dump}}`` payload or one unit's dump), from a
+file argument or stdin (``-``). Output: a per-unit report attributing
+where generation time is going — queue wait vs first-token latency vs
+decode pacing — plus what the scheduler actually decided poll by poll
+(depth-group splits and cost-model merges, chunked-prefill interleave,
+prefix-cache hits, shed events).
+
+Usage::
+
+    curl -s localhost:8000/flightrecorder | python tools/flight_report.py -
+    python tools/flight_report.py dump.json
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List
+
+
+def _pct(n: float, d: float) -> float:
+    return 100.0 * n / d if d else 0.0
+
+
+def diagnose(dump: Dict[str, Any]) -> List[str]:
+    """Report lines for one unit's flight-recorder dump."""
+    lines: List[str] = []
+    entries = dump.get("entries") or []
+    polls = [e for e in entries if e.get("type") == "poll"]
+    sheds = [e for e in entries if e.get("type") == "shed"]
+    lines.append(
+        f"recorded {dump.get('recorded_total', len(entries))} records "
+        f"(ring holds {len(entries)}, dropped "
+        f"{dump.get('dropped', 0)} oldest)"
+    )
+
+    # -- SLO attribution ----------------------------------------------------
+    slo = dump.get("slo")
+    if slo:
+        qw, ttft, tpot = slo["queue_wait_ms"], slo["ttft_ms"], slo["tpot_ms"]
+        # tpot is None when every completion was single-token (no
+        # inter-token interval exists)
+        tpot_txt = (
+            f"TPOT p50 {tpot['p50_ms']}ms / p99 {tpot['p99_ms']}ms"
+            if tpot else "TPOT n/a (single-token completions)"
+        )
+        lines.append(
+            f"SLO over {slo['samples']} completed requests: "
+            f"queue wait p50 {qw['p50_ms']}ms / p99 {qw['p99_ms']}ms, "
+            f"TTFT p50 {ttft['p50_ms']}ms / p99 {ttft['p99_ms']}ms, "
+            f"{tpot_txt}"
+        )
+        # what dominates the tail: the wait before a lane, or the work on it
+        prefill_p99 = max(0.0, ttft["p99_ms"] - qw["p99_ms"])
+        if ttft["p99_ms"] > 0:
+            if qw["p99_ms"] >= 0.5 * ttft["p99_ms"]:
+                lines.append(
+                    f"DIAGNOSIS: p99 TTFT dominated by QUEUE WAIT "
+                    f"({_pct(qw['p99_ms'], ttft['p99_ms']):.0f}% of it) — "
+                    "add lanes/chips or shed earlier; the scheduler is not "
+                    "the bottleneck"
+                )
+            else:
+                lines.append(
+                    f"DIAGNOSIS: p99 TTFT dominated by ADMIT+PREFILL "
+                    f"(~{prefill_p99:.1f}ms after the queue) — look at "
+                    "prefill bucketing / chunked-prefill interleave"
+                )
+    else:
+        lines.append("SLO: no completed requests in the reservoir yet")
+
+    if not polls:
+        lines.append("no poll records (no traffic since the ring opened)")
+        if sheds:
+            lines.append(f"{len(sheds)} shed events recorded")
+        return lines
+
+    # -- batch composition --------------------------------------------------
+    avg_active = sum(p.get("active", 0) for p in polls) / len(polls)
+    avg_queue = sum(p.get("queue", 0) for p in polls) / len(polls)
+    admits = sum(p.get("admitted", 0) for p in polls)
+    lines.append(
+        f"{len(polls)} working polls: avg {avg_active:.1f} active lanes, "
+        f"avg admit-queue depth {avg_queue:.1f}, {admits} admissions"
+    )
+
+    # -- depth-group plan + cost-model verdicts ------------------------------
+    planned = [p for p in polls if "plan" in p]
+    decode = [p for p in planned if p["plan"].get("mode") == "decode"]
+    if decode:
+        split = [p for p in decode if len(p["plan"].get("groups", [])) > 1]
+        merged_polls = [p for p in decode if p["plan"].get("merged", 0) > 0]
+        mixed = [p for p in decode if p["plan"].get("distinct_buckets", 1) > 1]
+        lines.append(
+            f"depth grouping: {len(mixed)}/{len(decode)} decode polls had "
+            f"mixed attention depths; {_pct(len(split), len(decode)):.0f}% "
+            f"dispatched split sub-bursts, cost model merged groups on "
+            f"{_pct(len(merged_polls), len(decode)):.0f}% of polls"
+        )
+        if mixed and not split:
+            lines.append(
+                "DIAGNOSIS: depths mix but every poll merged — either "
+                "depth_groups is off/1 or the cost model says splits don't "
+                "pay at this model size (see depth_group_split_bytes)"
+            )
+    spec = [p for p in planned if p["plan"].get("mode") == "spec"]
+    if spec:
+        lines.append(f"speculative decode: {len(spec)} spec-burst polls")
+
+    # -- chunked prefill interleave ------------------------------------------
+    chunk_polls = [p for p in polls if p.get("prefill_chunks")]
+    if chunk_polls:
+        n_chunks = sum(p["prefill_chunks"] for p in chunk_polls)
+        lines.append(
+            f"chunked prefill: {n_chunks} chunks interleaved across "
+            f"{len(chunk_polls)} polls "
+            f"({_pct(len(chunk_polls), len(polls)):.0f}% of polls carried a "
+            "chunk between decode bursts)"
+        )
+
+    # -- prefix cache ---------------------------------------------------------
+    hits = sum(p.get("prefix_hits", 0) for p in polls)
+    evicted = sum(p.get("prefix_evicted", 0) for p in polls)
+    if hits or evicted:
+        lines.append(
+            f"prefix cache: {hits} admit hits, {evicted} radix evictions "
+            "inside the recorded window"
+        )
+
+    # -- shed -----------------------------------------------------------------
+    if sheds:
+        reasons: Dict[str, int] = {}
+        for s in sheds:
+            reasons[s.get("reason", "?")] = reasons.get(s.get("reason", "?"), 0) + 1
+        lines.append(
+            "load shedding: "
+            + ", ".join(f"{n}x {r}" for r, n in sorted(reasons.items()))
+        )
+        lines.append(
+            "DIAGNOSIS: requests were shed before work — clients saw 429s; "
+            "queue depth above exceeds what the observed completion rate "
+            "can drain"
+        )
+    return lines
+
+
+def render(payload: Dict[str, Any]) -> str:
+    units = payload.get("units")
+    if units is None:
+        units = {"(batcher)": payload}
+    out: List[str] = []
+    for name, dump in units.items():
+        out.append(f"=== flight report: {name} ===")
+        out.extend("  " + line for line in diagnose(dump))
+    return "\n".join(out)
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) != 2 or argv[1] in ("-h", "--help"):
+        print(__doc__, file=sys.stderr)
+        return 2
+    raw = sys.stdin.read() if argv[1] == "-" else open(argv[1]).read()
+    print(render(json.loads(raw)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

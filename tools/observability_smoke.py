@@ -1,0 +1,128 @@
+#!/usr/bin/env python3
+"""CI smoke for the generation-path observability stack.
+
+Boots a tiny generate server behind a real engine on sockets, runs a few
+requests, then asserts the whole observability surface is live:
+
+* ``/prometheus`` exposes the first-class SLO series
+  (``seldon_engine_generate_ttft_seconds`` / ``..._tpot_seconds`` /
+  ``..._queue_wait_seconds`` histograms);
+* ``/flightrecorder`` returns well-formed JSON with per-poll records and
+  an SLO summary (and ``tools/flight_report.py`` can render it);
+* ``/traces`` shows a generate request as ONE stitched trace:
+  queue-wait → prefill → decode spans under the engine's root span.
+
+Run directly (``JAX_PLATFORMS=cpu python tools/observability_smoke.py``)
+or from the CI observability step. Exits non-zero on any failed check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import http.client
+
+    from seldon_core_tpu.modelbench import EngineHarness, write_model_dir
+    from seldon_core_tpu.servers.generateserver import GenerateServer
+    from seldon_core_tpu.tracing import get_tracer, init_tracer
+
+    init_tracer("obs-smoke", enabled=True)
+    failures = []
+
+    def check(name: str, ok: bool, detail: str = ""):
+        print(f"{'ok  ' if ok else 'FAIL'} {name}" + (f": {detail}" if detail else ""))
+        if not ok:
+            failures.append(name)
+
+    with tempfile.TemporaryDirectory(prefix="obs-smoke-") as root:
+        model_dir = write_model_dir(root, "llm", {
+            "vocab_size": 256, "d_model": 32, "n_layers": 2, "n_heads": 2,
+            "n_kv_heads": 2, "d_ff": 64, "max_seq": 64,
+        })
+        component = GenerateServer(model_uri=model_dir, slots=2,
+                                   steps_per_poll=4, attn_bucket=16)
+        component.load()
+        harness = EngineHarness(component, name="obs-smoke").start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", harness.http_port)
+            body = json.dumps({"jsonData": {
+                "prompt_tokens": [[1, 2, 3, 4, 5]],
+                "max_new_tokens": 6, "temperature": 0.0,
+            }}).encode()
+            for _ in range(3):
+                conn.request("POST", "/api/v0.1/predictions", body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                payload = resp.read()
+                check("predict 200", resp.status == 200, payload[:120].decode("utf-8", "replace"))
+
+            conn.request("GET", "/metrics")
+            metrics = conn.getresponse().read().decode()
+            for series in (
+                "seldon_engine_generate_ttft_seconds",
+                "seldon_engine_generate_tpot_seconds",
+                "seldon_engine_generate_queue_wait_seconds",
+            ):
+                check(f"/metrics has {series}", f"{series}_bucket" in metrics)
+
+            conn.request("GET", "/flightrecorder")
+            resp = conn.getresponse()
+            check("/flightrecorder 200", resp.status == 200)
+            fr = json.loads(resp.read())
+            units = fr.get("units") or {}
+            check("/flightrecorder has a unit dump", bool(units))
+            dump = next(iter(units.values()), {})
+            check("flight recorder recorded polls",
+                  any(e.get("type") == "poll" for e in dump.get("entries", [])))
+            check("flight recorder has SLO summary",
+                  bool((dump.get("slo") or {}).get("samples")))
+
+            sys.path.insert(0, os.path.dirname(__file__))
+            from flight_report import render
+
+            report = render(fr)
+            check("flight_report renders", "flight report" in report
+                  and "SLO over" in report)
+
+            conn.request("GET", "/traces?operation=gen.")
+            resp = conn.getresponse()
+            check("/traces 200", resp.status == 200)
+            traces = json.loads(resp.read())
+            ops = {
+                s["operationName"]
+                for t in traces.get("data", [])
+                for s in t.get("spans", [])
+            }
+            for op in ("gen.queue_wait", "gen.prefill", "gen.decode"):
+                check(f"/traces has {op}", op in ops, str(sorted(ops)))
+            # one request = one stitched trace: a gen.decode span shares its
+            # trace id with the engine's root predictions span
+            full = get_tracer().export_jaeger()
+            stitched = False
+            for t in full["data"]:
+                names = {s["operationName"] for s in t["spans"]}
+                if "predictions" in names and "gen.decode" in names:
+                    stitched = True
+            check("generate spans stitch under the engine root", stitched)
+        finally:
+            harness.stop()
+            if component.batcher is not None:
+                component.batcher.close()
+            init_tracer(enabled=False)
+
+    if failures:
+        print(f"\nobservability smoke FAILED: {failures}", file=sys.stderr)
+        return 1
+    print("\nobservability smoke passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
