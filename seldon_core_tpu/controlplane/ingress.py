@@ -289,9 +289,22 @@ class Gateway:
             # req.json() handles both raw JSON and the reference's
             # form-encoded `json=` body style
             payload = req.json()
-            for s in shadows:
-                t = asyncio.ensure_future(gw._forward(s, api_path, payload))
-                t.add_done_callback(_log_shadow_failure)
+            # legacy gateway-side mirroring ONLY when the engine doesn't
+            # mirror for itself: a rollout wires a bounded, diffing
+            # ShadowMirror onto the primary's EngineApp (rollout/mirror.py),
+            # and double-mirroring would send shadows every request twice.
+            # The engine mirrors PREDICTIONS only — feedback (reward
+            # signals a shadow's routers need) still fans out here even
+            # mid-rollout
+            engine_mirrors = (
+                getattr(getattr(primary, "app", None), "shadow_mirror", None)
+                is not None
+                and (api_path.endswith("/predictions") or api_path == "/predict")
+            )
+            if not engine_mirrors:
+                for s in shadows:
+                    t = asyncio.ensure_future(gw._forward(s, api_path, payload))
+                    t.add_done_callback(_log_shadow_failure)
             try:
                 out = await gw._forward(primary, api_path, payload)
             except LookupError as e:

@@ -71,6 +71,13 @@ class DeploymentController:
         self.autoscale_period_s = 5.0
         self.scale_down_ticks = 3
         self._scale_down_streak: Dict[Tuple[str, str], int] = {}
+        # progressive delivery: the rollout state machines tick alongside
+        # the autoscaler (rollout/controller.py); weight updates land as
+        # store.apply generation bumps this controller then reconciles
+        from ..rollout import RolloutController
+
+        self.rollout = RolloutController(store)
+        self.rollout_period_s = 1.0
 
     # -- desired state ------------------------------------------------------
 
@@ -356,7 +363,93 @@ class DeploymentController:
             self.gateway.set_routes(
                 dep, self._routable_endpoints(dep), self._explainer_endpoints(dep)
             )
+        self._wire_shadow_mirrors(dep)
         return status
+
+    def _wire_shadow_mirrors(self, dep: SeldonDeployment) -> None:
+        """Shadow-mode rollouts mirror at the ENGINE: every live
+        predictor's EngineApp gets a bounded, diffing ShadowMirror whose
+        targets are the candidate's engines (the gateway then skips its
+        legacy fire-and-forget for this deployment). Cleared — restoring
+        the byte-identical no-rollout path — whenever no shadow rollout is
+        active."""
+        from ..rollout import ShadowMirror, plan_from_deployment
+
+        try:
+            plan = plan_from_deployment(dep)
+        except GraphSpecError:
+            plan = None
+        if (
+            plan is not None
+            and plan.mode == "shadow"
+            and self.rollout is not None
+            and not self.rollout.shadow_active(dep, plan)
+        ):
+            # terminal rollout (failed on divergence, or promoted): no
+            # longer active — keeping the mirror attached would double
+            # every live request's device load forever just because the
+            # annotations are still on the spec
+            plan = None
+        engines = self._routable_endpoints(dep)
+        shadow_preds = {
+            p.name for p in dep.predictors
+            if p.annotations.get("seldon.io/shadow", "false") == "true"
+        }
+        mirror_targets = None
+        if plan is not None and plan.mode == "shadow":
+            # EVERY shadow predictor stays a target (a plain shadow must
+            # not starve because a rollout candidate exists beside it —
+            # the gateway's legacy mirror is suppressed for the whole
+            # deployment), but only ONE handle per predictor: mirroring
+            # each replica would multiply duplicate dispatch and inflate
+            # the divergence denominator min_samples reads
+            targets = []
+            for pred in sorted(shadow_preds):
+                for h in engines.get(pred, []):
+                    if getattr(h, "app", None) is not None or h.spec.http_port:
+                        targets.append((pred, h))
+                        break
+            if targets:
+                mirror_targets = targets
+            else:
+                logger.warning(
+                    "rollout %s: shadow mode but no mirrorable shadow "
+                    "endpoint — the rollout will pause forever "
+                    "(mirroring needs in-process or HTTP-reachable "
+                    "shadow engines)", dep.key,
+                )
+        mirrors_wired = 0
+        for pred, handles in engines.items():
+            if mirror_targets is not None and pred in shadow_preds:
+                continue  # shadows never re-mirror
+            for h in handles:
+                app = getattr(h, "app", None)
+                if app is None:
+                    continue
+                if mirror_targets is None:
+                    app.shadow_mirror = None
+                    continue
+                cur = getattr(app, "shadow_mirror", None)
+                if (
+                    cur is not None
+                    and cur.deployment == dep.key
+                    and cur.targets == mirror_targets
+                ):
+                    mirrors_wired += 1
+                    continue  # unchanged: keep counts/bound/divergence ring
+                app.shadow_mirror = ShadowMirror(
+                    mirror_targets,
+                    deployment=dep.key,
+                    metrics=getattr(app, "metrics", None),
+                )
+                mirrors_wired += 1
+        if mirror_targets is not None and mirrors_wired == 0:
+            logger.warning(
+                "rollout %s: shadow mode but no in-process live engine to "
+                "mirror FROM — no mirrored samples will arrive and the "
+                "rollout will pause forever (shadow rollouts need the "
+                "default in-process engine runtime)", dep.key,
+            )
 
     def _wire_explainer_endpoint(self, spec: ComponentSpec, desired_names) -> None:
         if any((p or {}).get("name") == "predictor_endpoint" for p in spec.parameters or []):
@@ -575,6 +668,7 @@ class DeploymentController:
             await self.reconcile(dep.clone())
         loop = asyncio.get_running_loop()
         next_autoscale = loop.time() + self.autoscale_period_s
+        next_rollout = loop.time() + self.rollout_period_s
         try:
             while stop_event is None or not stop_event.is_set():
                 if loop.time() >= next_autoscale:
@@ -584,6 +678,27 @@ class DeploymentController:
                     except Exception:  # noqa: BLE001 - probe hiccups must
                         # not kill the manager loop
                         logger.exception("autoscale pass failed")
+                if loop.time() >= next_rollout:
+                    next_rollout = loop.time() + self.rollout_period_s
+                    try:
+                        # analysis windows are plan-interval-gated inside;
+                        # this cadence only bounds verdict latency. Weight
+                        # changes surface as store events consumed below.
+                        verdicts = self.rollout.tick_all()
+                        # shadow verdicts change no spec (no store event,
+                        # so no reconcile): rewire mirrors directly, or a
+                        # failed/promoted shadow would keep receiving a
+                        # duplicate of every request forever
+                        for vkey in verdicts:
+                            vdep = next(
+                                (d for d in self.store.list()
+                                 if d.key == vkey), None,
+                            )
+                            if vdep is not None:
+                                self._wire_shadow_mirrors(vdep)
+                    except Exception:  # noqa: BLE001 - one bad rollout
+                        # must not kill the manager loop
+                        logger.exception("rollout pass failed")
                 try:
                     event, dep = await asyncio.wait_for(q.get(), timeout=0.2)
                 except asyncio.TimeoutError:

@@ -46,13 +46,22 @@ class DeploymentStatus:
     state: str = STATE_CREATING
     description: str = ""
     predictor_status: List[PredictorStatus] = field(default_factory=list)
+    # progressive-delivery checkpoint (rollout/controller.py): status
+    # writes skip the generation bump, so the rollout state machine can
+    # durably record its resume point — after a control-plane restart a
+    # mid-ramp rollout keeps its TRUE pre-rollout baseline weights and a
+    # promoted/rolled-back one stays terminal instead of re-ramping
+    rollout: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "state": self.state,
             "description": self.description,
             "predictorStatus": [p.to_dict() for p in self.predictor_status],
         }
+        if self.rollout is not None:
+            out["rollout"] = self.rollout
+        return out
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "DeploymentStatus":
@@ -67,6 +76,7 @@ class DeploymentStatus:
                 )
                 for p in d.get("predictorStatus", [])
             ],
+            rollout=d.get("rollout"),
         )
 
 
@@ -140,12 +150,15 @@ class SeldonDeployment:
 
         ``include_replicas=False`` gives the component-naming variant: a
         scale event must not rename (and so recreate) surviving replica
-        components, only add/remove."""
+        components, only add/remove. Traffic weights are excluded there
+        too — a canary ramp step (rollout controller rewriting
+        ``PredictorSpec.traffic``) re-routes the gateway, it must never
+        restart an engine mid-rollout."""
         import hashlib
 
         preds = [p.to_dict() for p in self.predictors]
         if not include_replicas:
-            preds = [{**p, "replicas": None} for p in preds]
+            preds = [{**p, "replicas": None, "traffic": None} for p in preds]
         blob = json.dumps(
             {"protocol": self.protocol, "predictors": preds},
             sort_keys=True,

@@ -246,6 +246,24 @@ class RestClient(UnitClient):
             writer.close()
 
 
+async def engine_predict_url(url: str, message: Dict[str, Any],
+                             timeout: float = DEFAULT_TIMEOUT_S * 2) -> Dict[str, Any]:
+    """One-shot POST to an ENGINE's predictions route by URL.
+
+    The shadow mirror's remote hop (rollout/mirror.py): mirrored traffic
+    is low-rate duplicate dispatch, so a per-call connection keeps the
+    path stateless — no pool to leak when the shadow generation is torn
+    down mid-rollout. ``url`` is ``http://host:port`` (a ComponentHandle's
+    ``.url``)."""
+    rest = url.split("//", 1)[-1]
+    host, _, port = rest.partition(":")
+    client = RestClient(host, int(port or 80), timeout=timeout, retries=1)
+    try:
+        return await client.engine_predict(message)
+    finally:
+        await client.close()
+
+
 class GrpcClient(UnitClient):
     """grpc.aio channel with generic method stubs; dict<->proto at the edge."""
 

@@ -110,6 +110,40 @@ class MetricsRegistry:
                 if slo is not None:
                     self.observe(slo, val / 1000.0, tags)
 
+    # -- label-subset readers (the rollout controller's analysis lens) ------
+    # A series matches when its labels are a SUPERSET of the given ones, so
+    # {"deployment": "canary"} sums over every unit/tag variant of that
+    # predictor's series without the caller enumerating them.
+
+    @staticmethod
+    def _matches(key: LabelKey, want: Dict[str, str]) -> bool:
+        have = dict(key)
+        return all(have.get(k) == v for k, v in want.items())
+
+    def counter_total(self, name: str, labels: Dict[str, str] | None = None) -> float:
+        want = labels or {}
+        with self._lock:
+            series = self._counters.get(name)
+            if not series:
+                return 0.0
+            return float(sum(
+                v for key, v in series.items() if self._matches(key, want)
+            ))
+
+    def histogram_totals(
+        self, name: str, labels: Dict[str, str] | None = None
+    ) -> Tuple[float, float]:
+        """(sum_seconds, count) over every matching histogram series —
+        window-diffing two calls gives a mean over exactly that window."""
+        want = labels or {}
+        total_sum, total_count = 0.0, 0.0
+        with self._lock:
+            for key, h in self._histograms.get(name, {}).items():
+                if self._matches(key, want):
+                    total_sum += h[-2]
+                    total_count += h[-1]
+        return total_sum, total_count
+
     def quantile(self, name: str, q: float, labels: Dict[str, str] | None = None) -> float:
         """Approximate quantile from histogram buckets (for tests/bench)."""
         key = _labels_key(labels or {})

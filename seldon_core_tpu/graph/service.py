@@ -137,6 +137,12 @@ class EngineApp:
             str(self._ann.get("seldon.io/shed-on-deadline", "true")).lower()
             != "false"
         )
+        # progressive delivery: when a rollout wires a ShadowMirror here
+        # (rollout/mirror.py, via the reconciler), every served predict is
+        # duplicated fire-and-forget to the shadow predictors and the
+        # responses diffed. None (the default) is a single attribute check
+        # on the hot path — byte-identical behavior without a rollout.
+        self.shadow_mirror = None
 
     def _inflight_add(self, n: int) -> None:
         with self._inflight_lock:
@@ -231,6 +237,13 @@ class EngineApp:
                 # dashboards undercount the unary hot path
                 self.metrics.counter_inc("seldon_engine_load_shed", labels)
             raise
+        except Exception:
+            # a unit raising outside the UnitCallError contract (bad
+            # payload, over-bucket prompt) is still a failed request: the
+            # errors series must see it or error-rate gates (the rollout
+            # controller's) undercount exactly the requests that broke
+            self.metrics.counter_inc("seldon_api_engine_server_errors", labels)
+            raise
         finally:
             self._inflight_add(-1)
             dur = time.perf_counter() - t0
@@ -263,6 +276,11 @@ class EngineApp:
                     "seldon_engine_prefix_cache_hit_tokens", labels, total
                 )
         self.request_logger.log((out.get("meta") or {}).get("puid", ""), message, out)
+        if self.shadow_mirror is not None:
+            # AFTER the response exists: mirroring duplicates load, never
+            # latency — submit() schedules and returns, all failures are
+            # counted inside the mirror
+            self.shadow_mirror.submit(message, out)
         return out
 
     async def send_feedback(self, feedback: Dict[str, Any]) -> Dict[str, Any]:
@@ -555,8 +573,64 @@ class EngineApp:
             # frees the decode lane and unblocks the generator's queue
             return StreamingResponse(sse(), on_abort=handle.cancel)
 
+        async def weights_swap(req: Request) -> Response:
+            # live weight hot-swap for units exposing hot_swap (the
+            # generate server): POST {"model_uri": "...", "wait_s": 30}
+            # double-buffers the new checkpoint and swaps at a scheduler
+            # poll boundary — in-flight lanes finish on the old version
+            body = req.json() or {}
+            if body.get("cancel"):
+                # {"cancel": true}: abort a staged swap whose drain is
+                # stuck (e.g. a stalled streaming lane) — admissions
+                # resume without restarting the process
+                cancels: Dict[str, Any] = {}
+                for rt in self.executor._walk(self.executor.root):
+                    target = getattr(rt.client, "user_object", None)
+                    fn = getattr(target, "cancel_hot_swap", None)
+                    if fn is not None:
+                        cancels[rt.name] = fn()
+                if not cancels:
+                    return Response(
+                        error_body(501, "no unit supports weight hot-swap"),
+                        501,
+                    )
+                return Response({"units": cancels})
+            uri = body.get("model_uri")
+            if not uri:
+                return Response(error_body(400, "need model_uri"), 400)
+            wait_s = float(body.get("wait_s", 30.0))
+            loop = asyncio.get_running_loop()
+            units: Dict[str, Any] = {}
+            for rt in self.executor._walk(self.executor.root):
+                target = getattr(rt.client, "user_object", None)
+                fn = getattr(target, "hot_swap", None)
+                if fn is None:
+                    continue
+                try:
+                    # checkpoint load + device upload are blocking: off the
+                    # event loop so serving never stalls behind the swap
+                    units[rt.name] = await loop.run_in_executor(
+                        None, lambda f=fn: f(uri, wait_s)
+                    )
+                except Exception as e:  # noqa: BLE001 - bad checkpoint
+                    # units swapped before the failure ARE on the new
+                    # weights — say so, or the caller reads a mixed-
+                    # version graph as a clean no-op
+                    detail = f"{rt.name}: {e}"
+                    if units:
+                        detail += (
+                            f" (units already swapped: {sorted(units)})"
+                        )
+                    return Response(error_body(400, detail), 400)
+            if not units:
+                return Response(
+                    error_body(501, "no unit supports weight hot-swap"), 501
+                )
+            return Response({"units": units})
+
         app.add_route("/pause", pause)
         app.add_route("/unpause", unpause)
+        app.add_route("/weights/swap", weights_swap)
         app.add_route("/inflight", inflight)
         app.add_route("/openapi.json", openapi)
         app.add_route("/api/v0.1/generate", generate_stream)

@@ -302,10 +302,29 @@ def validate_deployment(predictors: List[PredictorSpec]) -> None:
     # the ambassador/istio weight handling (reference: ambassador.go
     # shadow mappings; checkTraffic seldondeployment_webhook.go:372-386)
     live = [p for p in predictors if p.annotations.get("seldon.io/shadow", "false") != "true"]
+    # a shadow carrying a weight is a manifest typo, not a routing choice:
+    # silently excluding it from the 100-sum (the old behavior) hid e.g. a
+    # canary manifest where the shadow flag was left on the 10% predictor
+    for p in predictors:
+        if p.annotations.get("seldon.io/shadow", "false") == "true" and p.traffic:
+            raise GraphSpecError(
+                f"shadow predictor {p.name!r} must not carry a traffic "
+                f"weight (got {p.traffic}); shadows receive mirrored "
+                "traffic only — drop the weight or the seldon.io/shadow "
+                "annotation"
+            )
     total = sum(p.traffic for p in live)
     if len(live) > 1 and total != 100:
         raise GraphSpecError(f"traffic weights must sum to 100, got {total}")
     if len(live) == 1 and total not in (0, 100):
         raise GraphSpecError(f"traffic must be 100 for a single predictor when set, got {total}")
+    # rollout annotations parse strictly at admission, like the traffic
+    # sum: a typo'd gate or step list must fail the apply, not silently
+    # log-and-skip at controller tick time (rollout/plan.py docstring).
+    # Late import: rollout.plan imports this module at load time.
+    if any("seldon.io/rollout" in (p.annotations or {}) for p in predictors):
+        from ..rollout.plan import plan_from_predictors
+
+        plan_from_predictors(predictors)
     for p in predictors:
         validate_predictor(p)

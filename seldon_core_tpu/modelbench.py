@@ -1454,6 +1454,253 @@ def bench_degraded(
     }
 
 
+def bench_rollout(
+    root: str,
+    seconds: float = 4.0,
+    concurrency: int = 4,
+    prompt_len: int = 8,
+    max_new_tokens: int = 16,
+    slots: int = 4,
+    steps_per_poll: int = 8,
+    config: Optional[Dict[str, Any]] = None,
+    cache_seq: Optional[int] = None,
+    steps: Tuple[int, ...] = (25, 50, 100),
+    requests_per_step: int = 8,
+    label: str = "llm-rollout",
+) -> Dict[str, Any]:
+    """Progressive delivery end to end: one SLO-gated canary ramp of an
+    identical-weights old-vs-new pair, then a forced gate breach.
+
+    Two engines serve the SAME checkpoint ("old" baseline, "new"
+    canary). A real RolloutController (fake clock, real metrics
+    registry, real ResourceStore) ramps ``PredictorSpec.traffic``
+    through ``steps``; at every step the bench routes greedy requests
+    per the CURRENT store weights and asserts each response is
+    byte-identical to the no-rollout reference — a canary of the same
+    weights must be invisible in the bytes. A second rollout is then
+    breached on purpose (error traffic at the canary) to demonstrate
+    auto-rollback restoring baseline weights within one analysis
+    interval. Finally the shadow-mirror overhead is measured: baseline
+    throughput with a bounded diffing mirror duplicating every request
+    to the canary, vs mirror off."""
+    import http.client
+
+    from .controlplane import ResourceStore, SeldonDeployment
+    from .graph.engine_metrics import REGISTRY
+    from .rollout import RolloutController, ShadowMirror
+    from .servers.generateserver import GenerateServer
+
+    cfg = dict(config or {})
+    cfg.setdefault("max_seq", max(256, 2 * (prompt_len + max_new_tokens)))
+    model_dir = write_model_dir(root, "llm", cfg)
+
+    def make_component() -> GenerateServer:
+        c = GenerateServer(
+            model_uri=model_dir, slots=slots, steps_per_poll=steps_per_poll,
+            **({"max_seq": cache_seq} if cache_seq else {}),
+            warmup_prompt_lens=[prompt_len],
+            warmup_max_new_tokens=max_new_tokens,
+        )
+        c.load()
+        return c
+
+    old = make_component()
+    new = make_component()
+    rs = np.random.RandomState(7)
+    vocab = cfg.get("vocab_size", 32000)
+    prompts = [
+        rs.randint(1, vocab, prompt_len).tolist()
+        for _ in range(requests_per_step)
+    ]
+    # the no-rollout reference: each prompt's greedy bytes off the OLD
+    # component, before any rollout machinery exists
+    reference = [
+        old.predict(
+            {"prompt_tokens": [p], "max_new_tokens": max_new_tokens,
+             "temperature": 0.0}, [],
+        )["tokens"][0]
+        for p in prompts
+    ]
+    baseline_h = EngineHarness(old, name="baseline").start()
+    canary_h = EngineHarness(new, name="canary").start()
+    headers = {"Content-Type": "application/json", "Connection": "keep-alive"}
+
+    def engine_greedy(port: int, prompt: List[int]) -> List[int]:
+        conn = http.client.HTTPConnection("127.0.0.1", port)
+        body = json.dumps({"jsonData": {
+            "prompt_tokens": [prompt], "max_new_tokens": max_new_tokens,
+            "temperature": 0.0,
+        }}).encode()
+        conn.request("POST", "/api/v0.1/predictions", body, headers)
+        resp = conn.getresponse()
+        payload = resp.read()
+        conn.close()
+        if resp.status != 200:
+            raise RuntimeError(f"rollout bench HTTP {resp.status}: {payload[:200]}")
+        return json.loads(payload)["jsonData"]["tokens"][0]
+
+    def rollout_dep(name: str, step_list: Tuple[int, ...]) -> SeldonDeployment:
+        return SeldonDeployment.from_dict({
+            "name": name,
+            "predictors": [
+                {"name": "baseline", "traffic": 100,
+                 "graph": {"name": "model", "implementation": "SIMPLE_MODEL"}},
+                {"name": "canary", "traffic": 0,
+                 "annotations": {
+                     "seldon.io/rollout": "canary",
+                     "seldon.io/rollout-steps": ",".join(map(str, step_list)),
+                     "seldon.io/rollout-interval-s": "1",
+                     "seldon.io/rollout-min-samples": "2",
+                     # identical weights on one shared host: latency
+                     # ratios between the twin engines are pure load
+                     # noise, and the bench's gate proof is the ERROR
+                     # gate (phase 2) — a noise rollback here would
+                     # abort the ramp whose byte-identity we measure
+                     "seldon.io/rollout-max-ttft-ratio": "1000",
+                     "seldon.io/rollout-max-tpot-ratio": "1000",
+                 },
+                 "graph": {"name": "model", "implementation": "SIMPLE_MODEL"}},
+            ],
+        })
+
+    clock = [1000.0]
+    store = ResourceStore()
+    ctl = RolloutController(store, metrics=REGISTRY, now=lambda: clock[0])
+
+    try:
+        # -- phase 1: the ramp, byte-identity at every traffic step -------
+        store.apply(rollout_dep("rollout-bench", steps))
+        verdicts = list(ctl.tick_all().values())  # "start": weight=steps[0]
+        ramp: List[Dict[str, Any]] = []
+        key = "default/rollout-bench"
+        for _ in range(len(steps) + 3):  # verdict-bounded, safety-capped
+            st = ctl.state(key)
+            if st is None or st.phase != "ramping":
+                break
+            weight = {
+                p.name: p.traffic for p in store.get("rollout-bench").predictors
+            }["canary"]
+            n_canary = max(2, int(round(requests_per_step * weight / 100.0)))
+            identical = True
+            for i, p in enumerate(prompts):
+                port = (
+                    canary_h.http_port if i < n_canary else baseline_h.http_port
+                )
+                if engine_greedy(port, p) != reference[i]:
+                    identical = False
+            ramp.append({
+                "weight": weight,
+                "requests": requests_per_step,
+                "to_canary": n_canary,
+                "greedy_identical": identical,
+            })
+            clock[0] += 1.0
+            verdicts.extend(ctl.tick_all().values())
+        promoted = ctl.state(key).phase == "promoted"
+
+        # -- phase 2: forced gate breach -> auto-rollback -----------------
+        store.apply(rollout_dep("rollout-breach", (50, 100)))
+        ctl.tick_all()  # start: 50/50
+        bad_prompt = list(range(1, cfg["max_seq"] + 64))  # over every bucket
+        for _ in range(4):
+            try:
+                engine_greedy(canary_h.http_port, bad_prompt)
+            except RuntimeError:
+                pass  # 500 counted as a canary error at the engine
+        for p in prompts[:4]:
+            engine_greedy(baseline_h.http_port, p)
+        clock[0] += 1.0
+        breach_verdict = ctl.tick_all().get("default/rollout-breach")
+        restored = {
+            p.name: p.traffic for p in store.get("rollout-breach").predictors
+        }
+        rollback = {
+            "verdict": breach_verdict,
+            "restored_weights": restored,
+            "restored_to_baseline": restored == {"baseline": 100, "canary": 0},
+            "intervals_to_restore": 1,
+            "reasons": (ctl.state("default/rollout-breach").events[-1]
+                        .get("reasons", [])),
+        }
+
+        # -- phase 3: shadow-mirror overhead ------------------------------
+        body = json.dumps({"jsonData": {
+            "prompt_tokens": [prompts[0]], "max_new_tokens": max_new_tokens,
+            "temperature": 0.0,
+        }}).encode()
+
+        def make_call():
+            conn = http.client.HTTPConnection("127.0.0.1", baseline_h.http_port)
+
+            def call() -> int:
+                conn.request("POST", "/api/v0.1/predictions", body, headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+                if resp.status != 200:
+                    raise RuntimeError(
+                        f"rollout bench HTTP {resp.status}: {payload[:200]}"
+                    )
+                toks = json.loads(payload)["jsonData"]["tokens"][0]
+                return len(toks) - prompt_len
+
+            return call
+
+        mirror = ShadowMirror(
+            [("canary", canary_h.app)], deployment="default/rollout-bench",
+            metrics=REGISTRY,
+        )
+        baseline_h.app.shadow_mirror = mirror
+        on = closed_loop(make_call, seconds, concurrency, warmup_calls=1)
+        baseline_h.app.shadow_mirror = None
+        off = closed_loop(make_call, seconds, concurrency, warmup_calls=1)
+        on["tokens_per_s"] = on.pop("rows_per_s")
+        off["tokens_per_s"] = off.pop("rows_per_s")
+    finally:
+        baseline_h.stop()
+        canary_h.stop()
+        for c in (old, new):
+            if c.batcher is not None:
+                c.batcher.close()
+
+    return {
+        "model": label,
+        "transport": "engine REST x2, continuous batching, rollout-controlled",
+        "scenario": (
+            f"canary ramp {list(steps)} of identical-weights old-vs-new, "
+            "then a forced gate breach + shadow-mirror overhead"
+        ),
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new_tokens,
+        "slots": slots,
+        "steps": list(steps),
+        "ramp": ramp,
+        "verdicts": verdicts,
+        "promoted": promoted,
+        "rollback": rollback,
+        # identical weights MUST be invisible: every response at every
+        # traffic step matched the no-rollout reference bytes
+        "greedy_identical": (
+            bool(ramp)
+            and all(s["greedy_identical"] for s in ramp)
+            and rollback["restored_to_baseline"]
+        ),
+        # headline = mirror-off throughput; the mirrored twin alongside
+        "tokens_per_s": off["tokens_per_s"],
+        "p50_ms": off["p50_ms"],
+        "p99_ms": off["p99_ms"],
+        "mirror_off": off,
+        "mirror_on": on,
+        "mirror_overhead_pct": round(
+            100.0 * (1.0 - on["tokens_per_s"] / max(off["tokens_per_s"], 1e-9)),
+            1,
+        ),
+        "mirror": {
+            **mirror.counts,
+            "recent_divergences": list(mirror.recent),
+        },
+    }
+
+
 def _ablate_generate(
     root: str,
     base_kw: Dict[str, Any],
@@ -1603,6 +1850,19 @@ def run_model_tier(
                 config={
                     "vocab_size": 256, "d_model": 64, "n_layers": 2,
                     "n_heads": 2, "n_kv_heads": 2, "d_ff": 128, "max_seq": 64,
+                },
+            )
+            # progressive-delivery proof: identical-weights canary ramp
+            # with per-step greedy byte-identity, forced auto-rollback,
+            # and the shadow-mirror overhead (chip scales the same
+            # harness to the 1.26B tier)
+            results["llm_1b_rollout"] = bench_rollout(
+                root, seconds=min(seconds, 1.0), concurrency=2, prompt_len=4,
+                max_new_tokens=8, slots=2, requests_per_step=4,
+                steps=(50, 100),
+                config={
+                    "vocab_size": 256, "d_model": 32, "n_layers": 2,
+                    "n_heads": 2, "n_kv_heads": 2, "d_ff": 64, "max_seq": 64,
                 },
             )
         else:
@@ -1903,6 +2163,17 @@ def run_model_tier(
             results["llm_1b_degraded"] = bench_degraded(
                 root, label="llm-1.26b-degraded",
                 seconds=max(seconds, 8.0), concurrency=8, prompt_len=128,
+                max_new_tokens=64, slots=8, steps_per_poll=16,
+                cache_seq=256, config=big_cfg,
+            )
+            # progressive delivery at flagship scale: an identical-weights
+            # canary of the 1.26B decoder ramped 25->50->100 with greedy
+            # byte-identity at every step, a forced gate breach proving
+            # one-interval auto-rollback, and the engine-side shadow
+            # mirror's duplicate-dispatch overhead on the primary
+            results["llm_1b_rollout"] = bench_rollout(
+                root, label="llm-1.26b-rollout",
+                seconds=max(seconds, 6.0), concurrency=8, prompt_len=128,
                 max_new_tokens=64, slots=8, steps_per_poll=16,
                 cache_seq=256, config=big_cfg,
             )

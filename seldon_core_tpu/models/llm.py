@@ -830,6 +830,40 @@ class DecoderLM(ServedModel):
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, tokens[:, 1:])
         return ce.mean() + cfg.aux_loss_weight * aux
 
+    @staticmethod
+    def params_swappable(old, new) -> "Tuple[bool, str]":
+        """Whether ``new`` can replace ``old`` under live serving without
+        recompiling a single executable: the jitted prefill/decode/burst
+        functions are specialized on the param pytree's STRUCTURE and
+        every leaf's shape+dtype, so a hot-swap (continuous batching's
+        ``request_weight_swap``) is only sound when both match leaf for
+        leaf. Returns ``(ok, reason)`` — reason names the first offender
+        so a wrong-checkpoint swap fails with an actionable message
+        instead of an XLA retrace mid-traffic."""
+        import jax
+
+        old_leaves, old_def = jax.tree_util.tree_flatten(old)
+        new_leaves, new_def = jax.tree_util.tree_flatten(new)
+        if old_def != new_def:
+            return False, (
+                "param tree structure differs (different architecture or "
+                "checkpoint family)"
+            )
+        paths = [
+            jax.tree_util.keystr(p)
+            for p, _ in jax.tree_util.tree_flatten_with_path(old)[0]
+        ]
+        for path, a, b in zip(paths, old_leaves, new_leaves):
+            sa = getattr(a, "shape", None)
+            sb = getattr(b, "shape", None)
+            if sa != sb:
+                return False, f"{path}: shape {sb} != served {sa}"
+            da = getattr(a, "dtype", None)
+            db = getattr(b, "dtype", None)
+            if da != db:
+                return False, f"{path}: dtype {db} != served {da}"
+        return True, ""
+
     def input_sharding(self, mesh):
         from jax.sharding import NamedSharding, PartitionSpec as P
 

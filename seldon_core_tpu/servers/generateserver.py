@@ -157,6 +157,7 @@ class GenerateServer(SeldonComponent):
         self._extra = kwargs
         self.batcher = None
         self._model = None
+        self._swap_count = 0
 
     @staticmethod
     def _cast_params_freeing_impl(tree, dt):
@@ -418,6 +419,84 @@ class GenerateServer(SeldonComponent):
 
         return StreamHandle(chunks=chunks(), cancel=fut.cancel)
 
+    def hot_swap(self, model_uri: str, wait_s: float = 30.0) -> Dict[str, Any]:
+        """Live weight hot-swap: load a new checkpoint and replace the
+        served weights WITHOUT restarting the process or dropping a
+        request (the progressive-delivery path — the engine's
+        ``/weights/swap`` route lands here).
+
+        The new checkpoint must be the SAME architecture (the decode
+        executables are shape-specialized); its params are cast to the
+        serving dtype and handed to the batcher's double-buffered
+        ``request_weight_swap`` — new-weight upload overlaps old-weight
+        serving, in-flight lanes finish on the old version, the flip
+        happens at a scheduler poll boundary, and the prefix cache is
+        re-keyed so old-weights K/V can never serve a new-weights
+        prefill. Waits up to ``wait_s`` for the flip; a swap still
+        draining after that returns ``swapped: false`` and lands on its
+        own."""
+        if self.batcher is None:
+            self.load()
+        if self.batcher.swap_pending():
+            # fail BEFORE the checkpoint load: the conflict is knowable
+            # now, and a large model's read+cast+upload takes minutes
+            raise RuntimeError("a weight swap is already pending")
+        server = JAXServer(model_uri)
+        _apply, params = server.build()
+        new_model = server._model
+        if new_model is None or not hasattr(new_model, "cfg"):
+            raise ValueError(
+                f"hot-swap checkpoint at {model_uri!r} is not an llm-family "
+                "model dir"
+            )
+        old_cfg = dataclasses.asdict(self._model.cfg)
+        new_cfg = dataclasses.asdict(new_model.cfg)
+        # residual_scale only shapes synthetic INIT draws, not the forward
+        for skip in ("residual_scale",):
+            old_cfg.pop(skip, None)
+            new_cfg.pop(skip, None)
+        if old_cfg != new_cfg:
+            changed = sorted(
+                k for k in set(old_cfg) | set(new_cfg)
+                if old_cfg.get(k) != new_cfg.get(k)
+            )
+            raise ValueError(
+                f"hot-swap checkpoint architecture differs from the served "
+                f"model ({', '.join(changed)}); same-shape checkpoints only"
+            )
+        import jax.numpy as jnp
+
+        dt = jnp.dtype(getattr(self._model, "compute_dtype", "bfloat16"))
+        if dt != jnp.float32 and isinstance(params, dict):
+            params = self._cast_params_freeing_impl(params, dt)
+        self._swap_count += 1
+        version = f"v{self._swap_count}"
+        fut = self.batcher.request_weight_swap(params, version=version)
+        swapped = True
+        try:
+            fut.result(timeout=max(0.001, float(wait_s)))
+        except FuturesTimeout:
+            swapped = False  # still draining; the flip lands on its own
+        return {
+            "version": version,
+            "swapped": swapped,
+            "model_uri": model_uri,
+            "weight_version": self.batcher.weight_version,
+        }
+
+    def cancel_hot_swap(self) -> Dict[str, Any]:
+        """Abort a staged swap whose drain isn't converging (admissions
+        resume on the next poll); see ContinuousBatcher.cancel_weight_swap."""
+        cancelled = (
+            self.batcher.cancel_weight_swap()
+            if self.batcher is not None else False
+        )
+        return {
+            "cancelled": cancelled,
+            "weight_version":
+                self.batcher.weight_version if self.batcher else None,
+        }
+
     def tags(self) -> Dict:
         return {"server": "generateserver"}
 
@@ -431,6 +510,7 @@ class GenerateServer(SeldonComponent):
         out = self.batcher.flight.dump(limit)
         out["slo"] = self.batcher.slo_summary()
         out["stats"] = {k: v for k, v in self.batcher.stats.items()}
+        out["weight_version"] = self.batcher.weight_version
         return out
 
     def metrics(self) -> List[Dict]:
@@ -479,6 +559,8 @@ class GenerateServer(SeldonComponent):
             ])
         if s.get("shed"):
             out.append(delta("gen_shed_total", s["shed"]))
+        if s.get("weight_swaps"):
+            out.append(delta("gen_weight_swaps", s["weight_swaps"]))
         if self.batcher._prefix_index is not None:
             out.extend([
                 delta("prefix_cache_hits", s["prefix_hits"]),

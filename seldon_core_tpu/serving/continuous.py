@@ -135,6 +135,24 @@ class _ChunkJob:
 
 
 @dataclasses.dataclass
+class _SwapJob:
+    """A requested live weight swap: the new params are already cast,
+    device-resident and (when meshed) sharded — double-buffered next to
+    the serving set. The scheduler flips the pointer at a poll boundary
+    once every in-flight lane (decode, chunked prefill, pipelined burst)
+    has finished on the OLD version; until then admissions hold so the
+    drain converges."""
+
+    params: Any
+    version: Any
+    future: Future = dataclasses.field(default_factory=Future)
+    # lanes in flight when the scheduler first observed the request
+    # (flight-recorder attribution), and polls spent draining them
+    drain_lanes: Optional[int] = None
+    waited_polls: int = 0
+
+
+@dataclasses.dataclass
 class _Slot:
     request: GenRequest
     emitted: List[int] = dataclasses.field(default_factory=list)
@@ -334,6 +352,17 @@ class ContinuousBatcher:
         self.trace_groups: Optional[List[Dict[str, Any]]] = None
         # chunked-prefill jobs in flight, keyed by reserved slot
         self._chunked: Dict[int, _ChunkJob] = {}
+        # -- live weight hot-swap -----------------------------------------
+        # request_weight_swap stages a double-buffered _SwapJob here; the
+        # scheduler loop executes it at a poll boundary once all lanes
+        # drained. weight_version keys the prefix cache (old-weights K/V
+        # can never splice into a new-weights prefill) and rides flight-
+        # recorder swap events.
+        self.weight_version: Any = 0
+        self.stats["weight_swaps"] = 0
+        self._swap_lock = threading.Lock()
+        self._pending_swap: Optional[_SwapJob] = None
+        self._swap_seq = 0
 
         # -- device state ----------------------------------------------------
         # The persistent KV cache lives UNSTACKED: per-layer [S, KV, T, Dh]
@@ -405,6 +434,9 @@ class ContinuousBatcher:
         if mesh is not None:
             params = jax.device_put(params, model.param_sharding(mesh, params))
         self.params = params
+        # the cast memo pins the boot params' cast leaves; a weight swap
+        # clears it so the OLD buffer actually frees once the flip lands
+        self._cast_memo = cast_memo
         cache_sharding = cache_sharding_for(model.cfg.n_kv_heads)
         self._cache = unstack_cache(model, cache_sharding)
         self._draft_params = None
@@ -1050,6 +1082,145 @@ class ContinuousBatcher:
         """Blocking convenience: submit and wait for the generated ids."""
         return self.submit(tokens, **kw).result()
 
+    def request_weight_swap(self, params, version=None) -> Future:
+        """Stage a live weight hot-swap; returns a Future resolving to
+        the new weight version once the scheduler flips.
+
+        Thread-safe, callable under traffic. The new params are cast to
+        the serving compute dtype, validated leaf-for-leaf against the
+        served set (same tree / shapes / dtypes — the jitted executables
+        are specialized on them, so an incompatible checkpoint is
+        REJECTED here instead of retracing mid-traffic), device-put
+        (sharded when meshed) — i.e. double-buffered next to the live
+        weights, the upload overlapping serving. The scheduler then:
+
+        * stops admitting new requests (queued submits wait),
+        * lets every in-flight lane — decode, chunked prefill, pipelined
+          burst — finish on the OLD version,
+        * flips the param pointer at the next poll boundary, bumps
+          ``weight_version``, purges the prefix cache (its slabs are
+          keyed by weight version — stale K/V can never splice into a
+          new-weights prefill), records a flight-recorder
+          ``weight_swap`` event, and resumes admissions on the new
+          weights.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        if self._stop.is_set():
+            raise RuntimeError("batcher is closed")
+        if self.speculate_tokens > 0:
+            raise RuntimeError(
+                "weight hot-swap is not supported with speculative decoding "
+                "(the draft shares or derives from the served params)"
+            )
+        dt = jnp.dtype(getattr(self.model, "compute_dtype", "bfloat16"))
+        if dt != jnp.float32:
+            params = jax.tree_util.tree_map(
+                lambda a: a.astype(dt)
+                if hasattr(a, "dtype") and a.dtype == jnp.float32
+                else a,
+                params,
+            )
+        from ..models.llm import DecoderLM
+
+        check = getattr(self.model, "params_swappable", None)
+        if check is None:
+            check = DecoderLM.params_swappable
+        ok, why = check(self.params, params)
+        if not ok:
+            raise ValueError(f"weight hot-swap rejected: {why}")
+        if self.mesh is not None:
+            params = jax.device_put(
+                params, self.model.param_sharding(self.mesh, params)
+            )
+        with self._swap_lock:
+            if self._pending_swap is not None:
+                raise RuntimeError("a weight swap is already pending")
+            if version is None:
+                self._swap_seq += 1
+                if self._swap_seq == self.weight_version:
+                    self._swap_seq += 1
+                version = self._swap_seq
+            elif version == self.weight_version:
+                # a flip that keeps the version number would leave the
+                # version-keyed prefix cache holding OLD-weights K/V that
+                # still matches — the exact splice the keying exists to
+                # prevent
+                raise ValueError(
+                    f"weight swap version {version!r} is already the "
+                    "served version; pick a new version id"
+                )
+            job = _SwapJob(params=params, version=version)
+            self._pending_swap = job
+        # the loop must be alive to execute the swap, traffic or not
+        self.start()
+        return job.future
+
+    def swap_pending(self) -> bool:
+        """Whether a staged weight swap is awaiting its drain — callers
+        about to pay a full checkpoint load (GenerateServer.hot_swap) can
+        fail fast instead of discovering the conflict afterwards. The
+        authoritative check stays inside request_weight_swap."""
+        return self._pending_swap is not None
+
+    def cancel_weight_swap(self) -> bool:
+        """Abort a staged-but-not-yet-executed weight swap, resuming
+        admissions on the next poll. The escape hatch for a drain that
+        cannot converge (e.g. a stalled streaming consumer holding a
+        lane open with no deadline): without it the staged job would
+        hold every admission until close(). Returns True when a pending
+        swap was cancelled; False when none was pending (including a
+        swap that already flipped)."""
+        with self._swap_lock:
+            swap, self._pending_swap = self._pending_swap, None
+        if swap is None:
+            return False
+        if not swap.future.done():
+            swap.future.set_exception(
+                RuntimeError("weight swap cancelled before the flip")
+            )
+        return True
+
+    def _do_swap(self, swap: _SwapJob) -> None:
+        """Execute a drained swap (scheduler thread, poll boundary).
+
+        The whole flip runs under ``_swap_lock`` so ``cancel_weight_swap``
+        either lands BEFORE (pops the job — we see the mismatch and skip)
+        or AFTER (pending is already None — cancel returns False); it can
+        never fail the future of a swap that actually flipped. The flip
+        is host-side pointer work, so the hold is short.
+        """
+        with self._swap_lock:
+            if self._pending_swap is not swap:
+                return  # cancelled between the drain check and here
+            old_v = self.weight_version
+            self.params = swap.params
+            self.weight_version = swap.version
+            # drop the boot-cast memo so the old buffer's last pin dies
+            # with the pointer flip (double-buffering ends here)
+            self._cast_memo.clear()
+            if self._prefix_index is not None:
+                purged = self._prefix_index.set_version(swap.version)
+                self.stats["prefix_evicted"] += purged
+                self.stats["prefix_cache_bytes"] = self._prefix_index.total_bytes
+            self.stats["weight_swaps"] += 1
+            if self.flight is not None and self.flight.enabled:
+                self.flight.record({
+                    "type": "weight_swap",
+                    "old_version": old_v,
+                    "new_version": swap.version,
+                    "drained_lanes": swap.drain_lanes or 0,
+                    "waited_polls": swap.waited_polls,
+                })
+            self._pending_swap = None
+        logger.info(
+            "weight swap %r -> %r (drained %d lanes over %d polls)",
+            old_v, swap.version, swap.drain_lanes or 0, swap.waited_polls,
+        )
+        if not swap.future.done():
+            swap.future.set_result(swap.version)
+
     def start(self) -> None:
         if self._stop.is_set():
             raise RuntimeError("batcher is closed")
@@ -1282,6 +1453,13 @@ class ContinuousBatcher:
         if self._thread is not None:
             self._thread.join(timeout=10.0)
         self._drain_queue(RuntimeError("batcher is closed"))
+        self._fail_pending_swap(RuntimeError("batcher is closed"))
+
+    def _fail_pending_swap(self, err: Exception) -> None:
+        with self._swap_lock:
+            swap, self._pending_swap = self._pending_swap, None
+        if swap is not None and not swap.future.done():
+            swap.future.set_exception(err)
 
     def _drain_queue(self, err: Exception) -> None:
         while True:
@@ -1916,12 +2094,34 @@ class ContinuousBatcher:
                         self.stats["prefix_hits"], self.stats["prefix_evicted"],
                     )
                 poll_plan: Optional[Dict[str, Any]] = None
+                # -- live weight swap: drain, then flip at a poll boundary.
+                # While a swap is staged, admissions HOLD (queued submits
+                # wait) so in-flight lanes — decode, chunked prefill, and
+                # every pipelined burst — finish on the OLD version; the
+                # flip happens only when all three are empty, so no burst
+                # ever mixes weight versions.
+                # unlocked read: GIL-atomic, and a one-poll-late sighting
+                # of a freshly staged swap is harmless — _do_swap
+                # re-validates `self._pending_swap is not swap` under the
+                # lock before flipping. Keeps the no-rollout hot loop free
+                # of a per-poll mutex.
+                swap = self._pending_swap
+                if swap is not None:
+                    if swap.drain_lanes is None:
+                        swap.drain_lanes = (
+                            len(self._active) + len(self._chunked)
+                        )
+                    if not self._active and not self._chunked and not pending:
+                        self._do_swap(swap)
+                        swap = None
+                    else:
+                        swap.waited_polls += 1
                 # admit as many queued requests as there are free slots —
                 # same-bucket admissions are grouped so m lanes share one
                 # batched prefill forward (pow2 chunks bound executables)
                 wave: List[GenRequest] = []
                 busy = len(self._active) + len(self._chunked)
-                while busy + len(wave) < self.slots:
+                while swap is None and busy + len(wave) < self.slots:
                     try:
                         req = self._queue.get_nowait()
                     except queue.Empty:
@@ -2283,5 +2483,6 @@ class ContinuousBatcher:
                 job = self._chunked.pop(slot)
                 if not job.request.future.done():
                     job.request.future.set_exception(err)
+            self._fail_pending_swap(err)
             self._drain_queue(err)
             raise

@@ -45,6 +45,7 @@ class _Node:
     slab_bytes: int = 0
     slab_tokens: int = 0  # real prompt length the slab covers
     last_used: int = 0
+    version: Any = 0  # weight version the slab's K/V was computed under
 
 
 class RadixPrefixIndex:
@@ -60,6 +61,13 @@ class RadixPrefixIndex:
         self.root = _Node(edge=())
         self.total_bytes = 0
         self._clock = 0
+        # weight-version key: slabs are K/V computed under ONE set of
+        # model weights. A live hot-swap (continuous.request_weight_swap)
+        # bumps this via set_version, purging every stored slab — stale
+        # K/V from the old weights can then never splice into a
+        # new-weights prefill. match() double-checks per node (belt and
+        # braces against any future partial-purge path).
+        self.version: Any = 0
 
     # -- internals ---------------------------------------------------------
 
@@ -85,8 +93,10 @@ class RadixPrefixIndex:
         stack = [node]
         while stack:
             n = stack.pop()
-            if n.slab is not None and (
-                best is None or n.slab_tokens < best.slab_tokens
+            if (
+                n.slab is not None
+                and n.version == self.version
+                and (best is None or n.slab_tokens < best.slab_tokens)
             ):
                 best = n
             stack.extend(n.children.values())
@@ -199,12 +209,17 @@ class RadixPrefixIndex:
                 continue
             node = child
             depth += k
-        if node.slab is not None:
+        if node.slab is not None and node.version == self.version:
             node.last_used = self._tick()
             return 0
+        if node.slab is not None:
+            # stale-version slab at this exact path (defensive; set_version
+            # purges these): replace rather than serve old-weights K/V
+            self.total_bytes -= node.slab_bytes
         node.slab = slab
         node.slab_bytes = int(nbytes)
         node.slab_tokens = len(tokens)
+        node.version = self.version
         node.last_used = self._tick()
         self.total_bytes += node.slab_bytes
         return self._evict_to_budget()
@@ -223,6 +238,25 @@ class RadixPrefixIndex:
             evicted += 1
             self._prune(victim)
         return evicted
+
+    def set_version(self, version) -> int:
+        """Key the pool to a new weight version, purging every stored
+        slab (their K/V was computed under the OLD weights — serving one
+        into a new-weights prefill would splice numerically wrong cache).
+        Returns the number of slabs purged. No-op when the version is
+        unchanged."""
+        if version == self.version:
+            return 0
+        self.version = version
+        purged = 0
+        for node in self._slab_nodes():
+            self.total_bytes -= node.slab_bytes
+            node.slab = None
+            node.slab_bytes = 0
+            node.slab_tokens = 0
+            purged += 1
+            self._prune(node)
+        return purged
 
     # -- introspection -----------------------------------------------------
 

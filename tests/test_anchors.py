@@ -140,3 +140,19 @@ def test_bench_shared_prefix_scenario_anchor():
     # summary (the harness parses the tail's last line)
     bench_src = open(os.path.join(root, "bench.py")).read()
     assert "compact_summary" in bench_src
+
+
+def test_bench_rollout_scenario_anchor():
+    """The ``llm_1b_rollout`` bench scenario is an acceptance artifact
+    (per-step greedy byte-identity of an identical-weights canary, the
+    one-interval auto-rollback proof, and the shadow-mirror overhead are
+    read from its entry): it must stay wired through BOTH model tiers,
+    and the numbers-table generator must know its key."""
+    import seldon_core_tpu.modelbench as modelbench
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    mb_src = open(modelbench.__file__).read()
+    assert mb_src.count('results["llm_1b_rollout"]') >= 2  # tiny + chip
+    assert hasattr(modelbench, "bench_rollout")
+    gen_src = open(os.path.join(root, "tools", "gen_arch_numbers.py")).read()
+    assert "llm_1b_rollout" in gen_src

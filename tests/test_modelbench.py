@@ -58,6 +58,19 @@ def test_model_tier_tiny_end_to_end():
     assert results["llm_generate"]["dispatch_bound_tokens_per_s"] > 0
     assert results["resnet50_device"]["rows_per_s"] > 0
     assert "none" in results["resnet50_device"]["transport"]
+    # progressive delivery: the identical-weights canary ramp must be
+    # byte-invisible at every traffic step, the forced breach must
+    # restore baseline weights within one analysis interval, and the
+    # shadow-mirror phase must actually mirror
+    ro = results["llm_1b_rollout"]
+    assert ro["greedy_identical"] is True
+    assert ro["promoted"] is True
+    assert all(s["greedy_identical"] for s in ro["ramp"])
+    assert ro["rollback"]["verdict"] == "rollback"
+    assert ro["rollback"]["restored_to_baseline"] is True
+    assert ro["rollback"]["intervals_to_restore"] == 1
+    assert ro["tokens_per_s"] > 0
+    assert ro["mirror"]["mirrored"] > 0
     # CPU has no published peak -> MFU is None there; on TPU it's a number
     mfu = results["resnet50_rest"]["mfu_pct"]
     assert mfu is None or 0 < mfu < 100

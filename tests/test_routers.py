@@ -81,6 +81,52 @@ def test_state_dict_roundtrip():
     assert r2.state.best_branch == r.state.best_branch
 
 
+def test_state_dict_roundtrip_thompson():
+    """ThompsonSampling shares BanditState: success/tries arrays and the
+    elected best arm must survive a to/from_state_dict round-trip."""
+    r = ThompsonSampling(n_branches=4, seed=3)
+    r.send_feedback(X4, [], reward=0.75, truth=None, routing=1)
+    r.send_feedback(X4, [], reward=0.25, truth=None, routing=3)
+    d = r.to_state_dict()
+    r2 = ThompsonSampling(n_branches=4, seed=3)
+    r2.from_state_dict(d)
+    assert r2.state.success.tolist() == r.state.success.tolist()
+    assert r2.state.tries.tolist() == r.state.tries.tolist()
+    assert r2.state.best_branch == r.state.best_branch
+    # posterior restored: two same-seed routers route identically
+    r3 = ThompsonSampling(n_branches=4, seed=11)
+    r4 = ThompsonSampling(n_branches=4, seed=11)
+    r3.from_state_dict(d)
+    r4.from_state_dict(d)
+    assert [r3.route(X4, []) for _ in range(20)] == [
+        r4.route(X4, []) for _ in range(20)
+    ]
+
+
+def test_bandit_state_roundtrip_arrays():
+    """BanditState itself round-trips, dtypes and all — the pytree the
+    persistence layer checkpoints must restore from plain array dicts
+    (e.g. float32 leaves coming back from an orbax restore)."""
+    s = BanditState(3, best_branch=2)
+    rng = np.random.default_rng(0)
+    s.update(0, 3, 1, rng)
+    s.update(2, 1, 3, rng)
+    d = s.to_state_dict()
+    assert set(d) == {"success", "tries", "best_branch"}
+    assert all(isinstance(v, np.ndarray) for v in d.values())
+    restored = BanditState(3)
+    # restore must coerce back to float64 whatever dtype the store used
+    restored.from_state_dict(
+        {k: v.astype(np.float32) for k, v in d.items()}
+    )
+    assert restored.success.tolist() == s.success.tolist()
+    assert restored.tries.tolist() == s.tries.tolist()
+    assert restored.success.dtype == np.float64
+    assert restored.best_branch == s.best_branch
+    assert isinstance(restored.best_branch, int)
+    assert restored.values.tolist() == s.values.tolist()
+
+
 def test_branch_names_in_tags():
     r = EpsilonGreedy(n_branches=2, best_branch=1, branch_names="a:b", seed=0)
     assert r.tags() == {"best_branch": "b"}

@@ -26,12 +26,34 @@ def _pct(n: float, d: float) -> float:
     return 100.0 * n / d if d else 0.0
 
 
+def _swap_lines(swaps: List[Dict[str, Any]]) -> List[str]:
+    """Weight hot-swap records, shown inline with the scheduling story:
+    each flip names the version pair and how long the drain held the
+    poll loop (lanes in flight when staged, polls spent waiting)."""
+    lines: List[str] = []
+    for s in swaps:
+        lines.append(
+            f"weight swap: {s.get('old_version')!r} -> "
+            f"{s.get('new_version')!r} after draining "
+            f"{s.get('drained_lanes', 0)} in-flight lanes over "
+            f"{s.get('waited_polls', 0)} polls (prefix cache re-keyed)"
+        )
+    if len(swaps) > 1:
+        lines.append(
+            f"DIAGNOSIS: {len(swaps)} weight swaps inside one ring window — "
+            "each flip purges the prefix cache and pauses admissions for "
+            "the drain; batch rollouts should space swaps out"
+        )
+    return lines
+
+
 def diagnose(dump: Dict[str, Any]) -> List[str]:
     """Report lines for one unit's flight-recorder dump."""
     lines: List[str] = []
     entries = dump.get("entries") or []
     polls = [e for e in entries if e.get("type") == "poll"]
     sheds = [e for e in entries if e.get("type") == "shed"]
+    swaps = [e for e in entries if e.get("type") == "weight_swap"]
     lines.append(
         f"recorded {dump.get('recorded_total', len(entries))} records "
         f"(ring holds {len(entries)}, dropped "
@@ -77,6 +99,7 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
         lines.append("no poll records (no traffic since the ring opened)")
         if sheds:
             lines.append(f"{len(sheds)} shed events recorded")
+        lines.extend(_swap_lines(swaps))
         return lines
 
     # -- batch composition --------------------------------------------------
@@ -121,6 +144,9 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
             f"({_pct(len(chunk_polls), len(polls)):.0f}% of polls carried a "
             "chunk between decode bursts)"
         )
+
+    # -- live weight swaps ----------------------------------------------------
+    lines.extend(_swap_lines(swaps))
 
     # -- prefix cache ---------------------------------------------------------
     hits = sum(p.get("prefix_hits", 0) for p in polls)

@@ -188,6 +188,19 @@ def rows_from(mt, fronts):
             "radix prefix KV cache, 32 prompts over 4 system prompts"
             + ("; greedy outputs identical" if ident else ""),
         ))
+    gr = mt.get("llm_1b_rollout") or {}
+    if gr:
+        rb = gr.get("rollback") or {}
+        rolled = rb.get("restored_to_baseline")
+        rows.append((
+            "generate(), canary rollout",
+            f"{fmt(gr.get('tokens_per_s'))} tok/s, "
+            f"{gr.get('mirror_overhead_pct', '—')}% mirror overhead",
+            f"SLO-gated ramp {gr.get('steps', '—')}"
+            + ("; greedy identical every step"
+               if gr.get("greedy_identical") else "")
+            + ("; auto-rollback in 1 interval" if rolled else ""),
+        ))
     g1l = mt.get("llm_1b_long") or {}
     if g1l:
         mbu = f", MBU {g1l['mbu_pct']}%" if g1l.get("mbu_pct") is not None else ""
