@@ -24,6 +24,7 @@ from ..graph.spec import (
     PREPACKAGED_SERVERS,
     PredictorSpec,
     default_predictor,
+    parse_disagg_annotations,
     validate_deployment,
 )
 from ..storage import Storage
@@ -78,6 +79,11 @@ class DeploymentController:
 
         self.rollout = RolloutController(store)
         self.rollout_period_s = 1.0
+        # disaggregated serving: (dep.key, predictor, prefill index) ->
+        # KV transport port. Allocated once and reused across reconciles
+        # so a decode-pool scale event keeps pointing at live prefill
+        # listeners instead of re-rolling every peer address.
+        self._kv_ports: Dict[Tuple[str, str, int], int] = {}
 
     # -- desired state ------------------------------------------------------
 
@@ -179,6 +185,12 @@ class DeploymentController:
                 # orchestrator hop (reference: seldon.io/no-engine annotation,
                 # seldondeployment_types.go:43-45). Only single-node graphs
                 # qualify — deeper graphs need the engine walk.
+                if parse_disagg_annotations(pspec) is not None:
+                    raise GraphSpecError(
+                        f"{pspec.name}: seldon.io/disagg needs the engine "
+                        f"(pool roles are engine parameters); drop "
+                        f"{ANNOTATION_NO_ENGINE}"
+                    )
                 root = pspec.graph
                 if root.children:
                     raise GraphSpecError(
@@ -209,6 +221,13 @@ class DeploymentController:
                 if espec is not None:
                     specs.append(espec)
                 continue
+            disagg = parse_disagg_annotations(pspec)
+            if disagg is not None:
+                specs.extend(self._disagg_components(dep, pspec, h, disagg))
+                espec = explainer_spec()
+                if espec is not None:
+                    specs.append(espec)
+                continue
             for replica in range(max(1, pspec.replicas)):
                 name = f"{dep.key}/{pspec.name}/{replica}/engine-{h[:8]}"
                 specs.append(
@@ -226,6 +245,114 @@ class DeploymentController:
             if espec is not None:
                 specs.append(espec)
         return specs
+
+    def _disagg_components(
+        self, dep: SeldonDeployment, pspec: PredictorSpec, h: str, disagg
+    ) -> List[ComponentSpec]:
+        """Split a ``seldon.io/disagg`` GENERATE_SERVER predictor into
+        two independently scaled pools: ``prefill`` engines (role=prefill,
+        each listening on a stable KV port, NOT routable — they serve the
+        slab transport only) and ``decode`` engines (role=decode, peer
+        pointed round-robin at the prefill listeners, routable — the
+        gateway sends generate traffic here). Scaling either pool only
+        adds/removes members of that pool: the per-pool replica
+        annotations are excluded from the component-naming hash exactly
+        like ``replicas`` is."""
+        n_prefill, n_decode = disagg
+
+        def pool_spec(role: str, extra) -> Dict:
+            d = pspec.to_dict()
+            # the pool member is already specialized: strip the disagg
+            # annotations so the runtime's re-validation doesn't see a
+            # role parameter on a spec that still asks to be split
+            d["annotations"] = {
+                k: v
+                for k, v in (d.get("annotations") or {}).items()
+                if not k.startswith("seldon.io/disagg")
+            }
+            graph = d["graph"]
+            params = list(graph.get("parameters") or [])
+            params.append({"name": "role", "value": role, "type": "STRING"})
+            for k, v in extra:
+                params.append({"name": k, "value": str(v), "type": "STRING"})
+            graph["parameters"] = params
+            return d
+
+        from .runtime import free_port
+
+        def port_bindable(port: int) -> bool:
+            import socket as _socket
+
+            s = _socket.socket()
+            try:
+                s.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+                s.bind(("0.0.0.0", port))
+                return True
+            except OSError:
+                return False
+            finally:
+                s.close()
+
+        ports = []
+        for i in range(n_prefill):
+            key = (dep.key, pspec.name, i)
+            comp_name = f"{dep.key}/{pspec.name}/pf{i}/engine-{h[:8]}"
+            if (
+                key in self._kv_ports
+                and comp_name not in self.components
+                and not port_bindable(self._kv_ports[key])
+            ):
+                # the listener is NOT ours right now (component down) and
+                # a foreign process holds the cached port: retrying the
+                # same dead port every reconcile would wedge the
+                # deployment in CREATING forever — allocate fresh (the
+                # dependent decode members re-point via their peer-port
+                # names)
+                del self._kv_ports[key]
+            if key not in self._kv_ports:
+                self._kv_ports[key] = free_port()
+            ports.append(self._kv_ports[key])
+        out: List[ComponentSpec] = []
+        for i in range(n_prefill):
+            out.append(
+                ComponentSpec(
+                    name=f"{dep.key}/{pspec.name}/pf{i}/engine-{h[:8]}",
+                    kind="engine",
+                    deployment=dep.key,
+                    predictor=pspec.name,
+                    replica=i,
+                    routable=False,
+                    engine_spec=pool_spec(
+                        "prefill", [("kv_port", ports[i])]
+                    ),
+                )
+            )
+        for r in range(n_decode):
+            peer_port = ports[r % n_prefill]
+            out.append(
+                ComponentSpec(
+                    # the assigned peer is part of the NAME: a prefill-pool
+                    # resize that re-points this decoder (round-robin over
+                    # a different listener set) renames it, so reconcile
+                    # replaces exactly the re-pointed members — a survivor
+                    # would otherwise keep dialing its creation-time peer
+                    # forever (reconcile only starts absent names)
+                    name=(
+                        f"{dep.key}/{pspec.name}/{r}/"
+                        f"engine-{h[:8]}-kv{peer_port}"
+                    ),
+                    kind="engine",
+                    deployment=dep.key,
+                    predictor=pspec.name,
+                    replica=r,
+                    routable=True,
+                    engine_spec=pool_spec(
+                        "decode",
+                        [("peer", f"127.0.0.1:{peer_port}")],
+                    ),
+                )
+            )
+        return out
 
     # -- reconcile ----------------------------------------------------------
 
@@ -340,6 +467,15 @@ class DeploymentController:
         # status rollup (reference: seldondeployment_controller.go:1111-1119)
         for pspec in dep.predictors:
             replicas = max(1, pspec.replicas)
+            try:
+                disagg = parse_disagg_annotations(pspec)
+            except GraphSpecError:
+                disagg = None
+            if disagg is not None:
+                # routable components are the decode pool; availability
+                # is judged against ITS size (prefill members gate
+                # readiness through _await_ready like any component)
+                replicas = disagg[1]
             avail = 0
             for name, (handle, _) in self.components.items():
                 if (
@@ -548,6 +684,9 @@ class DeploymentController:
         # a re-created deployment must start a FRESH scale-down window
         for key in [k for k in self._scale_down_streak if k[0] == dep.key]:
             del self._scale_down_streak[key]
+        # ... and fresh KV transport ports for its prefill pool
+        for key in [k for k in self._kv_ports if k[0] == dep.key]:
+            del self._kv_ports[key]
 
     # -- watch loop ---------------------------------------------------------
 

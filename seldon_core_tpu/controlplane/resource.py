@@ -159,6 +159,24 @@ class SeldonDeployment:
         preds = [p.to_dict() for p in self.predictors]
         if not include_replicas:
             preds = [{**p, "replicas": None, "traffic": None} for p in preds]
+            # disagg pool sizes are replica counts too: scaling the
+            # prefill or decode pool must add/remove pool members, never
+            # rename (and so restart) the survivors
+            scale_keys = (
+                "seldon.io/disagg-prefill-replicas",
+                "seldon.io/disagg-decode-replicas",
+            )
+            preds = [
+                {
+                    **p,
+                    "annotations": {
+                        k: v
+                        for k, v in (p.get("annotations") or {}).items()
+                        if k not in scale_keys
+                    },
+                }
+                for p in preds
+            ]
         blob = json.dumps(
             {"protocol": self.protocol, "predictors": preds},
             sort_keys=True,

@@ -76,6 +76,20 @@ class MetricsRegistry:
         "gen_prefill_tokens": ("seldon_engine_generate_step_tokens", "prefill"),
     }
 
+    # disaggregated serving: KV-slab handoff counters land in first-class
+    # seldon_engine_kv_transfer_* series with a direction label (export =
+    # prefill pool shipping slabs out, import = decode pool splicing them
+    # in), plus the transfer-dedup savings counter — the measurable claim
+    # behind "the radix prefix cache is the transfer-dedup layer"
+    _KV_TRANSFER = {
+        "gen_kv_export_slabs": ("seldon_engine_kv_transfer_slabs", "export"),
+        "gen_kv_import_slabs": ("seldon_engine_kv_transfer_slabs", "import"),
+        "gen_kv_export_bytes": ("seldon_engine_kv_transfer_bytes", "export"),
+        "gen_kv_import_bytes": ("seldon_engine_kv_transfer_bytes", "import"),
+        "gen_kv_transfer_bytes_saved":
+            ("seldon_engine_kv_transfer_bytes_saved", None),
+    }
+
     # generate SLO TIMERs (per completed request, shipped by the generate
     # server's metrics() hook) additionally land in first-class latency
     # histograms per graph node: TTFT, TPOT/inter-token latency, and
@@ -102,6 +116,14 @@ class MetricsRegistry:
                 if step is not None:
                     name, phase = step
                     self.counter_inc(name, {**tags, "phase": phase}, val)
+                kv = self._KV_TRANSFER.get(key)
+                if kv is not None:
+                    name, direction = kv
+                    kv_tags = (
+                        {**tags, "direction": direction}
+                        if direction else tags
+                    )
+                    self.counter_inc(name, kv_tags, val)
             elif mtype == "GAUGE":
                 self.gauge_set(f"seldon_custom_{key}", val, tags)
             elif mtype == "TIMER":

@@ -271,6 +271,57 @@ def parse_hpa_spec(hpa: Dict[str, Any], who: str = "?") -> "tuple[int, int, floa
     return lo, hi, target
 
 
+# disaggregated generate serving (docs/generate.md "Disaggregated
+# serving"): the annotation splits a GENERATE_SERVER predictor into a
+# prefill pool and a decode pool with a KV-slab handoff between them
+ANNOTATION_DISAGG = "seldon.io/disagg"
+ANNOTATION_DISAGG_PREFILL_REPLICAS = "seldon.io/disagg-prefill-replicas"
+ANNOTATION_DISAGG_DECODE_REPLICAS = "seldon.io/disagg-decode-replicas"
+
+
+def parse_disagg_annotations(spec: PredictorSpec) -> "Optional[tuple]":
+    """``(prefill_replicas, decode_replicas)`` when the predictor opts
+    into disaggregated serving, None otherwise. The ONE parser shared by
+    admission validation and the reconciler's pool splitting, strict at
+    apply time: a disagg predictor must be a single-node
+    GENERATE_SERVER graph and the per-pool replica counts must be
+    positive integers."""
+    ann = spec.annotations or {}
+    if str(ann.get(ANNOTATION_DISAGG, "false")).lower() != "true":
+        return None
+    root = spec.graph
+    if root.children or root.implementation != "GENERATE_SERVER":
+        raise GraphSpecError(
+            f"predictor {spec.name!r}: {ANNOTATION_DISAGG} needs a "
+            "single-node GENERATE_SERVER graph (prefill/decode pools "
+            "split one generate unit)"
+        )
+    for unit in root.walk():
+        for p in unit.parameters:
+            if p.name in ("role", "peer", "kv_port"):
+                raise GraphSpecError(
+                    f"predictor {spec.name!r}: {ANNOTATION_DISAGG} owns "
+                    f"the {p.name!r} parameter — drop it from the graph "
+                    "(the reconciler assigns roles per pool)"
+                )
+    try:
+        prefill = int(ann.get(ANNOTATION_DISAGG_PREFILL_REPLICAS, 1))
+        decode = int(
+            ann.get(ANNOTATION_DISAGG_DECODE_REPLICAS, max(1, spec.replicas))
+        )
+    except (TypeError, ValueError) as e:
+        raise GraphSpecError(
+            f"predictor {spec.name!r}: malformed disagg replica "
+            f"annotation: {e}"
+        ) from e
+    if prefill < 1 or decode < 1:
+        raise GraphSpecError(
+            f"predictor {spec.name!r}: disagg pools need >= 1 replica "
+            f"each, got prefill={prefill} decode={decode}"
+        )
+    return prefill, decode
+
+
 def validate_predictor(spec: PredictorSpec) -> None:
     """Reference checks: seldondeployment_webhook.go:388-411."""
     if spec.replicas < 0:
@@ -291,6 +342,10 @@ def validate_predictor(spec: PredictorSpec) -> None:
             raise GraphSpecError(f"router {unit.name} has no children")
     if spec.hpa_spec is not None:
         parse_hpa_spec(spec.hpa_spec, who=spec.name)
+    # disagg annotations parse strictly at admission (same policy as
+    # rollout annotations): a typo'd pool size or a multi-node disagg
+    # graph fails the apply, not the reconcile
+    parse_disagg_annotations(spec)
 
 
 def validate_deployment(predictors: List[PredictorSpec]) -> None:

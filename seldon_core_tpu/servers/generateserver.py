@@ -58,6 +58,22 @@ Model URI layout: same ``jax_config.json`` as jaxserver with
                      ``/flightrecorder`` route (0 = off; default 512 —
                      cheap enough to leave on, see docs/operate.md
                      "Observability")
+    role             ``unified`` (default; serve prefill+decode locally,
+                     byte-identical to every prior release) |
+                     ``prefill`` (run prompt prefill only and export the
+                     K/V slab over the KV transport — no decode lanes,
+                     no scheduler loop) | ``decode`` (pull prefilled
+                     slabs from ``peer`` and run decode-only lanes).
+                     See docs/generate.md "Disaggregated serving"
+    peer             decode role: the prefill pool's KV endpoint as
+                     ``host:port`` (TCP transport); tests/benches may
+                     instead wire a live prefill GenerateServer object
+                     via ``set_peer()`` (loopback transport — same
+                     codec, in memory)
+    kv_port          prefill role: TCP port the KV export listener
+                     binds (0 = loopback-only, no listener)
+    kv_chunk_bytes   KV transport write granularity — the sender-side
+                     in-flight bound per slab stream (default 1 MiB)
 
 Request (jsonData)::
 
@@ -95,6 +111,14 @@ class StreamHandle:
 
 
 class GenerateServer(SeldonComponent):
+    # class-level defaults so partially constructed instances (tests
+    # build shells via __new__ around a bare batcher) behave as the
+    # unified role with no transport endpoints
+    _role = "unified"
+    _kv_server = None
+    _kv_client = None
+    batcher = None
+
     def __init__(
         self,
         model_uri: str,
@@ -115,11 +139,31 @@ class GenerateServer(SeldonComponent):
         depth_group_split_bytes: Optional[int] = None,
         prefill_chunk: int = 0,
         flight_recorder: int = 512,
+        role: str = "unified",
+        peer: Optional[str] = None,
+        kv_port: int = 0,
+        kv_chunk_bytes: int = 1 << 20,
         warmup_prompt_lens: Optional[Sequence[int]] = None,
         warmup_max_new_tokens: int = 0,
         **kwargs,
     ):
         self.model_uri = model_uri
+        role = str(role or "unified").lower()
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"role must be unified|prefill|decode, got {role!r}"
+            )
+        self._role = role
+        self._peer = peer or None
+        self._kv_port = int(kv_port)
+        self._kv_chunk_bytes = int(kv_chunk_bytes)
+        self._kv_server = None   # PrefillTransportServer (prefill role)
+        self._kv_client = None   # LoopbackTransport | TcpKVClient (decode)
+        if role != "unified" and int(speculate_tokens) > 0:
+            raise ValueError(
+                "disaggregated roles do not support speculative decoding "
+                "(the draft cache cannot cross the KV transport)"
+            )
         self._mesh = mesh
         self._slots = int(slots)
         self._max_seq = int(max_seq) if max_seq else None
@@ -234,7 +278,10 @@ class GenerateServer(SeldonComponent):
         self.batcher = ContinuousBatcher(
             self._model,
             params,
-            slots=self._slots,
+            # a prefill-role server runs NO decode lanes: the slab is
+            # built in staging and shipped, never inserted locally — one
+            # token lane keeps the cache allocation minimal
+            slots=1 if self._role == "prefill" else self._slots,
             max_seq=self._max_seq,
             mesh=self._mesh,
             shard_cache_seq=self._shard_cache_seq,
@@ -260,10 +307,31 @@ class GenerateServer(SeldonComponent):
                 prompt_lens=self._warmup_prompt_lens,
                 max_new_tokens=self._warmup_max_new_tokens,
             )
-        self.batcher.start()
+        if self._role == "prefill":
+            # no scheduler loop: export_prefill runs on the transport's
+            # handler threads, decode lanes never activate
+            if self._kv_port:
+                from ..serving.disagg import PrefillTransportServer
+
+                self._kv_server = PrefillTransportServer(
+                    self, port=self._kv_port,
+                    chunk_bytes=self._kv_chunk_bytes,
+                )
+                logger.info(
+                    "generateserver: prefill role exporting KV on :%d",
+                    self._kv_server.port,
+                )
+        else:
+            self.batcher.start()
+        if self._role == "decode" and self._peer is not None:
+            from ..serving.disagg import make_transport
+
+            self._kv_client = make_transport(
+                self._peer, chunk_bytes=self._kv_chunk_bytes
+            )
         logger.info(
-            "generateserver: %s ready (slots=%d, max_seq=%d)",
-            self.model_uri, self._slots, self.batcher.max_seq,
+            "generateserver: %s ready (role=%s, slots=%d, max_seq=%d)",
+            self.model_uri, self._role, self._slots, self.batcher.max_seq,
         )
 
     # -- byte-level text fallback (no tokenizer shipped in-image) ----------
@@ -298,9 +366,191 @@ class GenerateServer(SeldonComponent):
         )
         return token_lists, text_mode, kw
 
+    # -- disaggregated serving (prefill/decode pools) ----------------------
+
+    def set_peer(self, prefill_server) -> None:
+        """Wire a decode-role server to its prefill peer: a live
+        GenerateServer/handler object (loopback transport — the slab
+        still round-trips the full wire codec in memory) or a
+        ``host:port`` string (TCP)."""
+        from ..serving.disagg import make_transport
+
+        if self._role != "decode":
+            raise RuntimeError(f"set_peer on a {self._role}-role server")
+        self._kv_client = make_transport(
+            prefill_server, chunk_bytes=self._kv_chunk_bytes
+        )
+
+    def prefill_export(self, request: Dict[str, Any]):
+        """PREFILL-side transport handler: run the prompt forward and
+        return ``(meta, slab)`` for the wire codec. Called by the
+        loopback transport directly and by PrefillTransportServer per
+        TCP connection."""
+        if self.batcher is None:
+            self.load()
+        toks = request.get("tokens")
+        if not toks:
+            raise ValueError("prefill request needs tokens")
+        return self.batcher.export_prefill(
+            [int(t) for t in toks],
+            max_new_tokens=int(request.get("max_new_tokens", 32)),
+            temperature=float(request.get("temperature", 0.0)),
+            eos_id=request.get("eos_id"),
+            seed=int(request.get("seed", 0)),
+            covered_len=int(request.get("covered_len", 0)),
+        )
+
+    def _remote_submit(self, toks, kw, deadline_s, covered=None,
+                       on_tokens=None):
+        """Decode-role submit: consult the local radix cache for the
+        transfer-dedup base, pull the (suffix-only when possible) slab
+        from the prefill peer under a ``gen.kv_transfer`` span, and
+        queue it as a remote lane insert."""
+        from ..tracing import get_tracer
+
+        if self._kv_client is None:
+            raise RuntimeError(
+                "decode role has no prefill peer (set `peer` or call "
+                "set_peer())"
+            )
+        # shed BEFORE the handoff costs anything: an overloaded decode
+        # pool must not amplify load onto the prefill pool and the wire
+        # only to reject the slab on arrival (admit_remote re-checks,
+        # but by then the transfer is paid)
+        self.batcher._shed_check(deadline_s)
+        if covered is None:
+            covered = self.batcher.remote_covered_len(toks)
+        request = {
+            "tokens": [int(t) for t in toks],
+            "covered_len": int(covered),
+            **kw,
+        }
+        with get_tracer().span(
+            "gen.kv_transfer",
+            tags={"covered_len": int(covered), "tokens": len(toks),
+                  "transport": self._kv_client.name},
+        ):
+            meta, slab = self._kv_client.prefill(request, deadline_s=deadline_s)
+        return self.batcher.admit_remote(
+            slab, meta, on_tokens=on_tokens, deadline_s=deadline_s
+        )
+
+    def _collect_results(self, futures, token_lists, kw, deadline_s,
+                         expires_at, retry_prefix_gone=False):
+        """Await every request future under the remaining deadline budget
+        — ONE implementation for the unified and decode-role paths so
+        the deadline/cancellation semantics cannot drift apart.
+
+        All-or-nothing: any failure (or budget exhaustion) cancels the
+        sibling futures, reclaiming queued slots and mid-decode lanes,
+        before the error surfaces. Waits never exceed the request's own
+        budget (600s safety fallback without one) — an abandoned wait
+        would pin this worker thread and its decode lane.
+        ``retry_prefix_gone`` adds the decode-role contract: a
+        suffix-only handoff whose radix donor was evicted before the
+        splice re-requests the FULL slab once — correctness never
+        depends on the cache."""
+        import time as _time
+
+        from ..resilience import DeadlineExceeded
+
+        def remaining() -> float:
+            if expires_at is None:
+                return 600.0
+            return max(0.001, expires_at - _time.monotonic())
+
+        try:
+            results = []
+            for i, f in enumerate(futures):
+                try:
+                    results.append(f.result(timeout=remaining()))
+                except Exception as e:
+                    if retry_prefix_gone:
+                        from ..serving.disagg import PrefixGone
+
+                        if isinstance(e, PrefixGone):
+                            f2 = self._remote_submit(
+                                token_lists[i], kw, deadline_s, covered=0
+                            )
+                            futures[i] = f2
+                            results.append(f2.result(timeout=remaining()))
+                            continue
+                    raise
+        except FuturesTimeout:
+            for f in futures:
+                f.cancel()
+            if deadline_s is None:
+                raise  # the 600s safety fallback fired, not a budget
+            raise DeadlineExceeded(
+                f"generate ran past its {deadline_s * 1000:.0f}ms budget"
+            )
+        except Exception:
+            for f in futures:
+                f.cancel()
+            raise
+        return results
+
+    def _predict_disagg(self, token_lists, kw, deadline_s, expires_at):
+        """Decode-role submit loop: prefill at the peer pool, slab over
+        the KV transport, then the shared all-or-nothing collection.
+
+        Multi-prompt requests dispatch their transfers CONCURRENTLY —
+        sequential round trips would make prompt N's TTFT pay N-1 whole
+        prefill+transfer latencies, and the prefill listener's bounded
+        handler pool exists precisely to serve them in parallel."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        if len(token_lists) == 1:
+            futures = [self._remote_submit(token_lists[0], kw, deadline_s)]
+        else:
+            with ThreadPoolExecutor(
+                max_workers=min(8, len(token_lists)),
+                thread_name_prefix="kv-transfer",
+            ) as pool:
+                submits = [
+                    pool.submit(self._remote_submit, toks, kw, deadline_s)
+                    for toks in token_lists
+                ]
+            # the with-block joined the pool: every transfer has finished,
+            # one way or the other. All-or-nothing: any failure cancels
+            # EVERY sibling whose slab landed (sweeping `submits`, not a
+            # partial collection list, so no admitted lane can leak).
+            err = next(
+                (sf.exception() for sf in submits if sf.exception()), None
+            )
+            if err is not None:
+                for sf in submits:
+                    if sf.exception() is None:
+                        sf.result().cancel()
+                raise err
+            # in submission order, so responses stay positional
+            futures = [sf.result() for sf in submits]
+        results = self._collect_results(
+            futures, token_lists, kw, deadline_s, expires_at,
+            retry_prefix_gone=True,
+        )
+        return futures, results
+
+    def close(self) -> None:
+        """Stop the KV transport endpoints and the scheduler."""
+        if self._kv_server is not None:
+            self._kv_server.close()
+            self._kv_server = None
+        if self._kv_client is not None:
+            self._kv_client.close()
+            self._kv_client = None
+        if self.batcher is not None:
+            self.batcher.close()
+
     def predict(self, X, names, meta=None):
         if self.batcher is None:
             self.load()
+        if self._role == "prefill":
+            raise RuntimeError(
+                "this unit is a prefill-role pool member: it serves the "
+                "KV transport only — route generate requests at the "
+                "decode pool"
+            )
         body = X if isinstance(X, dict) else None
         if body is None:
             if isinstance(X, str):
@@ -313,7 +563,7 @@ class GenerateServer(SeldonComponent):
         # remaining deadline budget rides the request meta (stamped per
         # hop by the graph executor): the batcher sheds the submit when
         # its admit queue cannot meet it (ShedError -> engine 429)
-        from ..resilience import DeadlineExceeded, deadline_s_from_meta
+        from ..resilience import deadline_s_from_meta
 
         deadline_s = deadline_s_from_meta(meta)
         import time as _time
@@ -321,6 +571,15 @@ class GenerateServer(SeldonComponent):
         expires_at = (
             _time.monotonic() + deadline_s if deadline_s is not None else None
         )
+        if self._role == "decode":
+            # disaggregated path: prefill happens at the peer pool, the
+            # slab crosses the KV transport, decode runs here
+            futures, results = self._predict_disagg(
+                token_lists, kw, deadline_s, expires_at
+            )
+            return self._build_response(
+                futures, results, token_lists, text_mode
+            )
         futures = []
         try:
             for toks in token_lists:
@@ -336,32 +595,12 @@ class GenerateServer(SeldonComponent):
             for f in futures:
                 f.cancel()
             raise
-        try:
-            results = []
-            for f in futures:
-                # wait no longer than the request's own budget: the 504 is
-                # the engine's answer either way, and an abandoned wait
-                # would pin this worker thread (and the decode lane) for
-                # the full 600s fallback
-                timeout = 600.0
-                if expires_at is not None:
-                    timeout = max(0.001, expires_at - _time.monotonic())
-                results.append(f.result(timeout=timeout))
-        except FuturesTimeout:
-            for f in futures:
-                f.cancel()  # reclaims queued slots and mid-decode lanes
-            if deadline_s is None:
-                raise  # the 600s safety fallback fired, not a budget
-            raise DeadlineExceeded(
-                f"generate ran past its {deadline_s * 1000:.0f}ms budget"
-            )
-        except Exception:
-            # one prompt failed mid-flight (admit error set on its
-            # future): all-or-nothing here too — reclaim the siblings
-            # before surfacing the error
-            for f in futures:
-                f.cancel()
-            raise
+        results = self._collect_results(
+            futures, token_lists, kw, deadline_s, expires_at
+        )
+        return self._build_response(futures, results, token_lists, text_mode)
+
+    def _build_response(self, futures, results, token_lists, text_mode):
         out: Dict[str, Any] = {"tokens": results}
         if text_mode:
             out["text"] = [
@@ -369,7 +608,9 @@ class GenerateServer(SeldonComponent):
             ]
         if self.batcher._prefix_index is not None:
             # per-request prompt tokens served from the prefix cache, in
-            # request order — graph nodes and the engine report it
+            # request order — graph nodes and the engine report it. For a
+            # decode pool the hit doubles as the transfer-dedup count:
+            # those tokens' K/V never crossed the wire
             out["cache_hit_tokens"] = [
                 int(getattr(getattr(f, "gen_request", None),
                             "cache_hit_tokens", 0))
@@ -394,7 +635,22 @@ class GenerateServer(SeldonComponent):
             raise ValueError("stream takes ONE prompt")
         toks = token_lists[0]
         q: "_queue.Queue" = _queue.Queue()
-        fut = self.batcher.submit(toks, on_tokens=q.put, **kw)
+        if self._role == "prefill":
+            raise RuntimeError(
+                "prefill-role pool members serve the KV transport only"
+            )
+        if self._role == "decode":
+            # streamed disaggregated generate: the slab handoff happens
+            # before the first byte goes out, then tokens stream as spans
+            # land exactly like the unary path. Always the FULL slab
+            # (covered=0): the unary path's PrefixGone retry cannot be
+            # replayed once response bytes exist, so streaming trades the
+            # transfer dedup for a handoff that can never lose its donor
+            # mid-stream
+            fut = self._remote_submit(toks, kw, None, covered=0,
+                                      on_tokens=q.put)
+        else:
+            fut = self.batcher.submit(toks, on_tokens=q.put, **kw)
         fut.add_done_callback(lambda _f: q.put(None))
 
         def chunks():
@@ -561,6 +817,18 @@ class GenerateServer(SeldonComponent):
             out.append(delta("gen_shed_total", s["shed"]))
         if s.get("weight_swaps"):
             out.append(delta("gen_weight_swaps", s["weight_swaps"]))
+        if s.get("kv_exports") or s.get("kv_imports"):
+            # disaggregated serving: slab/byte counters per direction plus
+            # the transfer-dedup savings — engine_metrics maps these to
+            # the first-class seldon_engine_kv_transfer_* series
+            out.extend([
+                delta("gen_kv_export_slabs", s["kv_exports"]),
+                delta("gen_kv_export_bytes", s["kv_export_bytes"]),
+                delta("gen_kv_import_slabs", s["kv_imports"]),
+                delta("gen_kv_import_bytes", s["kv_import_bytes"]),
+                delta("gen_kv_transfer_bytes_saved",
+                      s["kv_transfer_bytes_saved"]),
+            ])
         if self.batcher._prefix_index is not None:
             out.extend([
                 delta("prefix_cache_hits", s["prefix_hits"]),

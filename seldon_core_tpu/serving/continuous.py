@@ -115,6 +115,11 @@ class GenRequest:
     # (trace_id, parent_span_id) captured from the submitting thread's
     # active span; None when tracing is off or the request is unsampled
     trace: Optional[Tuple[str, str]] = None
+    # disaggregated serving: a remote admit carries its prefill-side slab
+    # here ({"slab" dev arrays, "first", "key", "covered", "nbytes",
+    # "version"}) and skips local prefill entirely — the wave-routing
+    # loop routes it to _admit_remote_lane (see admit_remote)
+    remote: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass
@@ -320,7 +325,17 @@ class ContinuousBatcher:
             "shed": 0,
             "burst_reads": 0, "burst_read_bytes": 0,
             "group_bursts": 0, "group_lanes": 0, "group_pad_lanes": 0,
+            # disaggregated serving: slabs/bytes shipped out (prefill
+            # role), slabs/bytes admitted in (decode role), and transfer
+            # bytes the decode-side radix cache deduplicated away
+            "kv_exports": 0, "kv_export_bytes": 0,
+            "kv_imports": 0, "kv_import_bytes": 0,
+            "kv_transfer_bytes_saved": 0,
         }
+        # export_prefill runs on caller threads (the prefill transport's
+        # handlers), concurrently with each other; its stat updates take
+        # this lock so counters can't lose increments
+        self._export_lock = threading.Lock()
         # SLO instrumentation: queue-wait / TTFT / TPOT samples of
         # COMPLETED requests. ``slo_pending`` is the drain queue the
         # serving component ships as Meta.metrics TIMERs (drop-oldest
@@ -1082,6 +1097,283 @@ class ContinuousBatcher:
         """Blocking convenience: submit and wait for the generated ids."""
         return self.submit(tokens, **kw).result()
 
+    # -- disaggregated serving (prefill/decode pools, KV-slab handoff) -----
+
+    @property
+    def _slab_token_bytes(self) -> int:
+        """K+V bytes one prompt position occupies across every layer —
+        the per-token unit the transfer-dedup accounting is priced in."""
+        return self._kv_key_bytes
+
+    def export_prefill(
+        self,
+        tokens: Sequence[int],
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        eos_id: Optional[int] = None,
+        seed: int = 0,
+        covered_len: int = 0,
+    ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """PREFILL-side half of disaggregation: run the prompt forward
+        and return ``(meta, slab)`` — the host-side ``cache_one`` K/V
+        stack plus everything a decode pool needs to splice it as a
+        remote lane insert (first sampled token, post-split RNG lane
+        key, weight version, sampling params).
+
+        Reuses PR 3's staging-slab path: with ``prefill_chunk`` set and
+        a multi-chunk bucket the slab is built chunk by chunk exactly
+        like an interleaved admission (no decode lanes are touched —
+        this method never requires the scheduler loop, which a
+        prefill-role server does not run); otherwise the ordinary
+        bucketed whole-prompt prefill produces it in one forward. The
+        first token is sampled on THIS side with the same PRNG
+        derivation an admission uses, so disaggregated greedy output is
+        byte-identical to unified serving.
+
+        ``covered_len`` > 0 (the decode side's radix prefix cache
+        already holds that many leading tokens) slices the transfer down
+        to the suffix columns — the K/V is still computed here (a full
+        prefill is the only way to produce correct suffix K/V without
+        the donor slab), but only ``bucket - covered_len`` positions
+        cross the wire and ``kv_transfer_bytes_saved`` records the
+        dedup."""
+        import jax.numpy as jnp
+
+        from ..tracing import device_trace
+        from .disagg import prompt_hash
+
+        if self._stop.is_set():
+            raise RuntimeError("batcher is closed")
+        n = len(tokens)
+        if not n:
+            raise ValueError("empty prompt")
+        if n >= self.max_seq:
+            raise ValueError(
+                f"prompt of {n} exceeds max_seq {self.max_seq}"
+            )
+        tokens = [int(t) for t in tokens]
+        bucket = self._bucket(n)
+        covered = max(0, min(int(covered_len), n - 1))
+        C = self.prefill_chunk
+        chunks = 0
+        if C and bucket > C:
+            # the staging path: one _chunk_fn slice at a time, same
+            # offsets/slide-back as _advance_chunks, final slice samples
+            slab = self._new_slab(bucket)
+            first = key = None
+            start = 0
+            while True:
+                is_last = start + C >= n
+                s = max(0, min(start, bucket - C)) if is_last else start
+                end = min(s + C, n)
+                buf = np.zeros((1, C), np.int32)
+                buf[0, : end - s] = tokens[s:end]
+                attn_len = min(bucket, self._attn_need(s + C))
+                with device_trace("gen.prefill_chunk"):
+                    slab, first, key = self._chunk_fn(
+                        self.params, slab, jnp.asarray(buf),
+                        jnp.int32(s), jnp.int32(n - 1 - s),
+                        jnp.int32(seed), jnp.float32(temperature),
+                        attn_len, is_last,
+                    )
+                chunks += 1
+                if is_last:
+                    break
+                start = end
+            cache_one, first_tok = slab, first
+        else:
+            prompt = np.zeros((1, bucket), np.int32)
+            prompt[0, :n] = tokens
+            with device_trace("gen.prefill"):
+                first, cache_one, key = self._prefill_fn(
+                    self.params, jnp.asarray(prompt),
+                    jnp.asarray([n - 1], jnp.int32),
+                    jnp.int32(seed), jnp.float32(temperature),
+                )
+            first_tok = first[0]
+        # host pull IS the export (the slab must cross a transport);
+        # suffix-only when the decode side already holds the prefix
+        k = np.asarray(cache_one["k"])
+        v = np.asarray(cache_one["v"])
+        if covered:
+            k = k[:, :, :, covered:, :]
+            v = v[:, :, :, covered:, :]
+        meta = {
+            "tokens": tokens,
+            "prompt_hash": prompt_hash(tokens),
+            "n_tokens": n,
+            "bucket": bucket,
+            "covered_len": covered,
+            "layout": "cache_one",
+            "first_token": int(np.asarray(first_tok)),
+            "rng_key": np.asarray(key).astype(np.uint32).tolist(),
+            "weight_version": self.weight_version,
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature),
+            "eos_id": eos_id,
+            "seed": int(seed),
+        }
+        nbytes = int(k.nbytes) + int(v.nbytes)
+        with self._export_lock:
+            self.stats["kv_exports"] += 1
+            self.stats["kv_export_bytes"] += nbytes
+            self.stats["prefill_steps"] += max(1, chunks)
+            self.stats["prefill_tokens"] += chunks * C if chunks else bucket
+            self.stats["prefill_chunks"] += chunks
+            # kv_transfer_bytes_saved is counted on the DECODE side only
+            # (the pool whose radix cache made the dedup decision): the
+            # exported series is direction-less, so counting the same
+            # covered tokens here too would double the cluster-wide sum
+        if self.flight is not None and self.flight.enabled:
+            self.flight.record({
+                "type": "kv_export",
+                "tokens": n,
+                "bucket": bucket,
+                "covered_len": covered,
+                "bytes": nbytes,
+                "chunks": chunks,
+                "weight_version": self.weight_version,
+            })
+        return meta, {"k": k, "v": v}
+
+    def remote_covered_len(self, tokens: Sequence[int]) -> int:
+        """DECODE-side consult before requesting a remote prefill: the
+        longest locally cached prefix usable as the transfer-dedup base
+        (0 = ask for the full slab). Applies the same usability caps as
+        a local prefix-cache admit, so a nonzero answer is one
+        admit_remote can actually splice."""
+        if self._prefix_index is None:
+            return 0
+        tokens = [int(t) for t in tokens]
+        n = len(tokens)
+        m, slab = self._prefix_index.match(tokens)
+        m = min(m, n - 1)
+        if slab is None or m < self.prefix_cache_min_tokens:
+            return 0
+        if slab["k"].shape[3] > self._bucket(n):
+            return 0  # donor wider than the prompt bucket: not a win
+        return m
+
+    def admit_remote(
+        self,
+        slab: Dict[str, Any],
+        meta: Dict[str, Any],
+        on_tokens=None,
+        deadline_s: Optional[float] = None,
+    ) -> Future:
+        """DECODE-side half of disaggregation: validate a shipped slab
+        message, upload it, and queue it as a remote lane insert —
+        spliced by the scheduler thread through the SAME insert
+        executables an ordinary admission uses, so decode after a remote
+        admit is byte-identical to unified serving.
+
+        Rejections are typed and happen BEFORE any lane state exists:
+        weight-version mismatch (a hot-swap landed between prefill and
+        admit) raises :class:`~.disagg.WeightVersionMismatch`; a
+        shape/dtype/layout mismatch raises :class:`~.disagg.DisaggError`;
+        a suffix-only slab whose local donor prefix was evicted raises
+        :class:`~.disagg.PrefixGone` at insert time (the caller retries
+        with ``covered_len=0``). Returns the request Future, exactly
+        like :meth:`submit`."""
+        import jax.numpy as jnp
+
+        from .disagg import DisaggError, PrefixGone, WeightVersionMismatch
+        from .disagg import prompt_hash as _phash
+
+        if self._stop.is_set():
+            raise RuntimeError("batcher is closed")
+        if self.speculate_tokens > 0:
+            raise DisaggError(
+                "remote admits are not supported with speculative "
+                "decoding (the draft cache has no prefix for the lane)"
+            )
+        tokens = [int(t) for t in meta.get("tokens") or []]
+        if not tokens:
+            raise DisaggError("slab meta carries no prompt tokens")
+        n = len(tokens)
+        if n >= self.max_seq:
+            raise DisaggError(
+                f"remote prompt of {n} exceeds max_seq {self.max_seq}"
+            )
+        if meta.get("prompt_hash") and meta["prompt_hash"] != _phash(tokens):
+            raise DisaggError("slab prompt hash mismatch — corrupt meta")
+        if meta.get("layout", "cache_one") != "cache_one":
+            raise DisaggError(
+                f"unsupported slab layout {meta.get('layout')!r}"
+            )
+        if meta.get("weight_version") != self.weight_version:
+            raise WeightVersionMismatch(
+                f"slab prefilled under weight_version "
+                f"{meta.get('weight_version')!r} but this decode pool "
+                f"serves {self.weight_version!r}"
+            )
+        covered = max(0, int(meta.get("covered_len", 0)))
+        if covered and self._prefix_index is None:
+            raise PrefixGone(
+                "suffix-only slab but this decode pool runs no prefix "
+                "cache — re-request with covered_len=0"
+            )
+        self._shed_check(deadline_s)
+        cfg = self.model.cfg
+        k = np.asarray(slab["k"])
+        v = np.asarray(slab["v"])
+        bucket = self._bucket(n)
+        want = (cfg.n_layers, 1, cfg.n_kv_heads, bucket - covered,
+                cfg.head_dim)
+        if tuple(k.shape) != want or tuple(v.shape) != want:
+            raise DisaggError(
+                f"slab shape {tuple(k.shape)} does not match the serving "
+                f"model's {want} (prompt {n} -> bucket {bucket}, "
+                f"covered {covered})"
+            )
+        dt = jnp.dtype(getattr(self.model, "compute_dtype", cfg.dtype))
+        if str(k.dtype) != str(dt):
+            raise DisaggError(
+                f"slab dtype {k.dtype} vs serving compute dtype {dt} — "
+                "prefill and decode pools must share a dtype"
+            )
+        if meta.get("first_token") is None:
+            raise DisaggError("slab meta carries no first_token")
+        key_arr = np.asarray(meta.get("rng_key", [0, 0]), np.uint32)
+        budget = self.max_seq - n
+        req = GenRequest(
+            tokens=tokens,
+            max_new_tokens=min(int(meta.get("max_new_tokens", 32)), budget),
+            temperature=float(meta.get("temperature", 0.0)),
+            eos_id=meta.get("eos_id"),
+            seed=int(meta.get("seed", 0)),
+            on_tokens=on_tokens,
+        )
+        req.submit_t = time.monotonic()
+        req.submit_wall_us = int(time.time() * 1e6)
+        req.cache_hit_tokens = covered
+        from ..tracing import get_tracer
+
+        tracer = get_tracer()
+        if tracer.enabled:
+            parent = tracer.active_span()
+            if parent is not None and parent.trace_id != "0":
+                req.trace = (parent.trace_id, parent.span_id)
+        # device upload happens HERE, on the caller thread: the H2D copy
+        # overlaps whatever burst the scheduler is running
+        req.remote = {
+            "slab": {"k": jnp.asarray(k), "v": jnp.asarray(v)},
+            "first": int(meta["first_token"]),
+            "key": jnp.asarray(key_arr),
+            "covered": covered,
+            "nbytes": int(k.nbytes) + int(v.nbytes),
+            "version": meta.get("weight_version"),
+        }
+        req.future.gen_request = req
+        self._queue.put(req)
+        if self._stop.is_set():
+            self._drain_queue(
+                RuntimeError("continuous batcher died; see server log")
+            )
+            return req.future
+        self.start()
+        return req.future
+
     def request_weight_swap(self, params, version=None) -> Future:
         """Stage a live weight hot-swap; returns a Future resolving to
         the new weight version once the scheduler flips.
@@ -1760,6 +2052,94 @@ class ContinuousBatcher:
         self.stats["prefix_evicted"] += idx.insert(toks, slab, nbytes)
         self.stats["prefix_cache_bytes"] = idx.total_bytes
 
+    def _admit_remote_lane(self, slot: int, req: GenRequest) -> None:
+        """Splice a shipped prefill slab into ``slot`` (scheduler thread;
+        the decode-side endpoint of the KV handoff). No prefill runs
+        here — the slab carries the prompt K/V and the first sampled
+        token; a full slab goes through the ORDINARY whole-prompt
+        insert, a suffix-only slab re-matches the local radix index and
+        goes through the prefix-splice insert, so decode state after a
+        remote admit is bit-identical to the unified path's."""
+        import jax.numpy as jnp
+
+        from ..tracing import device_trace
+        from .disagg import PrefixGone, WeightVersionMismatch
+
+        r = req.remote
+        n = len(req.tokens)
+        t_admit = time.monotonic()
+        req.admit_t = t_admit
+        # re-validate at the poll boundary: a hot-swap that flipped while
+        # this request sat in the queue makes the slab stale — the typed
+        # refusal the progressive-delivery contract requires
+        if r["version"] != self.weight_version:
+            raise WeightVersionMismatch(
+                f"weight swap landed mid-handoff: slab is "
+                f"{r['version']!r}, serving {self.weight_version!r}"
+            )
+        covered = r["covered"]
+        if covered:
+            m, donor = self._prefix_index.match(req.tokens)
+            if donor is None or m < covered:
+                raise PrefixGone(
+                    f"cached prefix covers {m} tokens but the slab "
+                    f"assumes {covered} — donor evicted mid-handoff; "
+                    "re-request with covered_len=0"
+                )
+            with device_trace("gen.lane_insert"):
+                self._cache, self._cur_tok, self._pos, self._keys = (
+                    self._insert_prefix_fn(
+                        self._cache, donor, r["slab"], slot,
+                        jnp.int32(covered), jnp.int32(r["first"]), n,
+                        r["key"], self._cur_tok, self._pos, self._keys,
+                    )
+                )
+            self.stats["prefix_hits"] += 1
+            self.stats["prefix_tokens_saved"] += covered
+        else:
+            with device_trace("gen.lane_insert"):
+                self._cache, self._cur_tok, self._pos, self._keys = (
+                    self._insert_fn(
+                        self._cache, r["slab"], slot, jnp.int32(r["first"]),
+                        n, r["key"], self._cur_tok, self._pos, self._keys,
+                    )
+                )
+            if self._prefix_index is not None:
+                self.stats["prefix_misses"] += 1
+        t_inserted = time.monotonic()
+        req.decode_start_t = t_inserted
+        self._emit_span(
+            req, "gen.queue_wait", req.submit_t, t_admit,
+            tags={"lane": slot, "remote": True,
+                  "cache_hit_tokens": covered},
+        )
+        self._emit_span(
+            req, "gen.lane_insert", t_admit, t_inserted,
+            tags={"lane": slot, "remote": True, "dispatch": True},
+        )
+        self._active[slot] = _Slot(request=req)
+        self._pos_host[slot] = n
+        self._masks_dirty = True
+        self.stats["admitted"] += 1
+        self.stats["kv_imports"] += 1
+        self.stats["kv_import_bytes"] += r["nbytes"]
+        if covered:
+            self.stats["kv_transfer_bytes_saved"] += (
+                covered * self._slab_token_bytes
+            )
+        if self.flight is not None and self.flight.enabled:
+            self.flight.record({
+                "type": "remote_insert",
+                "lane": slot,
+                "tokens": n,
+                "covered_len": covered,
+                "bytes": r["nbytes"],
+                "weight_version": self.weight_version,
+            })
+        # the slab's device arrays are spliced; drop the reference so the
+        # upload buffer frees as soon as the insert's copy completes
+        req.remote = None
+
     def _admit(self, slot: int, req: GenRequest, hit=None) -> None:
         # ``hit``: a (match_len, slab) the wave-routing loop already
         # computed — passed through so the radix walk (and its LRU touch)
@@ -2138,6 +2518,32 @@ class ContinuousBatcher:
                     chunk_size = self.prefill_chunk
                     by_bucket: Dict[int, List[GenRequest]] = {}
                     for req in wave:
+                        if req.remote is not None:
+                            # disaggregated handoff: the prompt K/V came
+                            # over the wire — splice it, no local prefill
+                            slot = next(free_iter)
+                            try:
+                                self._admit_remote_lane(slot, req)
+                            except Exception as e:  # noqa: BLE001 - typed refusal
+                                from .disagg import (
+                                    PrefixGone,
+                                    WeightVersionMismatch,
+                                )
+
+                                if isinstance(
+                                    e, (PrefixGone, WeightVersionMismatch)
+                                ):
+                                    # expected, self-healing refusals (the
+                                    # caller retries full-slab / re-prefills
+                                    # under the new version): one info line,
+                                    # no traceback — ERROR stays reserved
+                                    # for corrupt slabs and real faults
+                                    logger.info("remote admit refused: %s", e)
+                                else:
+                                    logger.exception("remote admit failed")
+                                if not req.future.done():
+                                    req.future.set_exception(e)
+                            continue
                         hit = (
                             self._prefix_match(req)
                             if self._prefix_index is not None

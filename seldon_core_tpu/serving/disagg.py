@@ -1,0 +1,427 @@
+"""Prefill/decode disaggregation: KV-slab wire codec + transports.
+
+DeepServe-style decoupled serving (PAPERS.md, arxiv 2501.14417): prefill
+and decode run in independently scaled pools, and the finished prompt
+K/V slab crosses a transport instead of a lane insert. This module is
+the wire half — the batcher half lives in ``continuous.py``
+(``ContinuousBatcher.export_prefill`` / ``admit_remote``).
+
+The unit of transfer is a **slab message**: the ``cache_one``-layout
+prompt K/V stack ``{"k","v"}`` of ``[L, 1, KV, W, Dh]`` host arrays plus
+a metadata dict (prompt tokens + hash, dtype/layout, the first sampled
+token, the post-split RNG lane key, ``weight_version``, sampling
+params, and ``covered_len`` — how many leading prompt tokens the decode
+side already holds in its radix prefix cache, so only the suffix slab
+is on the wire).
+
+Wire format (version ``SKV1``), streamed **layer-major** so the decode
+side can start uploading layer 0 while layer L-1 is still in flight::
+
+    b"SKV1" | u32 header_len | u32 crc32(header) | header JSON
+    per layer l in 0..L-1, for each of k, v:
+        u32 payload_len | u32 crc32(payload) | payload bytes
+    b"SKVE" | u32 total_crc32 (running crc over every payload)
+
+The header carries its own CRC because a flipped bit there is the
+nastiest corruption: a still-valid-JSON header with a wrong
+``first_token`` or RNG key would seed a lane with silently wrong
+output, not a crash.
+
+Every frame is checksummed; a mismatch raises :class:`ChecksumError`
+and a short read raises :class:`TruncatedStream` — both BEFORE any lane
+state exists on the decode side (no half-admitted lane, the codec
+satellite's contract). Errors from the prefill peer travel as a
+``b"SKV!"``-prefixed JSON frame instead of a header.
+
+Transports:
+
+* :class:`LoopbackTransport` — in-process: the decode server holds a
+  direct reference to the prefill server, but the slab still round-trips
+  the full encode/decode codec through memory, so loopback exercises
+  byte-identical framing to TCP (and the codec tests cover both).
+* :class:`TcpKVClient` / :class:`PrefillTransportServer` — chunked
+  TCP/DCN: the client sends one JSON request line, the server streams
+  the slab back in ``chunk_bytes`` writes (the sender never materialises
+  more than one chunk beyond the OS socket buffer — the bounded
+  in-flight contract), deadline-aware per PR 2 (the remaining request
+  budget becomes the socket timeout on both connect and read).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import logging
+import socket
+import struct
+import threading
+import zlib
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+MAGIC = b"SKV1"
+END = b"SKVE"
+ERR = b"SKV!"
+WIRE_VERSION = 1
+
+
+class DisaggError(RuntimeError):
+    """Base for disaggregation failures; carries a wire status so the
+    graph executor surfaces it as a typed UnitCallError."""
+
+    status = 502
+
+
+class ChecksumError(DisaggError):
+    """A slab frame's CRC did not match — the stream is corrupt."""
+
+
+class TruncatedStream(DisaggError):
+    """The stream ended mid-frame — nothing was admitted."""
+
+
+class WeightVersionMismatch(DisaggError):
+    """The slab was prefilled under a different weight version than the
+    decode pool is serving (a hot-swap landed between prefill and
+    admit): the K/V would be stale, refuse the splice."""
+
+    status = 409
+
+
+class PrefixGone(DisaggError):
+    """The decode-side radix entry that justified suffix-only transfer
+    was evicted before the admit; the caller re-requests a full slab."""
+
+
+def prompt_hash(tokens) -> str:
+    return hashlib.sha256(
+        np.asarray(tokens, np.int32).tobytes()
+    ).hexdigest()[:16]
+
+
+def _read_exact(read: Callable[[int], bytes], n: int) -> bytes:
+    """Read exactly n bytes or raise TruncatedStream."""
+    chunks = []
+    got = 0
+    while got < n:
+        b = read(n - got)
+        if not b:
+            raise TruncatedStream(
+                f"stream ended after {got} of {n} expected bytes"
+            )
+        chunks.append(b)
+        got += len(b)
+    return b"".join(chunks) if len(chunks) != 1 else chunks[0]
+
+
+def encode_slab(
+    meta: Dict[str, Any],
+    slab: Dict[str, np.ndarray],
+    chunk_bytes: int = 1 << 20,
+) -> Iterator[bytes]:
+    """Yield the wire frames of one slab message. ``slab`` holds host
+    arrays ``[L, 1, KV, W, Dh]``; frames come out layer-major (k then v
+    per layer) in writes of at most ``chunk_bytes`` so a streaming
+    sender never holds more than one chunk in flight."""
+    k, v = np.ascontiguousarray(slab["k"]), np.ascontiguousarray(slab["v"])
+    if k.shape != v.shape:
+        raise DisaggError(f"k/v shape mismatch: {k.shape} vs {v.shape}")
+    header = dict(meta)
+    header["wire_version"] = WIRE_VERSION
+    header["shape"] = list(k.shape)
+    header["slab_dtype"] = str(k.dtype)
+    hdr = json.dumps(header).encode()
+    yield MAGIC + struct.pack("<II", len(hdr), zlib.crc32(hdr)) + hdr
+    total_crc = 0
+    for layer in range(k.shape[0]):
+        for arr in (k[layer], v[layer]):
+            payload = arr.tobytes()
+            total_crc = zlib.crc32(payload, total_crc)
+            yield struct.pack("<II", len(payload), zlib.crc32(payload))
+            for off in range(0, len(payload), chunk_bytes):
+                yield payload[off:off + chunk_bytes]
+    yield END + struct.pack("<I", total_crc)
+
+
+def decode_slab(
+    read: Callable[[int], bytes],
+) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+    """Consume one slab message from ``read`` (a ``recv``-style callable
+    returning up to n bytes, b"" at EOF). Returns ``(meta, slab)``.
+    Raises :class:`ChecksumError` / :class:`TruncatedStream` /
+    :class:`DisaggError` — always before returning partial data."""
+    magic = _read_exact(read, 4)
+    if magic == ERR:
+        (n,) = struct.unpack("<I", _read_exact(read, 4))
+        err = json.loads(_read_exact(read, n))
+        cls = {"weight_version": WeightVersionMismatch}.get(
+            err.get("kind"), DisaggError
+        )
+        raise cls(err.get("error", "prefill peer error"))
+    if magic != MAGIC:
+        raise DisaggError(f"bad slab magic {magic!r} (want {MAGIC!r})")
+    hdr_len, hdr_crc = struct.unpack("<II", _read_exact(read, 8))
+    hdr = _read_exact(read, hdr_len)
+    if zlib.crc32(hdr) != hdr_crc:
+        raise ChecksumError("slab header failed its checksum")
+    meta = json.loads(hdr)
+    if meta.get("wire_version") != WIRE_VERSION:
+        raise DisaggError(
+            f"unsupported slab wire version {meta.get('wire_version')!r}"
+        )
+    shape = tuple(meta["shape"])
+    dtype = np.dtype(meta["slab_dtype"])
+    if len(shape) != 5:
+        raise DisaggError(f"slab shape must be [L,1,KV,W,Dh], got {shape}")
+    layer_bytes = int(np.prod(shape[1:])) * dtype.itemsize
+    out = {
+        "k": np.empty(shape, dtype),
+        "v": np.empty(shape, dtype),
+    }
+    total_crc = 0
+    for layer in range(shape[0]):
+        for name in ("k", "v"):
+            n, crc = struct.unpack("<II", _read_exact(read, 8))
+            if n != layer_bytes:
+                raise DisaggError(
+                    f"layer {layer} {name} frame is {n} bytes, "
+                    f"expected {layer_bytes} for shape {shape}"
+                )
+            payload = _read_exact(read, n)
+            if zlib.crc32(payload) != crc:
+                raise ChecksumError(
+                    f"layer {layer} {name} frame failed its checksum"
+                )
+            total_crc = zlib.crc32(payload, total_crc)
+            out[name][layer] = np.frombuffer(payload, dtype).reshape(shape[1:])
+    tail = _read_exact(read, 8)
+    if tail[:4] != END:
+        raise TruncatedStream(f"missing end frame (got {tail[:4]!r})")
+    (want,) = struct.unpack("<I", tail[4:])
+    if want != total_crc:
+        raise ChecksumError("stream total checksum mismatch")
+    return meta, out
+
+
+def encode_error(err: Exception) -> bytes:
+    kind = "weight_version" if isinstance(err, WeightVersionMismatch) else "error"
+    body = json.dumps({"error": str(err), "kind": kind}).encode()
+    return ERR + struct.pack("<I", len(body)) + body
+
+
+# ---------------------------------------------------------------------------
+# Transports
+# ---------------------------------------------------------------------------
+
+
+class LoopbackTransport:
+    """In-process transport: a direct reference to the prefill-side
+    handler, with the slab still round-tripping the full codec through a
+    memory buffer (framing bugs can't hide behind shared memory)."""
+
+    name = "loopback"
+
+    def __init__(self, prefill_server, chunk_bytes: int = 1 << 20):
+        self._server = prefill_server
+        self._chunk = int(chunk_bytes)
+
+    def prefill(
+        self, request: Dict[str, Any], deadline_s: Optional[float] = None
+    ) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+        buf = io.BytesIO()
+        try:
+            meta, slab = self._server.prefill_export(request)
+            for frame in encode_slab(meta, slab, self._chunk):
+                buf.write(frame)
+        except DisaggError as e:
+            buf = io.BytesIO(encode_error(e))
+        buf.seek(0)
+        return decode_slab(buf.read)
+
+    def close(self) -> None:
+        pass
+
+
+class TcpKVClient:
+    """Decode-side client for the chunked TCP/DCN transport: one
+    connection per transfer (the slab dominates any handshake cost),
+    deadline-aware — the remaining request budget is the socket timeout
+    for connect and every read."""
+
+    name = "tcp"
+
+    def __init__(self, peer: str, connect_timeout_s: float = 10.0):
+        host, _, port = peer.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"peer must be host:port, got {peer!r}")
+        self.host, self.port = host, int(port)
+        self._connect_timeout = float(connect_timeout_s)
+
+    def prefill(
+        self, request: Dict[str, Any], deadline_s: Optional[float] = None
+    ) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+        timeout = (
+            min(self._connect_timeout, deadline_s)
+            if deadline_s is not None else self._connect_timeout
+        )
+        import time as _time
+
+        expires_at = (
+            _time.monotonic() + deadline_s if deadline_s is not None else None
+        )
+        try:
+            sock = socket.create_connection(
+                (self.host, self.port), timeout=timeout
+            )
+        except OSError as e:
+            raise DisaggError(
+                f"prefill peer {self.host}:{self.port} unreachable: {e}"
+            ) from e
+
+        def read(n: int) -> bytes:
+            # the REMAINING budget bounds every read: a peer dripping one
+            # chunk per almost-deadline must still finish the whole
+            # transfer inside the request budget, not reset the clock
+            # per recv
+            if expires_at is not None:
+                remaining = expires_at - _time.monotonic()
+                if remaining <= 0:
+                    raise socket.timeout("kv transfer budget exhausted")
+                sock.settimeout(remaining)
+            return sock.recv(n)
+
+        try:
+            sock.settimeout(
+                max(0.001, expires_at - _time.monotonic())
+                if expires_at is not None else 60.0
+            )
+            sock.sendall(json.dumps(request).encode() + b"\n")
+            return decode_slab(read)
+        except socket.timeout as e:
+            raise DisaggError(
+                f"kv transfer from {self.host}:{self.port} ran past the "
+                "deadline"
+            ) from e
+        except OSError as e:
+            # mid-stream connection loss (e.g. a prefill-pool resize
+            # tearing the listener down under us) must surface with the
+            # same typed status every other transport failure carries
+            raise DisaggError(
+                f"kv transfer from {self.host}:{self.port} failed "
+                f"mid-stream: {e}"
+            ) from e
+        finally:
+            sock.close()
+
+    def close(self) -> None:
+        pass
+
+
+class PrefillTransportServer:
+    """Prefill-side TCP listener: accepts one JSON request line per
+    connection and streams the slab back frame by frame (``chunk_bytes``
+    per write — the sender-side in-flight bound). Runs accept + handler
+    threads, at most ``max_inflight`` concurrently — each handler holds
+    a device prefill plus a whole host-side slab, so an unbounded burst
+    of decode-pool connections would collapse exactly the pool
+    disaggregation is meant to isolate; over-limit connections get an
+    immediate typed shed frame instead of queueing. ``close()`` unblocks
+    the accept loop."""
+
+    def __init__(
+        self,
+        prefill_server,
+        port: int = 0,
+        host: str = "0.0.0.0",
+        chunk_bytes: int = 1 << 20,
+        max_inflight: int = 8,
+    ):
+        self._server = prefill_server
+        self._chunk = int(chunk_bytes)
+        self._slots = threading.Semaphore(max(1, int(max_inflight)))
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="kv-export", daemon=True
+        )
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return  # closed
+            threading.Thread(
+                target=self._handle, args=(conn,), daemon=True
+            ).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        if not self._slots.acquire(blocking=False):
+            # prefill-side shed-before-work: reject NOW, from this
+            # connection's own thread, rather than stacking device
+            # forwards and slab buffers behind the listener
+            try:
+                conn.sendall(encode_error(DisaggError(
+                    "prefill pool at capacity — retry"
+                )))
+            except OSError:
+                pass
+            finally:
+                conn.close()
+            return
+        try:
+            self._handle_locked(conn)
+        finally:
+            self._slots.release()
+
+    def _handle_locked(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(60.0)
+            line = b""
+            while not line.endswith(b"\n"):
+                b = conn.recv(65536)
+                if not b:
+                    return
+                line += b
+                if len(line) > 8 << 20:
+                    raise DisaggError("oversized prefill request")
+            request = json.loads(line)
+            try:
+                meta, slab = self._server.prefill_export(request)
+            except DisaggError as e:
+                conn.sendall(encode_error(e))
+                return
+            except Exception as e:  # noqa: BLE001 - bad request params
+                conn.sendall(encode_error(DisaggError(str(e))))
+                return
+            for frame in encode_slab(meta, slab, self._chunk):
+                conn.sendall(frame)
+        except Exception:  # noqa: BLE001 - one bad peer must not kill accept
+            logger.exception("kv export connection failed")
+        finally:
+            conn.close()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=5.0)
+
+
+def make_transport(peer, chunk_bytes: int = 1 << 20):
+    """``peer`` is either a live prefill-server object (loopback) or a
+    ``"host:port"`` string (TCP)."""
+    if isinstance(peer, str):
+        return TcpKVClient(peer)
+    return LoopbackTransport(peer, chunk_bytes=chunk_bytes)

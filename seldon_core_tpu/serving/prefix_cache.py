@@ -29,6 +29,7 @@ decode lanes be reused without scrubbing).
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 
@@ -52,8 +53,13 @@ class RadixPrefixIndex:
     """Longest-prefix match + LRU byte budget over published K/V slabs.
 
     All methods are plain Python over host token lists; slabs are opaque.
-    Single-writer (the scheduler thread); readers of ``total_bytes`` /
-    ``node_count`` from other threads see torn-but-harmless ints.
+    Public methods take an internal lock: mutation is dominated by the
+    scheduler thread, but disaggregated decode pools also consult the
+    index from server worker threads (``remote_covered_len`` before a
+    KV transfer), so walks must never see a half-split edge. The lock is
+    uncontended in the unified single-writer case; readers of
+    ``total_bytes`` / ``node_count`` from other threads still see
+    torn-but-harmless ints.
     """
 
     def __init__(self, budget_bytes: int):
@@ -61,6 +67,7 @@ class RadixPrefixIndex:
         self.root = _Node(edge=())
         self.total_bytes = 0
         self._clock = 0
+        self._lock = threading.Lock()
         # weight-version key: slabs are K/V computed under ONE set of
         # model weights. A live hot-swap (continuous.request_weight_swap)
         # bumps this via set_version, purging every stored slab — stale
@@ -153,6 +160,10 @@ class RadixPrefixIndex:
         return depth, carrier, path
 
     def match(self, tokens) -> Tuple[int, Any]:
+        with self._lock:
+            return self._match_locked(tokens)
+
+    def _match_locked(self, tokens) -> Tuple[int, Any]:
         """Longest cached prefix of ``tokens``: returns ``(depth, slab)``
         where ``slab`` holds valid K/V for positions ``[0, depth)``, or
         ``(0, None)``. Touches the LRU clock on the serving slab's node
@@ -171,6 +182,10 @@ class RadixPrefixIndex:
         return depth, slab_node.slab
 
     def covered_len(self, tokens) -> int:
+        with self._lock:
+            return self._covered_len_locked(tokens)
+
+    def _covered_len_locked(self, tokens) -> int:
         """Longest prefix of ``tokens`` some stored slab covers, WITHOUT
         touching the LRU clock (the publish-dedup probe)."""
         depth, carrier, _path = self._walk(tokens)
@@ -181,6 +196,10 @@ class RadixPrefixIndex:
     # -- mutation ----------------------------------------------------------
 
     def insert(self, tokens, slab, nbytes: int) -> int:
+        with self._lock:
+            return self._insert_locked(tokens, slab, nbytes)
+
+    def _insert_locked(self, tokens, slab, nbytes: int) -> int:
         """Publish ``slab`` (K/V for the whole of ``tokens``) under the
         radix path, splitting edges as needed, then evict LRU slab nodes
         until the byte budget holds. Returns the number of slabs evicted.
@@ -240,6 +259,10 @@ class RadixPrefixIndex:
         return evicted
 
     def set_version(self, version) -> int:
+        with self._lock:
+            return self._set_version_locked(version)
+
+    def _set_version_locked(self, version) -> int:
         """Key the pool to a new weight version, purging every stored
         slab (their K/V was computed under the OLD weights — serving one
         into a new-weights prefill would splice numerically wrong cache).

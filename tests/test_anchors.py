@@ -142,6 +142,25 @@ def test_bench_shared_prefix_scenario_anchor():
     assert "compact_summary" in bench_src
 
 
+def test_bench_disagg_scenario_anchor():
+    """The ``llm_1b_disagg`` bench scenario is an acceptance artifact
+    (greedy byte-identity of the KV-slab handoff across loopback + TCP,
+    the decode-pool TTFT/TPOT p99 isolation ratios under long-prompt
+    injection, and the ``kv_transfer_bytes_saved`` dedup proof are read
+    from its entry): it must stay wired through BOTH model tiers, and
+    the numbers-table generator must know its key."""
+    import seldon_core_tpu.modelbench as modelbench
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    mb_src = open(modelbench.__file__).read()
+    assert mb_src.count('results["llm_1b_disagg"]') >= 2  # tiny + chip
+    assert hasattr(modelbench, "bench_disagg")
+    # the entry asserts the greedy-identity bit like prior scenarios
+    assert '"greedy_identical": identical' in mb_src
+    gen_src = open(os.path.join(root, "tools", "gen_arch_numbers.py")).read()
+    assert "llm_1b_disagg" in gen_src
+
+
 def test_bench_rollout_scenario_anchor():
     """The ``llm_1b_rollout`` bench scenario is an acceptance artifact
     (per-step greedy byte-identity of an identical-weights canary, the

@@ -71,6 +71,19 @@ def test_model_tier_tiny_end_to_end():
     assert ro["rollback"]["intervals_to_restore"] == 1
     assert ro["tokens_per_s"] > 0
     assert ro["mirror"]["mirrored"] > 0
+    # disaggregated serving: the KV-slab handoff must be byte-invisible
+    # (unified vs loopback vs TCP, incl. decode-side prefix hits), all
+    # four isolation windows must have run, and the shared-prefix phase
+    # must actually deduplicate transfer bytes
+    dg = results["llm_1b_disagg"]
+    assert dg["greedy_identical"] is True
+    for w in ("unified_quiet", "unified_injected",
+              "disagg_quiet", "disagg_injected"):
+        assert dg["isolation"][w]["requests"] > 0, w
+    assert dg["isolation"]["unified_injected"]["long_injected"] > 0
+    assert dg["isolation"]["disagg_injected"]["long_injected"] > 0
+    assert dg["transfer_dedup"]["kv_transfer_bytes_saved"] > 0
+    assert any(h > 0 for h in dg["transfer_dedup"]["cache_hit_tokens"])
     # CPU has no published peak -> MFU is None there; on TPU it's a number
     mfu = results["resnet50_rest"]["mfu_pct"]
     assert mfu is None or 0 < mfu < 100

@@ -47,6 +47,45 @@ def _swap_lines(swaps: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+def _kv_lines(
+    exports: List[Dict[str, Any]], inserts: List[Dict[str, Any]]
+) -> List[str]:
+    """Disaggregated-serving records, shown inline with the scheduling
+    story: slab exports (prefill pool) and remote inserts (decode pool)
+    with their transfer-dedup coverage, so a dump from either pool shows
+    which half of the handoff this scheduler is and what crossed the
+    wire."""
+    lines: List[str] = []
+    if exports:
+        total = sum(e.get("bytes", 0) for e in exports)
+        suffix_only = [e for e in exports if e.get("covered_len", 0) > 0]
+        chunked = [e for e in exports if e.get("chunks", 0) > 1]
+        lines.append(
+            f"kv export (prefill pool): {len(exports)} slabs, "
+            f"{total / 1e6:.2f} MB shipped; {len(suffix_only)} suffix-only "
+            f"(decode-side prefix cache deduplicated the rest), "
+            f"{len(chunked)} built via chunked staging"
+        )
+    if inserts:
+        total = sum(e.get("bytes", 0) for e in inserts)
+        dedup = [e for e in inserts if e.get("covered_len", 0) > 0]
+        saved_toks = sum(e.get("covered_len", 0) for e in inserts)
+        lines.append(
+            f"remote inserts (decode pool): {len(inserts)} slabs spliced, "
+            f"{total / 1e6:.2f} MB received; {len(dedup)} rode a local "
+            f"prefix hit ({saved_toks} prompt tokens never crossed the "
+            "wire)"
+        )
+        if inserts and not dedup:
+            lines.append(
+                "DIAGNOSIS: every remote insert shipped its full slab — "
+                "no decode-side prefix hits; if traffic shares prompts, "
+                "set prefix_cache_hbm_bytes on the DECODE pool (it is "
+                "the transfer-dedup layer)"
+            )
+    return lines
+
+
 def diagnose(dump: Dict[str, Any]) -> List[str]:
     """Report lines for one unit's flight-recorder dump."""
     lines: List[str] = []
@@ -54,6 +93,8 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
     polls = [e for e in entries if e.get("type") == "poll"]
     sheds = [e for e in entries if e.get("type") == "shed"]
     swaps = [e for e in entries if e.get("type") == "weight_swap"]
+    kv_exports = [e for e in entries if e.get("type") == "kv_export"]
+    kv_inserts = [e for e in entries if e.get("type") == "remote_insert"]
     lines.append(
         f"recorded {dump.get('recorded_total', len(entries))} records "
         f"(ring holds {len(entries)}, dropped "
@@ -100,6 +141,9 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
         if sheds:
             lines.append(f"{len(sheds)} shed events recorded")
         lines.extend(_swap_lines(swaps))
+        # a prefill-role pool member never polls: its whole story is the
+        # export stream
+        lines.extend(_kv_lines(kv_exports, kv_inserts))
         return lines
 
     # -- batch composition --------------------------------------------------
@@ -147,6 +191,9 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
 
     # -- live weight swaps ----------------------------------------------------
     lines.extend(_swap_lines(swaps))
+
+    # -- disaggregated serving (KV-slab handoff) ------------------------------
+    lines.extend(_kv_lines(kv_exports, kv_inserts))
 
     # -- prefix cache ---------------------------------------------------------
     hits = sum(p.get("prefix_hits", 0) for p in polls)

@@ -201,6 +201,21 @@ def rows_from(mt, fronts):
                if gr.get("greedy_identical") else "")
             + ("; auto-rollback in 1 interval" if rolled else ""),
         ))
+    gd = mt.get("llm_1b_disagg") or {}
+    if gd:
+        iso = gd.get("isolation") or {}
+        dd = gd.get("transfer_dedup") or {}
+        ident = gd.get("greedy_identical")
+        rows.append((
+            "generate(), disaggregated prefill/decode",
+            f"short-request TTFT p99 ratio {iso.get('disagg_ttft_p99_ratio', '—')}x "
+            f"(unified {iso.get('unified_ttft_p99_ratio', '—')}x) under "
+            f"{fmt(gd.get('long_prompt_len'))}-token injection",
+            "KV-slab handoff, loopback+TCP"
+            + ("; greedy bytes identical" if ident else "")
+            + (f"; {fmt(dd.get('kv_transfer_bytes_saved', 0))} B "
+               "transfer-deduped" if dd.get("kv_transfer_bytes_saved") else ""),
+        ))
     g1l = mt.get("llm_1b_long") or {}
     if g1l:
         mbu = f", MBU {g1l['mbu_pct']}%" if g1l.get("mbu_pct") is not None else ""
