@@ -327,20 +327,20 @@ class DeploymentController:
                     ),
                 )
             )
+        # peer-LIST assignment (not per-member round-robin): every decode
+        # member gets the FULL candidate set of prefill listeners and the
+        # engine's failover transport picks per transfer — so a prefill-
+        # pool resize shrinks/grows the candidate set instead of
+        # re-pointing (and so replacing) survivors. Decode names carry no
+        # peer port: survivors keep serving through a resize, ejecting
+        # torn-down listeners at runtime (a survivor only learns about
+        # ADDED listeners when it is next recreated — acceptable, the
+        # failover layer keeps it correct on its stale subset meanwhile).
+        peer_list = ",".join(f"127.0.0.1:{p}" for p in ports)
         for r in range(n_decode):
-            peer_port = ports[r % n_prefill]
             out.append(
                 ComponentSpec(
-                    # the assigned peer is part of the NAME: a prefill-pool
-                    # resize that re-points this decoder (round-robin over
-                    # a different listener set) renames it, so reconcile
-                    # replaces exactly the re-pointed members — a survivor
-                    # would otherwise keep dialing its creation-time peer
-                    # forever (reconcile only starts absent names)
-                    name=(
-                        f"{dep.key}/{pspec.name}/{r}/"
-                        f"engine-{h[:8]}-kv{peer_port}"
-                    ),
+                    name=f"{dep.key}/{pspec.name}/{r}/engine-{h[:8]}",
                     kind="engine",
                     deployment=dep.key,
                     predictor=pspec.name,
@@ -348,7 +348,7 @@ class DeploymentController:
                     routable=True,
                     engine_spec=pool_spec(
                         "decode",
-                        [("peer", f"127.0.0.1:{peer_port}")],
+                        [("peer", peer_list)],
                     ),
                 )
             )

@@ -90,6 +90,26 @@ class MetricsRegistry:
             ("seldon_engine_kv_transfer_bytes_saved", None),
     }
 
+    # fault tolerance: recovery counters land in first-class series so a
+    # chaotic run (supervised batcher restarts, prefill-peer ejections /
+    # readmissions, local-prefill degradation) is diagnosable straight
+    # off /metrics — the observability half of the failure-mode matrix
+    # in docs/operate.md "Failure modes & recovery"
+    _RECOVERY = {
+        "gen_batcher_restarts": "seldon_engine_batcher_restarts",
+        "gen_peer_ejections": "seldon_engine_peer_ejections",
+        "gen_peer_readmissions": "seldon_engine_peer_readmissions",
+        "gen_degraded_local_prefill":
+            "seldon_engine_degraded_local_prefill",
+    }
+
+    # first-class health gauge: 1 = the generate scheduler is serving,
+    # 0 = restarting/dead (readiness mirrors it; this is the scrapeable
+    # view an alert can watch across the fleet)
+    _RECOVERY_GAUGES = {
+        "gen_batcher_healthy": "seldon_engine_batcher_healthy",
+    }
+
     # generate SLO TIMERs (per completed request, shipped by the generate
     # server's metrics() hook) additionally land in first-class latency
     # histograms per graph node: TTFT, TPOT/inter-token latency, and
@@ -124,8 +144,14 @@ class MetricsRegistry:
                         if direction else tags
                     )
                     self.counter_inc(name, kv_tags, val)
+                recovery = self._RECOVERY.get(key)
+                if recovery is not None:
+                    self.counter_inc(recovery, tags, val)
             elif mtype == "GAUGE":
                 self.gauge_set(f"seldon_custom_{key}", val, tags)
+                rg = self._RECOVERY_GAUGES.get(key)
+                if rg is not None:
+                    self.gauge_set(rg, val, tags)
             elif mtype == "TIMER":
                 self.observe(f"seldon_custom_{key}", val / 1000.0, tags)
                 slo = self._SLO_TIMERS.get(key)

@@ -409,7 +409,12 @@ class EngineApp:
                 out = await self.predict(body, headers=req.headers)
             except UnitCallError as e:
                 hdrs = None
-                if e.status == 429:
+                if e.status in (429, 503):
+                    # 429 = shed (PR 2 contract); 503 = transient
+                    # unavailability with a known horizon — a dead/
+                    # restarting batcher (BatcherDead.retry_after_s) or
+                    # an open breaker. Both carry Retry-After so clients
+                    # back off instead of hammering a recovering member.
                     after = getattr(e, "retry_after_s", None)
                     hdrs = {"Retry-After": str(max(1, int(after + 0.5)))
                             if after else "1"}
@@ -535,7 +540,7 @@ class EngineApp:
                 body = body["jsonData"]
             try:
                 # stream() validates AND submits eagerly — malformed bodies
-                # and closed batchers 400 here, before any bytes go out
+                # and dead batchers raise here, before any bytes go out
                 handle = target.stream(body)
             except ShedError as e:
                 # admit-queue shed: same 429 + Retry-After contract as the
@@ -547,8 +552,21 @@ class EngineApp:
                     error_body(429, str(e)), 429,
                     headers={"Retry-After": str(max(1, int(e.retry_after_s + 0.5)))},
                 )
-            except (ValueError, RuntimeError) as e:
-                return Response(error_body(400, str(e)), 400)
+            except Exception as e:  # noqa: BLE001 - typed vs bad-request split
+                status = getattr(e, "status", None)
+                if status == 503:
+                    # dead/restarting batcher (BatcherDead) or a typed
+                    # transport refusal: transient — 503 + Retry-After,
+                    # exactly like the unary path, never a client-fault 400
+                    after = getattr(e, "retry_after_s", None)
+                    return Response(
+                        error_body(503, str(e)), 503,
+                        headers={"Retry-After": str(max(1, int(after + 0.5)))
+                                 if after else "1"},
+                    )
+                if isinstance(e, (ValueError, RuntimeError)):
+                    return Response(error_body(400, str(e)), 400)
+                raise
 
             # in-flight from SUBMISSION (the decode lane is already
             # occupied), not from the first pulled chunk — a rolling-update
@@ -707,6 +725,9 @@ class EngineApp:
             try:
                 handle = target.stream(body)
             except (ValueError, RuntimeError) as e:
+                if getattr(e, "status", None) == 503:
+                    # dead/restarting batcher: transient, retryable
+                    await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
                 await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
             app._inflight_add(1)
             it = iter(handle.chunks)

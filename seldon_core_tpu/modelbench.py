@@ -1940,6 +1940,241 @@ def bench_disagg(
     }
 
 
+def bench_chaos(
+    root: str,
+    n_requests: int = 6,
+    prompt_len: int = 6,
+    max_new_tokens: int = 8,
+    slots: int = 2,
+    steps_per_poll: int = 4,
+    config: Optional[Dict[str, Any]] = None,
+    deadline_s: float = 90.0,
+    seed: int = 7,
+    label: str = "llm-chaos",
+) -> Dict[str, Any]:
+    """Chaos harness for the disaggregated generate path: seeded
+    KV-transport faults (connect-refused, CRC corruption, mid-stream
+    truncation, frame drop, stall) against a two-peer prefill pool, one
+    full-pool outage (degraded local prefill), and one induced
+    scheduler poll death on the decode batcher (the supervised
+    crash-restart path).
+
+    The acceptance bits: every request that completes under chaos is
+    greedy BYTE-IDENTICAL to the fault-free run; no request outlives
+    ``deadline_s`` (hang = the one unacceptable failure mode); the
+    error rate stays bounded (a clean second peer absorbs single-peer
+    faults, local prefill absorbs pool death, so only the
+    scheduler-death window may fail in-flight work); and the recovery
+    counters — ``batcher_restarts``, ``peer_ejections``,
+    ``degraded_local_prefill`` — are all exercised. With no fault knobs
+    set the serving path is byte-identical to the plain disaggregated
+    path (off-by-default convention)."""
+    from .resilience.faults import FaultInjector, FaultRule, KVFaults
+    from .serving.disagg import PrefillTransportServer
+    from .servers.generateserver import GenerateServer
+
+    cfg = dict(config or {})
+    cfg.setdefault("max_seq", 64)
+    model_dir = write_model_dir(root, "llm", cfg)
+    vocab = cfg.get("vocab_size", 256)
+    common = dict(
+        model_uri=model_dir, steps_per_poll=steps_per_poll,
+        warmup_prompt_lens=[prompt_len],
+        warmup_max_new_tokens=max_new_tokens,
+    )
+    uni = GenerateServer(slots=slots, **common)
+    uni.load()
+    pf1 = GenerateServer(role="prefill", **common)
+    pf1.load()
+    pf2 = GenerateServer(role="prefill", **common)
+    pf2.load()
+    l1 = PrefillTransportServer(pf1, port=0)
+    l2 = PrefillTransportServer(pf2, port=0)
+    peers = f"127.0.0.1:{l1.port},127.0.0.1:{l2.port}"
+    dec = GenerateServer(
+        slots=slots, role="decode", peer=peers,
+        peer_eject_backoff_s=0.1, restart_backoff_s=0.05, **common,
+    )
+    dec.load()
+
+    rs = np.random.RandomState(13)
+    prompts = [rs.randint(1, vocab, prompt_len).tolist()
+               for _ in range(n_requests)]
+    kw = dict(max_new_tokens=max_new_tokens, temperature=0.0,
+              eos_id=None, seed=0)
+
+    def run_window(reqs: List[List[int]]) -> Dict[str, Any]:
+        """Submit ``reqs`` through the decode server; every future is
+        awaited under the hang deadline. Returns outputs (None for a
+        failed request), the typed error names, and the slowest
+        request's wall time."""
+        outs: List[Any] = []
+        errors: List[str] = []
+        slowest = 0.0
+        for p in reqs:
+            t0 = time.perf_counter()
+            try:
+                fut = dec._remote_submit(list(p), kw, deadline_s)
+                outs.append(fut.result(timeout=deadline_s))
+            except Exception as e:  # noqa: BLE001 - typed failures counted
+                outs.append(None)
+                errors.append(type(e).__name__)
+            slowest = max(slowest, time.perf_counter() - t0)
+        return {"outs": outs, "errors": errors, "slowest_s": slowest}
+
+    def rewire(rules_by_addr: Dict[str, List[FaultRule]]) -> None:
+        """Fresh failover client with the window's per-peer KV faults
+        (a fresh client resets ejection state between windows, so each
+        fault class is measured from a healthy pool)."""
+        dec._kv_client.close()
+        dec.set_peer(peers)
+        for peer in dec._kv_client.peers:
+            rules = rules_by_addr.get(peer.addr)
+            if rules:
+                peer.transport._fault = KVFaults(rules, seed, peer.addr)
+
+    addr1 = f"127.0.0.1:{l1.port}"
+    fault_classes = {
+        "connect_refused": FaultRule(kv_connect_refused_rate=1.0),
+        "corrupt": FaultRule(kv_corrupt_rate=1.0),
+        "truncate": FaultRule(kv_truncate_rate=1.0),
+        "frame_drop": FaultRule(kv_drop_rate=1.0),
+        "stall": FaultRule(kv_stall_rate=1.0, kv_stall_ms=50.0),
+    }
+
+    windows: Dict[str, Any] = {}
+    identical = True
+    total = failed = 0
+    slowest_s = 0.0
+    t_start = time.perf_counter()
+    tokens_done = 0
+    try:
+        # fault-free reference (and the PR 6 parity proof: no knobs set,
+        # plain disaggregated serving)
+        refs = [uni.batcher.generate(list(p), **kw) for p in prompts]
+        base = run_window(prompts)
+        fault_free_identical = base["outs"] == refs
+        identical &= fault_free_identical
+        slowest_s = max(slowest_s, base["slowest_s"])
+        total += len(prompts)
+        tokens_done += sum(max_new_tokens for o in base["outs"] if o)
+
+        # each KV fault class, injected on peer 1 only: the failover
+        # layer must absorb it (retry on peer 2 / eject), outputs stay
+        # byte-identical, errors stay bounded
+        for name, rule in fault_classes.items():
+            rewire({addr1: [rule]})
+            w = run_window(prompts)
+            ok = all(
+                o is None or o == r for o, r in zip(w["outs"], refs)
+            )
+            identical &= ok
+            failed += len(w["errors"])
+            total += len(prompts)
+            tokens_done += sum(max_new_tokens for o in w["outs"] if o)
+            slowest_s = max(slowest_s, w["slowest_s"])
+            windows[name] = {
+                "requests": len(prompts),
+                "errors": w["errors"],
+                "completed_identical": ok,
+                "slowest_s": round(w["slowest_s"], 3),
+            }
+
+        # full-pool outage: both peers refuse — decode must degrade to
+        # LOCAL unified prefill with zero failures, byte-identically
+        refuse = FaultRule(kv_connect_refused_rate=1.0)
+        rewire({addr1: [refuse], f"127.0.0.1:{l2.port}": [refuse]})
+        w = run_window(prompts)
+        ok = all(o == r for o, r in zip(w["outs"], refs))
+        identical &= ok
+        failed += len(w["errors"])
+        total += len(prompts)
+        tokens_done += sum(max_new_tokens for o in w["outs"] if o)
+        slowest_s = max(slowest_s, w["slowest_s"])
+        windows["pool_down"] = {
+            "requests": len(prompts),
+            "errors": w["errors"],
+            "completed_identical": ok,
+            "degraded_local_prefill":
+                dec.batcher.stats["degraded_local_prefill"],
+            "slowest_s": round(w["slowest_s"], 3),
+        }
+
+        # induced scheduler death on the decode batcher: one poll death,
+        # supervised restart, then byte-identical service. In-flight
+        # failures surface typed (BatcherDead) — counted, bounded.
+        rewire({})
+        inj = FaultInjector([], seed=seed,
+                            scheduler={"die_after_polls": 2, "times": 1})
+        dec.batcher.fault_hook = inj.scheduler_hook()
+        w = run_window(prompts)
+        # wait out the restart, then prove recovery
+        deadline = time.monotonic() + deadline_s
+        while (dec.batcher.health != "serving"
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        w2 = run_window(prompts)
+        ok = all(
+            o is None or o == r for o, r in zip(w["outs"], refs)
+        ) and w2["outs"] == refs
+        identical &= ok
+        failed += len(w["errors"]) + len(w2["errors"])
+        total += 2 * len(prompts)
+        tokens_done += sum(
+            max_new_tokens for o in w["outs"] + w2["outs"] if o
+        )
+        slowest_s = max(slowest_s, w["slowest_s"], w2["slowest_s"])
+        windows["scheduler_death"] = {
+            "requests": 2 * len(prompts),
+            "errors": w["errors"] + w2["errors"],
+            "completed_identical": ok,
+            "batcher_restarts": dec.batcher.stats["batcher_restarts"],
+            "recovered": dec.batcher.health == "serving",
+            "slowest_s": round(max(w["slowest_s"], w2["slowest_s"]), 3),
+        }
+    finally:
+        elapsed = time.perf_counter() - t_start
+        stats = dict(dec.batcher.stats)
+        l1.close()
+        l2.close()
+        for s in (uni, pf1, pf2, dec):
+            s.close()
+
+    error_rate = round(failed / max(1, total), 4)
+    return {
+        "model": label,
+        "scenario": (
+            "seeded KV-transport faults (5 classes) + full-pool outage "
+            "+ induced scheduler death; byte-identity and bounded "
+            "errors under each"
+        ),
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new_tokens,
+        "requests_total": total,
+        # the acceptance bits
+        "greedy_identical": identical,
+        "fault_free_identical": fault_free_identical,
+        "no_hang": slowest_s <= deadline_s,
+        "slowest_request_s": round(slowest_s, 3),
+        "error_rate": error_rate,
+        "errors_bounded": error_rate <= 0.25,
+        "windows": windows,
+        "recovery_counters": {
+            "batcher_restarts": stats["batcher_restarts"],
+            "peer_ejections": stats["peer_ejections"],
+            "degraded_local_prefill": stats["degraded_local_prefill"],
+            "all_exercised": bool(
+                stats["batcher_restarts"]
+                and stats["peer_ejections"]
+                and stats["degraded_local_prefill"]
+            ),
+        },
+        "tokens_per_s": round(tokens_done / max(elapsed, 1e-9), 2),
+        "p50_ms": None,
+        "p99_ms": None,
+    }
+
+
 def _ablate_generate(
     root: str,
     base_kw: Dict[str, Any],
@@ -2115,6 +2350,20 @@ def run_model_tier(
                 config={
                     "vocab_size": 256, "d_model": 32, "n_layers": 2,
                     "n_heads": 2, "n_kv_heads": 2, "d_ff": 64, "max_seq": 128,
+                },
+            )
+            # chaos proof for the disaggregated path: seeded KV-transport
+            # faults per class + full-pool outage + one induced scheduler
+            # death — greedy byte-identity for everything that completes,
+            # bounded errors, no hangs, and every recovery counter
+            # (batcher_restarts / peer_ejections / degraded_local_prefill)
+            # exercised (chip scales the same harness)
+            results["llm_1b_chaos"] = bench_chaos(
+                root, n_requests=4, prompt_len=6, max_new_tokens=8,
+                slots=2, steps_per_poll=4,
+                config={
+                    "vocab_size": 256, "d_model": 32, "n_layers": 2,
+                    "n_heads": 2, "n_kv_heads": 2, "d_ff": 64, "max_seq": 64,
                 },
             )
         else:
@@ -2443,6 +2692,17 @@ def run_model_tier(
                 long_prompt_len=1792, system_len=384, max_new_tokens=64,
                 slots=8, steps_per_poll=16, n_shared=8,
                 config={**big_cfg, "max_seq": 2048},
+            )
+            # chaos at flagship scale: the same fault classes + induced
+            # scheduler death against the 1.26B disaggregated stack —
+            # recovery costs (restart re-warm, failover retries) are paid
+            # at real model size, byte-identity and bounded errors still
+            # required
+            results["llm_1b_chaos"] = bench_chaos(
+                root, label="llm-1.26b-chaos",
+                n_requests=4, prompt_len=128, max_new_tokens=32,
+                slots=4, steps_per_poll=16,
+                config={**big_cfg, "max_seq": 256},
             )
             # long-context serving, small decoder: the fast-step regime
             # where the per-burst host sync is the enemy — spp 32 buys a

@@ -30,7 +30,13 @@ from .deadline import (  # noqa: F401
     deadline_s_from_meta,
     stamp_meta,
 )
-from .faults import FaultInjector, FaultRule, FaultyClient, InjectedFault  # noqa: F401
+from .faults import (  # noqa: F401
+    FaultInjector,
+    FaultRule,
+    FaultyClient,
+    InjectedFault,
+    KVFaults,
+)
 from .policy import (  # noqa: F401
     HedgePolicy,
     IDEMPOTENT_METHODS,

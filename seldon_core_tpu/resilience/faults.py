@@ -21,8 +21,32 @@ Rule fields (all optional):
   hang_rate     probability of hanging for hang_s (default 3600 — only a
                 deadline or transport timeout gets the caller out)
 
+KV-transport faults (the chaos harness for the disaggregated path):
+rules carrying any ``kv_*`` field target the prefill/decode KV-slab
+transport instead of a unit client. ``unit`` then matches the PEER —
+``"*"``, ``"kv:*"``, ``"kv:<host:port>"`` or the bare ``host:port`` —
+and the fault perturbs the byte stream itself, so the REAL codec
+refusals (ChecksumError / TruncatedStream / connect-refused handling)
+and the decode server's peer ejection + failover are what recovery
+exercises:
+
+  kv_connect_refused_rate   refuse the connection before dialing
+  kv_corrupt_rate           flip one byte mid-stream (CRC refusal)
+  kv_truncate_rate          end the stream early (TruncatedStream)
+  kv_drop_rate              drop a byte span mid-stream (framing shifts
+                            -> checksum/length refusal downstream)
+  kv_stall_rate / kv_stall_ms
+                            stall the transfer before the first read
+
+Scheduler faults: a top-level ``scheduler`` section induces poll death
+in the continuous batcher's loop (the supervised crash-restart path):
+``{"scheduler": {"die_after_polls": 50, "times": 1}}`` — the loop
+raises on the Nth poll (``times`` deaths max, spaced ``die_after_polls``
+apart), exercising BatcherDead + rebuild end to end.
+
 Env wiring: ``SELDON_FAULTS`` holds the JSON config
-(``{"seed": 7, "rules": [{...}]}``) or ``@/path/to/faults.json``.
+(``{"seed": 7, "rules": [{...}], "scheduler": {...}}``) or
+``@/path/to/faults.json``. See docs/operate.md "Resilience".
 """
 
 from __future__ import annotations
@@ -32,6 +56,7 @@ import dataclasses
 import json
 import os
 import random
+import threading
 from typing import Dict, List, Optional, Tuple
 
 
@@ -57,17 +82,38 @@ class FaultRule:
     jitter_ms: float = 0.0
     hang_rate: float = 0.0
     hang_s: float = 3600.0
+    # -- KV-transport faults (see module docstring grammar) ------------
+    kv_connect_refused_rate: float = 0.0
+    kv_corrupt_rate: float = 0.0
+    kv_truncate_rate: float = 0.0
+    kv_drop_rate: float = 0.0
+    kv_stall_rate: float = 0.0
+    kv_stall_ms: float = 0.0
+
+    KV_FIELDS = (
+        "kv_connect_refused_rate", "kv_corrupt_rate", "kv_truncate_rate",
+        "kv_drop_rate", "kv_stall_rate",
+    )
 
     def matches(self, unit: str, method: str) -> bool:
         return self.unit in ("*", unit) and self.method in ("*", method)
 
+    def has_kv_faults(self) -> bool:
+        return any(getattr(self, f) for f in self.KV_FIELDS)
+
+    def matches_peer(self, addr: str) -> bool:
+        return self.unit in ("*", "kv:*", f"kv:{addr}", addr)
+
 
 class FaultInjector:
-    def __init__(self, rules, seed: int = 0):
+    def __init__(self, rules, seed: int = 0, scheduler=None):
         self.seed = int(seed)
         self.rules: List[FaultRule] = [
             r if isinstance(r, FaultRule) else FaultRule(**r) for r in rules
         ]
+        # scheduler-level induced poll death: {"die_after_polls": N,
+        # "times": M} — wired onto ContinuousBatcher.fault_hook
+        self.scheduler = dict(scheduler or {})
         self._rngs: Dict[Tuple[str, str], random.Random] = {}
         self._calls: Dict[Tuple[str, str], int] = {}
         # observability for tests/bench: what actually got injected
@@ -82,7 +128,11 @@ class FaultInjector:
             with open(blob[1:]) as f:
                 blob = f.read()
         cfg = json.loads(blob)
-        return cls(cfg.get("rules") or [], seed=cfg.get("seed", 0))
+        return cls(
+            cfg.get("rules") or [],
+            seed=cfg.get("seed", 0),
+            scheduler=cfg.get("scheduler"),
+        )
 
     def _rng(self, unit: str, method: str) -> random.Random:
         key = (unit, method)
@@ -135,6 +185,159 @@ class FaultInjector:
                     f"injected fault: {unit}.{method} "
                     f"(error_rate={rule.error_rate})",
                 )
+
+    # -- KV transport + scheduler targets (the disaggregated-path chaos
+    # harness; unit-client faults above are untouched) ------------------
+
+    def kv_faults_for(self, addr: str) -> Optional["KVFaults"]:
+        """Per-peer KV-transport fault hook for ``addr`` (``host:port``
+        or a loopback label), or None when no kv rule targets it. Each
+        peer gets its own seeded stream so a schedule is reproducible
+        regardless of which peers a decode pool dials."""
+        rules = [
+            r for r in self.rules
+            if r.has_kv_faults() and r.matches_peer(addr)
+        ]
+        if not rules:
+            return None
+        return KVFaults(rules, self.seed, addr)
+
+    def scheduler_hook(self):
+        """Poll-death hook for ContinuousBatcher.fault_hook, or None
+        when no scheduler section is configured. Raises InjectedFault on
+        the configured poll count — ``times`` deaths max, spaced
+        ``die_after_polls`` polls apart (poll counts are cumulative
+        across restarts, so a restarted loop is not instantly re-killed
+        mid-warmup)."""
+        after = int(self.scheduler.get("die_after_polls", 0))
+        if after <= 0:
+            return None
+        times = int(self.scheduler.get("times", 1))
+        state = {"deaths": 0, "last": 0}
+
+        def hook(poll_count: int) -> None:
+            if state["deaths"] >= times:
+                return
+            if poll_count - state["last"] >= after:
+                state["deaths"] += 1
+                state["last"] = poll_count
+                self.injected["errors"] += 1
+                raise InjectedFault(
+                    503,
+                    f"injected scheduler poll death "
+                    f"{state['deaths']}/{times} at poll {poll_count}",
+                )
+
+        return hook
+
+
+class KVFaults:
+    """Deterministic byte-level faults for ONE KV-transport peer.
+
+    The transports call :meth:`before_connect` ahead of dialing (refuse /
+    stall live here) and wrap their ``recv``-style reader with
+    :meth:`wrap_read`, which draws a per-transfer fault plan (corrupt /
+    truncate / drop at a drawn byte offset) from the peer's seeded
+    stream. Faults land in the RAW byte stream, so what recovery
+    exercises is the genuine codec refusal — ChecksumError,
+    TruncatedStream, a framing-shift DisaggError — not a synthetic
+    exception."""
+
+    def __init__(self, rules: List[FaultRule], seed: int, addr: str):
+        self.rules = rules
+        self.addr = addr
+        self._rng = random.Random(f"{seed}/kv/{addr}")
+        self._lock = threading.Lock()
+        self.injected = {
+            "connect_refused": 0, "corrupt": 0, "truncate": 0,
+            "drop": 0, "stalls": 0,
+        }
+
+    def _draw(self) -> float:
+        with self._lock:
+            return self._rng.random()
+
+    def _offset(self, lo: int, hi: int) -> int:
+        with self._lock:
+            return self._rng.randrange(lo, hi)
+
+    def connectable(self) -> bool:
+        """Probe-path view of connect faults: a peer whose connections
+        are being refused must also probe unhealthy, or the failover
+        layer would readmit it just to eject it again."""
+        for r in self.rules:
+            if r.kv_connect_refused_rate and (
+                self._draw() < r.kv_connect_refused_rate
+            ):
+                return False
+        return True
+
+    def before_connect(self) -> None:
+        import time as _time
+
+        for r in self.rules:
+            if r.kv_connect_refused_rate and (
+                self._draw() < r.kv_connect_refused_rate
+            ):
+                self.injected["connect_refused"] += 1
+                raise ConnectionRefusedError(
+                    f"injected: kv connect refused ({self.addr})"
+                )
+            if r.kv_stall_rate and self._draw() < r.kv_stall_rate:
+                self.injected["stalls"] += 1
+                _time.sleep(max(0.0, r.kv_stall_ms) / 1000.0)
+
+    def wrap_read(self, read):
+        """Wrap a ``recv``-style reader with this transfer's drawn fault
+        plan; returns ``read`` unchanged when no byte fault fires (zero
+        overhead off the fault path). Offsets are drawn small enough to
+        land inside any real slab stream (header alone is ~300 bytes)."""
+        corrupt_at = truncate_at = drop_at = None
+        for r in self.rules:
+            if (corrupt_at is None and r.kv_corrupt_rate
+                    and self._draw() < r.kv_corrupt_rate):
+                corrupt_at = self._offset(32, 2048)
+            if (truncate_at is None and r.kv_truncate_rate
+                    and self._draw() < r.kv_truncate_rate):
+                truncate_at = self._offset(32, 4096)
+            if (drop_at is None and r.kv_drop_rate
+                    and self._draw() < r.kv_drop_rate):
+                drop_at = self._offset(32, 2048)
+        if corrupt_at is None and truncate_at is None and drop_at is None:
+            return read
+        state = {"seen": 0, "corrupted": False, "dropped": False,
+                 "truncated": False}
+
+        def faulty(n: int) -> bytes:
+            if truncate_at is not None and state["seen"] >= truncate_at:
+                if not state["truncated"]:
+                    state["truncated"] = True
+                    self.injected["truncate"] += 1
+                return b""
+            b = read(n)
+            if not b:
+                return b
+            start = state["seen"]
+            state["seen"] += len(b)
+            if (corrupt_at is not None and not state["corrupted"]
+                    and start <= corrupt_at < state["seen"]):
+                state["corrupted"] = True
+                self.injected["corrupt"] += 1
+                buf = bytearray(b)
+                buf[corrupt_at - start] ^= 0xFF
+                b = bytes(buf)
+            if (drop_at is not None and not state["dropped"]
+                    and start <= drop_at < state["seen"]):
+                # drop up to 64 bytes mid-stream: every later frame
+                # misaligns, so the codec refuses on length/CRC
+                state["dropped"] = True
+                self.injected["drop"] += 1
+                at = drop_at - start
+                b = b[:at] + b[at + 64:]
+                state["seen"] = start + len(b)
+            return b
+
+        return faulty
 
 
 class FaultyClient:

@@ -65,15 +65,32 @@ Model URI layout: same ``jax_config.json`` as jaxserver with
                      no scheduler loop) | ``decode`` (pull prefilled
                      slabs from ``peer`` and run decode-only lanes).
                      See docs/generate.md "Disaggregated serving"
-    peer             decode role: the prefill pool's KV endpoint as
-                     ``host:port`` (TCP transport); tests/benches may
-                     instead wire a live prefill GenerateServer object
-                     via ``set_peer()`` (loopback transport — same
-                     codec, in memory)
+    peer             decode role: the prefill pool's KV endpoints as a
+                     ``host:port`` LIST (comma-separated string) — peers
+                     are health-probed, ejected with backoff on transfer
+                     failure, readmitted on probe success, and a failed
+                     transfer retries once on the next healthy peer;
+                     with the whole pool ejected, decode degrades to
+                     LOCAL unified prefill (``degraded_local_prefill``
+                     counts the regression). Tests/benches may instead
+                     wire live prefill GenerateServer objects via
+                     ``set_peer()`` (loopback transport — same codec,
+                     in memory)
     kv_port          prefill role: TCP port the KV export listener
                      binds (0 = loopback-only, no listener)
     kv_chunk_bytes   KV transport write granularity — the sender-side
                      in-flight bound per slab stream (default 1 MiB)
+    peer_eject_backoff_s
+                     decode role: initial per-peer re-probe backoff
+                     after a transfer failure (exponential, capped 30s;
+                     default 1.0)
+    restart_budget   scheduler supervision: how many times a dead
+                     batcher loop may rebuild (fresh cache + re-warm)
+                     before the member latches unready for replacement
+                     (default 3); see docs/operate.md "Failure modes"
+    restart_backoff_s
+                     initial crash-restart backoff (exponential,
+                     default 0.5)
 
 Request (jsonData)::
 
@@ -143,6 +160,9 @@ class GenerateServer(SeldonComponent):
         peer: Optional[str] = None,
         kv_port: int = 0,
         kv_chunk_bytes: int = 1 << 20,
+        peer_eject_backoff_s: float = 1.0,
+        restart_budget: int = 3,
+        restart_backoff_s: float = 0.5,
         warmup_prompt_lens: Optional[Sequence[int]] = None,
         warmup_max_new_tokens: int = 0,
         **kwargs,
@@ -157,8 +177,12 @@ class GenerateServer(SeldonComponent):
         self._peer = peer or None
         self._kv_port = int(kv_port)
         self._kv_chunk_bytes = int(kv_chunk_bytes)
+        self._peer_eject_backoff_s = float(peer_eject_backoff_s)
+        self._restart_budget = int(restart_budget)
+        self._restart_backoff_s = float(restart_backoff_s)
         self._kv_server = None   # PrefillTransportServer (prefill role)
-        self._kv_client = None   # LoopbackTransport | TcpKVClient (decode)
+        self._kv_client = None   # FailoverKVClient over the peer list (decode)
+        self._faults = None      # FaultInjector (chaos harness), set at load
         if role != "unified" and int(speculate_tokens) > 0:
             raise ValueError(
                 "disaggregated roles do not support speculative decoding "
@@ -298,7 +322,19 @@ class GenerateServer(SeldonComponent):
             depth_group_split_bytes=self._depth_group_split_bytes,
             prefill_chunk=self._prefill_chunk,
             flight_recorder_capacity=self._flight_recorder,
+            restart_budget=self._restart_budget,
+            restart_backoff_s=self._restart_backoff_s,
         )
+        # chaos harness (off without SELDON_FAULTS): the scheduler
+        # section wires induced poll death onto the batcher's fault hook;
+        # kv rules are resolved per peer when transports are built below
+        from ..resilience import FaultInjector
+
+        self._faults = FaultInjector.from_env()
+        if self._faults is not None:
+            hook = self._faults.scheduler_hook()
+            if hook is not None:
+                self.batcher.fault_hook = hook
         if self._warmup_prompt_lens:
             # compile-before-listen: every prefill/insert/burst variant the
             # declared traffic shape needs is built here, so the first
@@ -324,11 +360,7 @@ class GenerateServer(SeldonComponent):
         else:
             self.batcher.start()
         if self._role == "decode" and self._peer is not None:
-            from ..serving.disagg import make_transport
-
-            self._kv_client = make_transport(
-                self._peer, chunk_bytes=self._kv_chunk_bytes
-            )
+            self._kv_client = self._build_failover(self._peer)
         logger.info(
             "generateserver: %s ready (role=%s, slots=%d, max_seq=%d)",
             self.model_uri, self._role, self._slots, self.batcher.max_seq,
@@ -368,18 +400,63 @@ class GenerateServer(SeldonComponent):
 
     # -- disaggregated serving (prefill/decode pools) ----------------------
 
-    def set_peer(self, prefill_server) -> None:
-        """Wire a decode-role server to its prefill peer: a live
-        GenerateServer/handler object (loopback transport — the slab
-        still round-trips the full wire codec in memory) or a
-        ``host:port`` string (TCP)."""
-        from ..serving.disagg import make_transport
+    def _note_peer_event(self, kind: str, addr: str, reason: str = "") -> None:
+        """Counter + flight-record hook for the failover transport's
+        eject/readmit decisions — the observable half of the peer
+        failover contract (seldon_engine_peer_ejections, ``peer_ejected``
+        flight records)."""
+        b = self.batcher
+        if b is None:
+            return
+        key = "peer_ejections" if kind == "peer_ejected" else "peer_readmissions"
+        with b._export_lock:
+            b.stats[key] += 1
+        if b.flight is not None and b.flight.enabled:
+            rec = {"type": kind, "peer": addr}
+            if reason:
+                rec["reason"] = reason
+            b.flight.record(rec)
 
+    def _build_failover(self, peers):
+        """Decode role: the peer LIST (comma-separated ``host:port``
+        string, a single live server object, or a sequence of either)
+        becomes one FailoverKVClient with this server's ejection
+        telemetry and per-peer chaos faults wired in."""
+        from ..serving.disagg import make_failover
+
+        injector = self._faults
+        return make_failover(
+            peers,
+            chunk_bytes=self._kv_chunk_bytes,
+            fault_for=(
+                injector.kv_faults_for if injector is not None else None
+            ),
+            eject_backoff_s=self._peer_eject_backoff_s,
+            on_eject=lambda addr, reason: self._note_peer_event(
+                "peer_ejected", addr, reason
+            ),
+            on_readmit=lambda addr: self._note_peer_event(
+                "peer_readmitted", addr
+            ),
+        )
+
+    def set_peer(self, prefill_server) -> None:
+        """Wire a decode-role server to its prefill peer(s): a live
+        GenerateServer/handler object (loopback transport — the slab
+        still round-trips the full wire codec in memory), a
+        ``host:port`` string (TCP; comma-separated for a list), or a
+        sequence of either. Always wrapped in the failover layer, so
+        single-peer and multi-peer decode pools share one ejection/
+        degradation contract."""
         if self._role != "decode":
             raise RuntimeError(f"set_peer on a {self._role}-role server")
-        self._kv_client = make_transport(
-            prefill_server, chunk_bytes=self._kv_chunk_bytes
-        )
+        self._kv_client = self._build_failover(prefill_server)
+
+    def kv_ping(self) -> bool:
+        """Loopback health probe target (the in-process twin of the TCP
+        listener's ``{"ping": true}`` frame): True while this server's
+        batcher can still serve prefill exports."""
+        return self.batcher is not None and self.batcher.health == "serving"
 
     def prefill_export(self, request: Dict[str, Any]):
         """PREFILL-side transport handler: run the prompt forward and
@@ -404,8 +481,13 @@ class GenerateServer(SeldonComponent):
                        on_tokens=None):
         """Decode-role submit: consult the local radix cache for the
         transfer-dedup base, pull the (suffix-only when possible) slab
-        from the prefill peer under a ``gen.kv_transfer`` span, and
-        queue it as a remote lane insert."""
+        from the prefill pool under a ``gen.kv_transfer`` span, and
+        queue it as a remote lane insert. With the ENTIRE prefill pool
+        ejected, degrade gracefully to local unified prefill — the
+        batcher owns the full prefill path and its warmed executables,
+        so greedy output stays byte-identical while
+        ``degraded_local_prefill`` makes the regression visible."""
+        from ..serving.disagg import AllPeersDown
         from ..tracing import get_tracer
 
         if self._kv_client is None:
@@ -425,15 +507,45 @@ class GenerateServer(SeldonComponent):
             "covered_len": int(covered),
             **kw,
         }
-        with get_tracer().span(
-            "gen.kv_transfer",
-            tags={"covered_len": int(covered), "tokens": len(toks),
-                  "transport": self._kv_client.name},
-        ):
-            meta, slab = self._kv_client.prefill(request, deadline_s=deadline_s)
+        try:
+            with get_tracer().span(
+                "gen.kv_transfer",
+                tags={"covered_len": int(covered), "tokens": len(toks),
+                      "transport": self._kv_client.name},
+            ):
+                meta, slab = self._kv_client.prefill(
+                    request, deadline_s=deadline_s
+                )
+        except AllPeersDown as e:
+            return self._local_prefill_fallback(
+                toks, kw, deadline_s, on_tokens, str(e)
+            )
         return self.batcher.admit_remote(
             slab, meta, on_tokens=on_tokens, deadline_s=deadline_s
         )
+
+    def _local_prefill_fallback(self, toks, kw, deadline_s, on_tokens,
+                                reason: str):
+        """The whole prefill pool is ejected: serve the prompt with a
+        LOCAL unified prefill instead of failing the request. Counted
+        (``degraded_local_prefill``) and flight-recorded so the
+        regression is visible on dashboards while the failover layer
+        keeps probing the pool back in."""
+        b = self.batcher
+        with b._export_lock:
+            b.stats["degraded_local_prefill"] += 1
+        if b.flight is not None and b.flight.enabled:
+            b.flight.record({
+                "type": "degraded_local_prefill",
+                "tokens": len(toks),
+                "reason": reason,
+            })
+        logger.warning(
+            "prefill pool fully ejected (%s); serving %d-token prompt "
+            "with local unified prefill", reason, len(toks),
+        )
+        return b.submit(toks, deadline_s=deadline_s, on_tokens=on_tokens,
+                        **kw)
 
     def _collect_results(self, futures, token_lists, kw, deadline_s,
                          expires_at, retry_prefix_gone=False):
@@ -756,6 +868,18 @@ class GenerateServer(SeldonComponent):
     def tags(self) -> Dict:
         return {"server": "generateserver"}
 
+    def health_status(self):
+        """Readiness hook (InProcessClient.ready -> GraphExecutor.ready
+        -> the engine's /ready): a batcher that is mid-crash-restart or
+        latched dead flips this unit — and with it the engine — unready,
+        so the gateway routes around the member and, once the crash-loop
+        budget is exhausted, the reconciler replaces it. A server that
+        has not loaded yet keeps the default lenient readiness."""
+        b = self.batcher
+        if b is not None and b.health != "serving":
+            raise RuntimeError(f"continuous batcher is {b.health}")
+        return "ok"
+
     def flight_dump(self, limit: Optional[int] = None) -> Optional[Dict[str, Any]]:
         """Scheduler flight-recorder export (the ``/flightrecorder`` route's
         payload): the per-poll decision ring plus the SLO reservoir summary
@@ -817,6 +941,24 @@ class GenerateServer(SeldonComponent):
             out.append(delta("gen_shed_total", s["shed"]))
         if s.get("weight_swaps"):
             out.append(delta("gen_weight_swaps", s["weight_swaps"]))
+        # fault-tolerance counters + the first-class health gauge: the
+        # engine sink maps the counters to seldon_engine_batcher_restarts
+        # / _peer_ejections / _degraded_local_prefill (engine_metrics
+        # _RECOVERY) so a chaotic run is diagnosable off /metrics alone
+        out.append({
+            "type": "GAUGE", "key": "gen_batcher_healthy",
+            "value": 1.0 if self.batcher.health == "serving" else 0.0,
+        })
+        if s.get("batcher_restarts"):
+            out.append(delta("gen_batcher_restarts", s["batcher_restarts"]))
+        if s.get("peer_ejections"):
+            out.append(delta("gen_peer_ejections", s["peer_ejections"]))
+        if s.get("peer_readmissions"):
+            out.append(delta("gen_peer_readmissions",
+                             s["peer_readmissions"]))
+        if s.get("degraded_local_prefill"):
+            out.append(delta("gen_degraded_local_prefill",
+                             s["degraded_local_prefill"]))
         if s.get("kv_exports") or s.get("kv_imports"):
             # disaggregated serving: slab/byte counters per direction plus
             # the transfer-dedup savings — engine_metrics maps these to

@@ -1,2 +1,2 @@
-from .continuous import ContinuousBatcher, GenRequest  # noqa: F401
+from .continuous import BatcherDead, ContinuousBatcher, GenRequest  # noqa: F401
 from .prefix_cache import RadixPrefixIndex  # noqa: F401

@@ -80,6 +80,24 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
+class BatcherDead(RuntimeError):
+    """The continuous batcher's scheduler loop is not serving: it died
+    (in-flight work at crash time), its crash-loop budget is exhausted
+    (latched dead until the reconciler replaces the member), or it was
+    closed. Carries the 503 wire status plus ``retry_after_s`` so the
+    engine maps it to ``503 + Retry-After`` exactly like PR 2's shed
+    path maps :class:`~..resilience.ShedError` to 429 — clients back
+    off and retry (another replica, or this one once its supervised
+    restart lands)."""
+
+    status = 503
+
+    def __init__(self, info: str, retry_after_s: float = 1.0):
+        super().__init__(info)
+        self.info = info
+        self.retry_after_s = float(retry_after_s)
+
+
 @dataclasses.dataclass
 class GenRequest:
     tokens: List[int]
@@ -212,6 +230,8 @@ class ContinuousBatcher:
         depth_group_split_bytes: Optional[int] = None,
         prefill_chunk: int = 0,
         flight_recorder_capacity: int = 512,
+        restart_budget: int = 3,
+        restart_backoff_s: float = 0.5,
     ):
         import jax
         import jax.numpy as jnp
@@ -287,17 +307,46 @@ class ContinuousBatcher:
         self._thread: Optional[threading.Thread] = None
         self._thread_lock = threading.Lock()
         self._started = threading.Event()
+        # -- scheduler supervision (crash-loop restart) -------------------
+        # a loop death no longer poisons the batcher forever: the
+        # supervisor fails in-flight work with a typed BatcherDead,
+        # rebuilds the device state (the donated cache buffers are gone),
+        # re-warms, and resumes — bounded by ``restart_budget`` restarts
+        # with exponential backoff from ``restart_backoff_s``. Exhausting
+        # the budget latches ``health = "dead"`` (readiness goes red so
+        # the reconciler replaces the member). ``health`` is a plain str
+        # written by one thread at a time: "serving" | "restarting" |
+        # "dead" | "closed".
+        self.health = "serving"
+        self.restart_budget = max(0, int(restart_budget))
+        self.restart_backoff_s = max(0.0, float(restart_backoff_s))
+        # the budget counts crashes in quick succession (a crash LOOP):
+        # after this long without a death, the counter resets — a
+        # once-a-day transient must never slowly latch a healthy member
+        self.restart_window_s = 300.0
+        self._restarts = 0
+        self._last_crash_t = 0.0
+        # chaos hook: called at the top of every scheduler poll with the
+        # running poll count; raising kills the loop, exercising the REAL
+        # crash-recovery path (resilience.faults wires it from the
+        # SELDON_FAULTS scheduler section; tests set it directly)
+        self.fault_hook: Optional[Any] = None
+        self._poll_count = 0
+        # warm() records its arguments here so a crash-restart re-runs
+        # the same precompile before resuming admissions
+        self._warm_args: Optional[Dict[str, Any]] = None
         # -- radix prefix KV cache (cross-request prompt reuse) -----------
         # device K/V slabs of completed requests' prompts, indexed by a
         # radix tree over token IDs; an admit whose prompt shares a cached
         # prefix splices the slab and prefills only the suffix. Budgeted
         # in HBM bytes (0 = off), LRU-evicted at radix-node granularity.
         self.prefix_cache_min_tokens = max(1, int(prefix_cache_min_tokens))
+        self._prefix_cache_budget = int(prefix_cache_hbm_bytes)
         self._prefix_index = None
-        if int(prefix_cache_hbm_bytes) > 0:
+        if self._prefix_cache_budget > 0:
             from .prefix_cache import RadixPrefixIndex
 
-            self._prefix_index = RadixPrefixIndex(int(prefix_cache_hbm_bytes))
+            self._prefix_index = RadixPrefixIndex(self._prefix_cache_budget)
         # spec_rounds / spec_emitted feed the acceptance-rate gauge:
         # emitted/rounds ranges 1 (nothing accepted) .. gamma+1 (all).
         # prefill_steps/prefill_tokens split device prefill work out from
@@ -331,6 +380,13 @@ class ContinuousBatcher:
             "kv_exports": 0, "kv_export_bytes": 0,
             "kv_imports": 0, "kv_import_bytes": 0,
             "kv_transfer_bytes_saved": 0,
+            # fault tolerance: supervised scheduler restarts that landed,
+            # prefill-peer ejections/readmissions (decode role — bumped by
+            # the server's failover transport), and remote prefills served
+            # LOCALLY because the entire prefill pool was ejected
+            "batcher_restarts": 0,
+            "peer_ejections": 0, "peer_readmissions": 0,
+            "degraded_local_prefill": 0,
         }
         # export_prefill runs on caller threads (the prefill transport's
         # handlers), concurrently with each other; its stat updates take
@@ -452,8 +508,11 @@ class ContinuousBatcher:
         # the cast memo pins the boot params' cast leaves; a weight swap
         # clears it so the OLD buffer actually frees once the flip lands
         self._cast_memo = cast_memo
-        cache_sharding = cache_sharding_for(model.cfg.n_kv_heads)
-        self._cache = unstack_cache(model, cache_sharding)
+        # kept as closures for the supervisor: a crash-restart reallocates
+        # the donated cache (and lane registers) through the same path the
+        # constructor used, params untouched
+        self._cache_sharding_for = cache_sharding_for
+        self._unstack_cache = unstack_cache
         self._draft_params = None
         self._draft_cache = None
         if self.speculate_tokens > 0:
@@ -461,15 +520,7 @@ class ContinuousBatcher:
             if mesh is not None:
                 dp = jax.device_put(dp, draft_model.param_sharding(mesh, dp))
             self._draft_params = dp
-            self._draft_cache = unstack_cache(
-                draft_model, cache_sharding_for(draft_model.cfg.n_kv_heads)
-            )
-        self._cur_tok = jnp.zeros((self.slots,), jnp.int32)
-        self._pos = jnp.zeros((self.slots,), jnp.int32)
-        # per-lane PRNG streams: each request's sampling is seeded by ITS
-        # seed (folded in at admit), so results are reproducible no matter
-        # which other requests share the decode batch
-        self._keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(self.slots))
+        self._alloc_device_state()
 
         # -- executables -----------------------------------------------------
 
@@ -1039,6 +1090,27 @@ class ContinuousBatcher:
                     tags={"reason": reason, "queue_depth": depth},
                 )
 
+    def _dead_error(self) -> BatcherDead:
+        """The typed refusal every entrypoint raises once the scheduler
+        is gone — BatcherDead carries retry_after_s so the engine answers
+        503 + Retry-After instead of an opaque 500."""
+        if self.health == "closed":
+            return BatcherDead("batcher is closed", retry_after_s=1.0)
+        if self.health == "dead":
+            return BatcherDead(
+                "continuous batcher died and exhausted its crash-loop "
+                "budget; this member stays unready until the control "
+                "plane replaces it",
+                retry_after_s=5.0,
+            )
+        return BatcherDead(
+            "continuous batcher died; see server log", retry_after_s=5.0
+        )
+
+    def _check_alive(self) -> None:
+        if self._stop.is_set():
+            raise self._dead_error()
+
     def submit(
         self,
         tokens: Sequence[int],
@@ -1049,8 +1121,7 @@ class ContinuousBatcher:
         on_tokens=None,
         deadline_s: Optional[float] = None,
     ) -> Future:
-        if self._stop.is_set():
-            raise RuntimeError("batcher is closed")
+        self._check_alive()
         if not len(tokens):
             raise ValueError("empty prompt")
         if len(tokens) >= self.max_seq:
@@ -1088,7 +1159,7 @@ class ContinuousBatcher:
             # the loop died between the entry check and the put: its drain
             # already ran, so nothing will ever pop this request — fail the
             # stranded queue here instead of leaving the future unresolved
-            self._drain_queue(RuntimeError("continuous batcher died; see server log"))
+            self._drain_queue(self._dead_error())
             return req.future
         self.start()
         return req.future
@@ -1142,8 +1213,7 @@ class ContinuousBatcher:
         from ..tracing import device_trace
         from .disagg import prompt_hash
 
-        if self._stop.is_set():
-            raise RuntimeError("batcher is closed")
+        self._check_alive()
         n = len(tokens)
         if not n:
             raise ValueError("empty prompt")
@@ -1280,8 +1350,7 @@ class ContinuousBatcher:
         from .disagg import DisaggError, PrefixGone, WeightVersionMismatch
         from .disagg import prompt_hash as _phash
 
-        if self._stop.is_set():
-            raise RuntimeError("batcher is closed")
+        self._check_alive()
         if self.speculate_tokens > 0:
             raise DisaggError(
                 "remote admits are not supported with speculative "
@@ -1367,9 +1436,7 @@ class ContinuousBatcher:
         req.future.gen_request = req
         self._queue.put(req)
         if self._stop.is_set():
-            self._drain_queue(
-                RuntimeError("continuous batcher died; see server log")
-            )
+            self._drain_queue(self._dead_error())
             return req.future
         self.start()
         return req.future
@@ -1399,8 +1466,7 @@ class ContinuousBatcher:
         import jax
         import jax.numpy as jnp
 
-        if self._stop.is_set():
-            raise RuntimeError("batcher is closed")
+        self._check_alive()
         if self.speculate_tokens > 0:
             raise RuntimeError(
                 "weight hot-swap is not supported with speculative decoding "
@@ -1513,15 +1579,67 @@ class ContinuousBatcher:
         if not swap.future.done():
             swap.future.set_result(swap.version)
 
+    def _alloc_device_state(self) -> None:
+        """(Re)allocate everything the scheduler loop mutates on device:
+        the unstacked per-layer KV cache (and the draft's), the per-lane
+        token/position registers, and the per-lane PRNG streams (each
+        request's sampling is seeded by ITS seed, folded in at admit, so
+        results are reproducible no matter which other requests share the
+        decode batch). Called by the constructor and by the supervisor
+        after a loop death — the donating burst executables consumed the
+        old buffers, so a restarted loop must never touch them."""
+        import jax
+        import jax.numpy as jnp
+
+        self._cache = self._unstack_cache(
+            self.model, self._cache_sharding_for(self.model.cfg.n_kv_heads)
+        )
+        if self.speculate_tokens > 0:
+            self._draft_cache = self._unstack_cache(
+                self.draft_model,
+                self._cache_sharding_for(self.draft_model.cfg.n_kv_heads),
+            )
+        self._cur_tok = jnp.zeros((self.slots,), jnp.int32)
+        self._pos = jnp.zeros((self.slots,), jnp.int32)
+        self._keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(self.slots))
+
+    def _rebuild(self) -> None:
+        """Crash recovery (scheduler thread): fresh device state + a
+        reset prefix index (its slabs referenced the invalidated cache
+        stream's world — correctness never depends on the cache, so the
+        safe reset only costs re-warming it), then the recorded ``warm()``
+        re-precompile so the restarted loop serves its first admission
+        without an XLA stall. Host-side lane bookkeeping is cleared by
+        the caller's in-flight sweep before this runs."""
+        self._active.clear()
+        self._chunked.clear()
+        self._pos_host.clear()
+        self._masks_dirty = True
+        self._active_dev = None
+        self._temps_dev = None
+        self._alloc_device_state()
+        if self._prefix_index is not None:
+            from .prefix_cache import RadixPrefixIndex
+
+            self._prefix_index = RadixPrefixIndex(self._prefix_cache_budget)
+            self._prefix_index.set_version(self.weight_version)
+            self.stats["prefix_cache_bytes"] = 0
+        if self._warm_args is not None:
+            self.warm(**self._warm_args)
+
     def start(self) -> None:
         if self._stop.is_set():
-            raise RuntimeError("batcher is closed")
+            raise BatcherDead(
+                "batcher is closed" if self.health == "closed"
+                else "continuous batcher is dead; see server log",
+                retry_after_s=5.0,
+            )
         with self._thread_lock:
             # check-then-act under a lock: two racing submits must not spawn
             # two scheduler threads over the same donated device state
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(
-                    target=self._loop, name="continuous-batcher", daemon=True
+                    target=self._run, name="continuous-batcher", daemon=True
                 )
                 self._thread.start()
         self._started.wait()
@@ -1551,6 +1669,13 @@ class ContinuousBatcher:
         import jax
         import jax.numpy as jnp
 
+        # remember the traffic shape so a supervised crash-restart can
+        # re-run the exact same precompile before resuming admissions
+        self._warm_args = {
+            "prompt_lens": tuple(prompt_lens),
+            "max_new_tokens": int(max_new_tokens),
+            "batch_sizes": tuple(batch_sizes),
+        }
         # clamp declared warmup lens to the cache length: an oversized
         # config entry warms the max_seq bucket rather than failing load()
         # with _bucket's too-long-REQUEST error (submit() still rejects
@@ -1741,11 +1866,16 @@ class ContinuousBatcher:
         self._keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(self.slots))
 
     def close(self) -> None:
+        if self.health != "dead":
+            # a dead batcher stays "dead" (its unready latch is the
+            # reconciler's replace signal); a serving/restarting one
+            # records the deliberate shutdown
+            self.health = "closed"
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
-        self._drain_queue(RuntimeError("batcher is closed"))
-        self._fail_pending_swap(RuntimeError("batcher is closed"))
+        self._drain_queue(self._dead_error())
+        self._fail_pending_swap(self._dead_error())
 
     def _fail_pending_swap(self, err: Exception) -> None:
         with self._swap_lock:
@@ -2448,19 +2578,128 @@ class ContinuousBatcher:
                 done = self._credit(s, host_toks[r, slot, : int(counts[r, slot])])
         self._check_done()
 
-    def _loop(self) -> None:
+    def _run(self) -> None:
+        """Scheduler thread entrypoint: the supervision shell around the
+        poll loop. A clean ``close()`` exits; a loop death fails in-flight
+        work with a typed :class:`BatcherDead` and — crash-loop budget
+        permitting — rebuilds the device state and resumes, so a
+        transient device/driver fault costs seconds, not a pod."""
+        self._started.set()
+        while not self._stop.is_set():
+            if not self._loop():
+                return
+
+    def _fail_inflight(self, pending, err: Exception) -> None:
+        """Fail every request the dead loop had in flight: active lanes,
+        pre-freed lanes living only in pending-burst snapshots (without
+        this sweep their callers would block forever), and chunked
+        admissions holding reserved lanes but no ``_active`` entry.
+        Queued-not-admitted requests are NOT drained here — their prompts
+        are host-side, so they survive a supervised restart and only fail
+        once the batcher latches dead."""
+        for slot in list(self._active):
+            s = self._active.pop(slot)
+            if not s.request.future.done():
+                s.request.future.set_exception(err)
+        for _mode, payload in pending:
+            snap = payload[3] if _mode == "spec" else payload[1]
+            for entry in snap.values():
+                s = entry[0]
+                if not s.request.future.done():
+                    s.request.future.set_exception(err)
+        for slot in list(self._chunked):
+            job = self._chunked.pop(slot)
+            if not job.request.future.done():
+                job.request.future.set_exception(err)
+
+    def _crash_recover(self, pending) -> bool:
+        """Supervise one loop death (scheduler thread). True = the loop
+        may resume on rebuilt device state; False = the batcher is done
+        for good — the crash-loop budget is exhausted (``health``
+        latches ``"dead"``, readiness goes red, the reconciler replaces
+        this member) or ``close()`` landed mid-backoff. A failed rebuild
+        (the device may still be sick) consumes another budget slot and
+        backs off again."""
+        while True:
+            now = time.monotonic()
+            if (self._last_crash_t
+                    and now - self._last_crash_t > self.restart_window_s):
+                self._restarts = 0  # served healthily long enough
+            self._last_crash_t = now
+            self._restarts += 1
+            attempt = self._restarts
+            exhausted = attempt > self.restart_budget
+            backoff = min(
+                self.restart_backoff_s * (2 ** (attempt - 1)), 30.0
+            )
+            if exhausted:
+                self.health = "dead"
+                err = self._dead_error()
+            else:
+                self.health = "restarting"
+                err = BatcherDead(
+                    f"continuous batcher died; restarting "
+                    f"(attempt {attempt}/{self.restart_budget})",
+                    retry_after_s=max(backoff, 0.5),
+                )
+            self._fail_inflight(pending, err)
+            pending = ()  # later iterations have nothing new in flight
+            self._fail_pending_swap(err)
+            if self.flight is not None and self.flight.enabled:
+                self.flight.record({
+                    "type": "batcher_restart",
+                    "attempt": attempt,
+                    "budget": self.restart_budget,
+                    "backoff_s": round(backoff, 3),
+                    "outcome": "latched_dead" if exhausted else "restarting",
+                })
+            if exhausted:
+                logger.error(
+                    "continuous batcher crash-loop budget exhausted after "
+                    "%d restarts; latching unready for replacement",
+                    self.restart_budget,
+                )
+                self._stop.set()
+                self._drain_queue(err)
+                return False
+            if self._stop.wait(backoff):
+                self._drain_queue(self._dead_error())
+                return False  # close() landed while backing off
+            try:
+                self._rebuild()
+            except Exception:  # noqa: BLE001 - rebuild on a sick device
+                logger.exception("batcher rebuild failed (attempt %d)", attempt)
+                continue
+            self.stats["batcher_restarts"] += 1
+            self.health = "serving"
+            logger.warning(
+                "continuous batcher restarted (%d/%d): fresh cache, prefix "
+                "index reset, executables re-warmed",
+                attempt, self.restart_budget,
+            )
+            return True
+
+    def _loop(self) -> bool:
+        """One supervised run of the poll loop. Returns False on a clean
+        ``close()`` stop, or :meth:`_crash_recover`'s verdict after a
+        loop death (True = run again on rebuilt state)."""
         import collections
 
         import jax.numpy as jnp
 
         from ..tracing import device_trace
 
-        self._started.set()
         temps = np.zeros((self.slots,), np.float32)
         # in-flight bursts, oldest first: (device tokens, lane snapshot)
         pending: "collections.deque" = collections.deque()
         try:
             while not self._stop.is_set():
+                # chaos hook: an injected poll death here exercises the
+                # REAL supervision path end to end (faults.py wires it
+                # from the SELDON_FAULTS scheduler section)
+                self._poll_count += 1
+                if self.fault_hook is not None:
+                    self.fault_hook(self._poll_count)
                 # flight recorder: counter snapshot at poll start so the
                 # poll record carries DELTAS (what this poll did), plus the
                 # decode plan captured at dispatch below. One small dict
@@ -2866,29 +3105,7 @@ class ContinuousBatcher:
                         self._process_spec_burst(*payload)
                     else:
                         self._process_burst(*payload)
-        except Exception:  # noqa: BLE001 - surface scheduler death to callers
+        except Exception:  # noqa: BLE001 - every loop death is supervised
             logger.exception("continuous batcher loop died")
-            # poison the batcher: the donated cache buffers are gone, a
-            # relaunched loop would compute on invalidated state
-            self._stop.set()
-            err = RuntimeError("continuous batcher died; see server log")
-            for slot in list(self._active):
-                s = self._active.pop(slot)
-                if not s.request.future.done():
-                    s.request.future.set_exception(err)
-            # pre-freed lanes live only in pending-burst snapshots now —
-            # without this sweep their callers would block forever
-            for _mode, payload in pending:
-                snap = payload[3] if _mode == "spec" else payload[1]
-                for entry in snap.values():
-                    s = entry[0]
-                    if not s.request.future.done():
-                        s.request.future.set_exception(err)
-            # chunked admissions hold reserved lanes but no _active entry
-            for slot in list(self._chunked):
-                job = self._chunked.pop(slot)
-                if not job.request.future.done():
-                    job.request.future.set_exception(err)
-            self._fail_pending_swap(err)
-            self._drain_queue(err)
-            raise
+            return self._crash_recover(pending)
+        return False  # clean stop via close()

@@ -97,6 +97,23 @@ class PrefixGone(DisaggError):
     was evicted before the admit; the caller re-requests a full slab."""
 
 
+class PeerBusy(DisaggError):
+    """The prefill peer shed the transfer at its capacity bound — busy,
+    not dead. The failover layer tries another peer WITHOUT ejecting
+    this one (a loaded pool must not look like a dead pool)."""
+
+    status = 503
+
+
+class AllPeersDown(DisaggError):
+    """Every configured prefill peer is currently ejected. The decode
+    server catches this and degrades to LOCAL unified prefill (the
+    batcher owns the full prefill path), counting the regression in
+    ``degraded_local_prefill``."""
+
+    status = 503
+
+
 def prompt_hash(tokens) -> str:
     return hashlib.sha256(
         np.asarray(tokens, np.int32).tobytes()
@@ -158,9 +175,10 @@ def decode_slab(
     if magic == ERR:
         (n,) = struct.unpack("<I", _read_exact(read, 4))
         err = json.loads(_read_exact(read, n))
-        cls = {"weight_version": WeightVersionMismatch}.get(
-            err.get("kind"), DisaggError
-        )
+        cls = {
+            "weight_version": WeightVersionMismatch,
+            "capacity": PeerBusy,
+        }.get(err.get("kind"), DisaggError)
         raise cls(err.get("error", "prefill peer error"))
     if magic != MAGIC:
         raise DisaggError(f"bad slab magic {magic!r} (want {MAGIC!r})")
@@ -207,10 +225,23 @@ def decode_slab(
     return meta, out
 
 
-def encode_error(err: Exception) -> bytes:
-    kind = "weight_version" if isinstance(err, WeightVersionMismatch) else "error"
+def encode_error(err: Exception, kind: Optional[str] = None) -> bytes:
+    if kind is None:
+        if isinstance(err, WeightVersionMismatch):
+            kind = "weight_version"
+        elif isinstance(err, PeerBusy):
+            kind = "capacity"
+        else:
+            kind = "error"
     body = json.dumps({"error": str(err), "kind": kind}).encode()
     return ERR + struct.pack("<I", len(body)) + body
+
+
+def encode_pong() -> bytes:
+    """Health-probe answer, riding the SKV1 error-frame path (no new
+    wire machinery): ``kind == "pong"`` never raises — the probing
+    client reads it directly."""
+    return encode_error(DisaggError("pong"), kind="pong")
 
 
 # ---------------------------------------------------------------------------
@@ -221,17 +252,25 @@ def encode_error(err: Exception) -> bytes:
 class LoopbackTransport:
     """In-process transport: a direct reference to the prefill-side
     handler, with the slab still round-tripping the full codec through a
-    memory buffer (framing bugs can't hide behind shared memory)."""
+    memory buffer (framing bugs can't hide behind shared memory).
+    ``fault`` (resilience.faults.KVFaults) perturbs the byte stream the
+    same way it perturbs the TCP reads — chaos coverage without
+    sockets."""
 
     name = "loopback"
 
-    def __init__(self, prefill_server, chunk_bytes: int = 1 << 20):
+    def __init__(self, prefill_server, chunk_bytes: int = 1 << 20,
+                 fault=None):
         self._server = prefill_server
         self._chunk = int(chunk_bytes)
+        self._fault = fault
+        self.addr = f"loopback:{id(prefill_server):x}"
 
     def prefill(
         self, request: Dict[str, Any], deadline_s: Optional[float] = None
     ) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+        if self._fault is not None:
+            self._fault.before_connect()
         buf = io.BytesIO()
         try:
             meta, slab = self._server.prefill_export(request)
@@ -240,7 +279,23 @@ class LoopbackTransport:
         except DisaggError as e:
             buf = io.BytesIO(encode_error(e))
         buf.seek(0)
-        return decode_slab(buf.read)
+        read = buf.read
+        if self._fault is not None:
+            read = self._fault.wrap_read(read)
+        return decode_slab(read)
+
+    def probe(self, timeout_s: float = 2.0) -> bool:
+        """Loopback health probe: ask the in-process prefill server's
+        ``kv_ping`` hook (False once its batcher is dead/closed)."""
+        if self._fault is not None and not self._fault.connectable():
+            return False
+        ping = getattr(self._server, "kv_ping", None)
+        if ping is None:
+            return True
+        try:
+            return bool(ping())
+        except Exception:  # noqa: BLE001 - an unhealthy peer must probe False
+            return False
 
     def close(self) -> None:
         pass
@@ -254,12 +309,15 @@ class TcpKVClient:
 
     name = "tcp"
 
-    def __init__(self, peer: str, connect_timeout_s: float = 10.0):
+    def __init__(self, peer: str, connect_timeout_s: float = 10.0,
+                 fault=None):
         host, _, port = peer.rpartition(":")
         if not host or not port.isdigit():
             raise ValueError(f"peer must be host:port, got {peer!r}")
         self.host, self.port = host, int(port)
+        self.addr = f"{self.host}:{self.port}"
         self._connect_timeout = float(connect_timeout_s)
+        self._fault = fault
 
     def prefill(
         self, request: Dict[str, Any], deadline_s: Optional[float] = None
@@ -274,6 +332,8 @@ class TcpKVClient:
             _time.monotonic() + deadline_s if deadline_s is not None else None
         )
         try:
+            if self._fault is not None:
+                self._fault.before_connect()
             sock = socket.create_connection(
                 (self.host, self.port), timeout=timeout
             )
@@ -294,6 +354,8 @@ class TcpKVClient:
                 sock.settimeout(remaining)
             return sock.recv(n)
 
+        if self._fault is not None:
+            read = self._fault.wrap_read(read)
         try:
             sock.settimeout(
                 max(0.001, expires_at - _time.monotonic())
@@ -314,6 +376,34 @@ class TcpKVClient:
                 f"kv transfer from {self.host}:{self.port} failed "
                 f"mid-stream: {e}"
             ) from e
+        finally:
+            sock.close()
+
+    def probe(self, timeout_s: float = 2.0) -> bool:
+        """Cheap KV-port health ping: one connection, one
+        ``{"ping": true}`` line, one SKV1 error-frame pong back — no
+        device work, no handler slot at the peer. True means the
+        listener is up AND answering the wire protocol (a port held by
+        a foreign process probes False)."""
+        if self._fault is not None and not self._fault.connectable():
+            return False
+        try:
+            sock = socket.create_connection(
+                (self.host, self.port), timeout=timeout_s
+            )
+        except OSError:
+            return False
+        try:
+            sock.settimeout(timeout_s)
+            sock.sendall(b'{"ping": true}\n')
+            magic = _read_exact(sock.recv, 4)
+            if magic != ERR:
+                return False
+            (n,) = struct.unpack("<I", _read_exact(sock.recv, 4))
+            body = json.loads(_read_exact(sock.recv, n))
+            return body.get("kind") == "pong"
+        except (OSError, ValueError, DisaggError):
+            return False
         finally:
             sock.close()
 
@@ -365,12 +455,39 @@ class PrefillTransportServer:
             ).start()
 
     def _handle(self, conn: socket.socket) -> None:
+        # ONE bounded read classifies the connection (a ping is a tiny
+        # single-packet line): pings are answered WITHOUT consuming a
+        # handler slot — a pool at capacity is busy, not dead, and the
+        # failover layer must be able to tell the two apart — while
+        # everything else hits the capacity check BEFORE the rest of
+        # its request uploads, preserving shed-before-work (N slow
+        # clients may not pin N threads + 8 MiB buffers each just by
+        # dribbling their request lines past an acquired slot).
+        try:
+            conn.settimeout(60.0)
+            first = conn.recv(65536)
+            if not first:
+                conn.close()
+                return
+        except Exception:  # noqa: BLE001 - one bad peer must not kill accept
+            conn.close()
+            return
+        if first.startswith(b'{"ping"'):
+            try:
+                conn.sendall(encode_pong())
+            except OSError:
+                pass
+            finally:
+                conn.close()
+            return
         if not self._slots.acquire(blocking=False):
             # prefill-side shed-before-work: reject NOW, from this
             # connection's own thread, rather than stacking device
-            # forwards and slab buffers behind the listener
+            # forwards and slab buffers behind the listener. The frame
+            # carries the capacity kind so clients see PeerBusy (retry
+            # another peer) instead of a dead-peer ejection.
             try:
-                conn.sendall(encode_error(DisaggError(
+                conn.sendall(encode_error(PeerBusy(
                     "prefill pool at capacity — retry"
                 )))
             except OSError:
@@ -379,14 +496,12 @@ class PrefillTransportServer:
                 conn.close()
             return
         try:
-            self._handle_locked(conn)
+            self._handle_locked(conn, first)
         finally:
             self._slots.release()
 
-    def _handle_locked(self, conn: socket.socket) -> None:
+    def _handle_locked(self, conn: socket.socket, line: bytes) -> None:
         try:
-            conn.settimeout(60.0)
-            line = b""
             while not line.endswith(b"\n"):
                 b = conn.recv(65536)
                 if not b:
@@ -395,6 +510,11 @@ class PrefillTransportServer:
                 if len(line) > 8 << 20:
                     raise DisaggError("oversized prefill request")
             request = json.loads(line)
+            if request.get("ping"):
+                # unusually framed ping (multi-packet / leading space):
+                # still answered, just from a slot
+                conn.sendall(encode_pong())
+                return
             try:
                 meta, slab = self._server.prefill_export(request)
             except DisaggError as e:
@@ -405,6 +525,11 @@ class PrefillTransportServer:
                 return
             for frame in encode_slab(meta, slab, self._chunk):
                 conn.sendall(frame)
+        except (ConnectionResetError, BrokenPipeError) as e:
+            # the client hung up mid-stream (deadline, corruption abort,
+            # its own failover retry) — routine under chaos, one info
+            # line; ERROR stays reserved for listener-side faults
+            logger.info("kv export client disconnected mid-stream: %s", e)
         except Exception:  # noqa: BLE001 - one bad peer must not kill accept
             logger.exception("kv export connection failed")
         finally:
@@ -419,9 +544,245 @@ class PrefillTransportServer:
         self._thread.join(timeout=5.0)
 
 
-def make_transport(peer, chunk_bytes: int = 1 << 20):
+class _PeerState:
+    """One prefill peer inside a FailoverKVClient: its transport plus
+    the ejection bookkeeping (consecutive failures drive an exponential
+    re-probe backoff; a probe success resets it)."""
+
+    __slots__ = ("transport", "addr", "healthy", "fails", "eject_until")
+
+    def __init__(self, transport):
+        self.transport = transport
+        self.addr = getattr(transport, "addr", transport.name)
+        self.healthy = True
+        self.fails = 0
+        self.eject_until = 0.0
+
+
+class FailoverKVClient:
+    """Decode-side transport over a prefill-peer LIST.
+
+    Peers are tried round-robin. A transfer failure that smells like a
+    dead peer (unreachable, truncated/corrupt stream, handler crash)
+    **ejects** that peer — it sits out an exponential backoff, then the
+    next selection **probes** it (cheap KV-port ping on the SKV1
+    error-frame path) and readmits on success. A failed transfer is
+    retried ONCE on the next healthy peer before surfacing, so one sick
+    peer costs a retry, not an error. Typed refusals that are about the
+    *request*, not the peer — :class:`WeightVersionMismatch`,
+    :class:`PrefixGone` — pass straight through, and :class:`PeerBusy`
+    (the capacity shed frame) rotates to another peer WITHOUT ejecting.
+    When every peer is ejected, :class:`AllPeersDown` surfaces so the
+    decode server can degrade to local unified prefill.
+
+    ``on_eject(addr, reason)`` / ``on_readmit(addr)`` hooks feed the
+    decode server's ``peer_ejections`` counters and ``peer_ejected``
+    flight-recorder records."""
+
+    name = "failover"
+
+    def __init__(
+        self,
+        transports,
+        eject_backoff_s: float = 1.0,
+        max_backoff_s: float = 30.0,
+        probe_timeout_s: float = 2.0,
+        on_eject: Optional[Callable[[str, str], None]] = None,
+        on_readmit: Optional[Callable[[str], None]] = None,
+    ):
+        if not transports:
+            raise ValueError("FailoverKVClient needs at least one peer")
+        self._peers = [_PeerState(t) for t in transports]
+        self._eject_backoff = float(eject_backoff_s)
+        self._max_backoff = float(max_backoff_s)
+        self._probe_timeout = float(probe_timeout_s)
+        self._on_eject = on_eject
+        self._on_readmit = on_readmit
+        self._cursor = 0
+        self._lock = threading.Lock()
+
+    @property
+    def peers(self):
+        return list(self._peers)
+
+    def healthy_count(self) -> int:
+        return sum(1 for p in self._peers if p.healthy)
+
+    def _backoff_s(self, fails: int) -> float:
+        """Exponential re-probe backoff for a peer with ``fails``
+        consecutive failures. The exponent is clamped (the backoff caps
+        at max_backoff_s anyway): a peer that stays dead for hours —
+        e.g. a stale listener in a decode survivor's peer list after a
+        prefill-pool scale-down — keeps growing ``fails``, and an
+        unclamped ``2 ** fails`` would eventually overflow float and
+        crash the request path instead of backing off."""
+        return min(
+            self._eject_backoff * (2 ** min(int(fails), 16)),
+            self._max_backoff,
+        )
+
+    def _probe_failed(self, peer: _PeerState, now: float) -> None:
+        """One failed re-probe: extend the ejection window and grow the
+        failure streak (single home for the backoff bookkeeping)."""
+        with self._lock:
+            peer.eject_until = now + self._backoff_s(peer.fails)
+            peer.fails += 1
+
+    def _eject(self, peer: _PeerState, reason: str) -> None:
+        import time as _time
+
+        with self._lock:
+            peer.fails += 1
+            backoff = self._backoff_s(peer.fails - 1)
+            peer.healthy = False
+            peer.eject_until = _time.monotonic() + backoff
+        logger.warning(
+            "prefill peer %s ejected for %.1fs (failure %d): %s",
+            peer.addr, backoff, peer.fails, reason,
+        )
+        if self._on_eject is not None:
+            try:
+                self._on_eject(peer.addr, reason)
+            except Exception:  # noqa: BLE001 - telemetry must not break failover
+                logger.exception("on_eject hook failed")
+
+    def _readmit(self, peer: _PeerState) -> None:
+        with self._lock:
+            peer.healthy = True
+            peer.fails = 0
+            peer.eject_until = 0.0
+        logger.info("prefill peer %s readmitted (probe ok)", peer.addr)
+        if self._on_readmit is not None:
+            try:
+                self._on_readmit(peer.addr)
+            except Exception:  # noqa: BLE001
+                logger.exception("on_readmit hook failed")
+
+    def probe_ejected(self) -> int:
+        """Probe every backoff-expired ejected peer now; returns how many
+        were readmitted. The selection path does this lazily per pick —
+        this entry point exists for periodic probers and tests."""
+        import time as _time
+
+        now = _time.monotonic()
+        readmitted = 0
+        for peer in self._peers:
+            if not peer.healthy and now >= peer.eject_until:
+                if peer.transport.probe(self._probe_timeout):
+                    self._readmit(peer)
+                    readmitted += 1
+                else:
+                    self._probe_failed(peer, now)
+        return readmitted
+
+    def _pick(self, exclude) -> Optional[_PeerState]:
+        """Next usable peer round-robin: healthy first; an ejected peer
+        whose backoff expired is probed and readmitted inline (the
+        "readmitted on probe success" half of the failover contract)."""
+        import time as _time
+
+        n = len(self._peers)
+        now = _time.monotonic()
+        # healthy pass
+        for i in range(n):
+            with self._lock:
+                peer = self._peers[self._cursor % n]
+                self._cursor += 1
+            if peer in exclude:
+                continue
+            if peer.healthy:
+                return peer
+            if now >= peer.eject_until:
+                if peer.transport.probe(self._probe_timeout):
+                    self._readmit(peer)
+                    return peer
+                self._probe_failed(peer, now)
+        return None
+
+    def prefill(
+        self, request: Dict[str, Any], deadline_s: Optional[float] = None
+    ) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+        tried: list = []
+        busy_err: Optional[Exception] = None
+        while len(tried) < 2:
+            peer = self._pick(exclude=tried)
+            if peer is None:
+                if busy_err is not None:
+                    raise busy_err  # every peer busy != every peer dead
+                raise AllPeersDown(
+                    f"all {len(self._peers)} prefill peers are ejected "
+                    f"({', '.join(p.addr for p in self._peers)})"
+                )
+            try:
+                out = peer.transport.prefill(request, deadline_s=deadline_s)
+            except (WeightVersionMismatch, PrefixGone):
+                raise  # about the request/version, not the peer
+            except PeerBusy as e:
+                busy_err = e
+                tried.append(peer)
+                continue
+            except ValueError:
+                raise  # malformed request: no peer would serve it
+            except Exception as e:  # noqa: BLE001 - peer-death class
+                self._eject(peer, f"{type(e).__name__}: {e}")
+                tried.append(peer)
+                continue
+            if peer.fails:
+                with self._lock:
+                    peer.fails = 0
+            return out
+        # two peers failed the SAME transfer: surface a typed error (the
+        # unary caller maps it; the decode server may still fall back
+        # locally when the pool then fully ejects)
+        if busy_err is not None:
+            raise busy_err  # capacity, not death: 503-retry semantics
+        if self.healthy_count() == 0:
+            raise AllPeersDown(
+                f"all {len(self._peers)} prefill peers are ejected "
+                f"({', '.join(p.addr for p in self._peers)})"
+            )
+        raise DisaggError(
+            f"kv transfer failed on {len(tried)} peers "
+            f"({', '.join(p.addr for p in tried)}); retry"
+        )
+
+    def close(self) -> None:
+        for peer in self._peers:
+            try:
+                peer.transport.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def make_transport(peer, chunk_bytes: int = 1 << 20, fault=None):
     """``peer`` is either a live prefill-server object (loopback) or a
     ``"host:port"`` string (TCP)."""
     if isinstance(peer, str):
-        return TcpKVClient(peer)
-    return LoopbackTransport(peer, chunk_bytes=chunk_bytes)
+        return TcpKVClient(peer, fault=fault)
+    return LoopbackTransport(peer, chunk_bytes=chunk_bytes, fault=fault)
+
+
+def make_failover(
+    peers,
+    chunk_bytes: int = 1 << 20,
+    fault_for: Optional[Callable[[str], Any]] = None,
+    **failover_kw,
+):
+    """Build the decode side's transport from a peer LIST (each entry a
+    live prefill-server object or ``host:port`` string; a lone
+    ``"a:1,b:2"`` string is split). Always returns a
+    :class:`FailoverKVClient` — a single peer is just a list of one, so
+    ejection/degradation semantics are uniform across pool sizes.
+    ``fault_for(addr)`` resolves the chaos injector's per-peer KV fault
+    hook (None = no faults)."""
+    if isinstance(peers, str):
+        peers = [p.strip() for p in peers.split(",") if p.strip()]
+    elif not isinstance(peers, (list, tuple)):
+        peers = [peers]
+    transports = []
+    for p in peers:
+        addr = p if isinstance(p, str) else f"loopback:{id(p):x}"
+        fault = fault_for(addr) if fault_for is not None else None
+        transports.append(make_transport(p, chunk_bytes=chunk_bytes,
+                                         fault=fault))
+    return FailoverKVClient(transports, **failover_kw)

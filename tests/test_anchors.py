@@ -175,3 +175,23 @@ def test_bench_rollout_scenario_anchor():
     assert hasattr(modelbench, "bench_rollout")
     gen_src = open(os.path.join(root, "tools", "gen_arch_numbers.py")).read()
     assert "llm_1b_rollout" in gen_src
+
+
+def test_bench_chaos_scenario_anchor():
+    """The ``llm_1b_chaos`` bench scenario is an acceptance artifact
+    (greedy byte-identity of every completed request under seeded
+    KV-transport faults + one induced scheduler death, the no-hang
+    bound, and the exercised recovery counters are read from its
+    entry): it must stay wired through BOTH model tiers, and the
+    numbers-table generator must know its key."""
+    import seldon_core_tpu.modelbench as modelbench
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    mb_src = open(modelbench.__file__).read()
+    assert mb_src.count('results["llm_1b_chaos"]') >= 2  # tiny + chip
+    assert hasattr(modelbench, "bench_chaos")
+    # the entry asserts the acceptance bits like prior scenarios
+    assert '"greedy_identical": identical' in mb_src
+    assert '"no_hang"' in mb_src
+    gen_src = open(os.path.join(root, "tools", "gen_arch_numbers.py")).read()
+    assert "llm_1b_chaos" in gen_src

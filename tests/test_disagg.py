@@ -629,6 +629,15 @@ def test_reconciler_splits_pools_and_scales_independently(model_dir):
             "temperature": 0.0,
         }})
         assert len(out["jsonData"]["tokens"][0]) == 4 + 6
+        # decode members are wired with the FULL peer candidate list
+        # (failover transport), not one round-robin pick
+        dec_handle = ctl.components[decode[0]][0]
+        dec_spec = dec_handle.spec.engine_spec
+        peer_param = next(
+            p["value"] for p in dec_spec["graph"]["parameters"]
+            if p["name"] == "peer"
+        )
+        assert len(peer_param.split(",")) == 1  # one prefill listener
         # scale the decode pool only: the prefill member AND the existing
         # decode members survive by name (no restarts)
         d2, _ = store.apply(dep(decode=3))
@@ -637,17 +646,27 @@ def test_reconciler_splits_pools_and_scales_independently(model_dir):
         assert [n for n in names2 if "/pf0/" in n] == prefill
         assert set(decode) <= set(names2)
         assert len([n for n in names2 if "/pf" not in n]) == 3
-        # resize the PREFILL pool: decode members whose round-robin peer
-        # assignment changed are renamed (and so re-pointed); decoder 0
-        # keeps peer ports[0] and survives untouched
-        d3, _ = store.apply(dep(prefill=2, decode=3))
+        # resize the PREFILL pool: the candidate set grows/shrinks but NO
+        # decode survivor is renamed or re-pointed — the failover layer
+        # owns peer selection at runtime, so a resize never restarts the
+        # decode pool (new members pick up the full current list)
+        d3, _ = store.apply(dep(prefill=2, decode=4))
         await ctl.reconcile(d3.clone())
         names3 = sorted(ctl.components)
         assert len([n for n in names3 if "/pf" in n]) == 2
         decode3 = [n for n in names3 if "/pf" not in n]
-        assert len(decode3) == 3
-        assert decode[0] in names3          # unchanged assignment survives
-        assert decode[1] not in names3      # re-pointed member replaced
+        assert len(decode3) == 4
+        assert set(decode) <= set(names3)   # every survivor keeps its name
+        # the member created in THIS reconcile (replica 3); replica 2 was
+        # created under d2's single-listener world and keeps its list
+        new_member = sorted(set(decode3) - set(decode))[-1]
+        new_peers = next(
+            p["value"]
+            for p in ctl.components[new_member][0].spec.engine_spec[
+                "graph"]["parameters"]
+            if p["name"] == "peer"
+        )
+        assert len(new_peers.split(",")) == 2  # new member sees BOTH
         # every decode member still answers through the handoff
         out3 = await ctl.components[decode3[1]][0].app.predict({"jsonData": {
             "prompt_tokens": [[5, 6, 7, 8]], "max_new_tokens": 6,

@@ -440,13 +440,18 @@ def test_cancelled_request_frees_its_lane(model_and_params):
 
 
 def test_scheduler_death_fails_all_waiters(model_and_params):
-    """A device fault mid-burst must fail every in-flight AND queued
-    request promptly (not hang futures), poison the batcher, and reject
-    later submits — the donated cache is gone, a silent relaunch would
-    compute on invalidated buffers."""
+    """A PERSISTENT device fault mid-burst fails every in-flight request
+    promptly (not hanging futures) with the typed BatcherDead, burns the
+    crash-loop budget (each supervised restart rebuilds the donated
+    cache, re-crashes) and then latches the batcher dead: health flips,
+    ``_stop`` sets, and later submits refuse up front with the typed
+    budget-exhausted error the reconciler's replace path keys off."""
+    from seldon_core_tpu.serving.continuous import BatcherDead
+
     model, params = model_and_params
     b = ContinuousBatcher(
-        model, params, slots=2, max_seq=64, prefill_buckets=(8,), steps_per_poll=2
+        model, params, slots=2, max_seq=64, prefill_buckets=(8,),
+        steps_per_poll=2, restart_budget=1, restart_backoff_s=0.05,
     )
     try:
         b.generate([1, 2], max_new_tokens=2)  # warm, loop running
@@ -455,9 +460,9 @@ def test_scheduler_death_fails_all_waiters(model_and_params):
             raise RuntimeError("synthetic device fault")
 
         b._burst_fn = boom
-        # the scheduler may die (and poison the batcher) while we are
-        # still submitting — a late submit is then ALLOWED to raise
-        # directly instead of returning a doomed future
+        # the scheduler may die (and latch dead) while we are still
+        # submitting — a late submit is then ALLOWED to raise directly
+        # instead of returning a doomed future
         futures = []
         for _ in range(4):
             try:
@@ -465,16 +470,18 @@ def test_scheduler_death_fails_all_waiters(model_and_params):
             except RuntimeError as e:
                 assert "closed" in str(e) or "died" in str(e)
         for f in futures:
-            with pytest.raises(RuntimeError, match="batcher died|closed"):
-                f.result(timeout=30)
-        # poisoned for good: later submits are rejected up front
-        for _ in range(100):
+            with pytest.raises(RuntimeError, match="batcher died|died|closed"):
+                f.result(timeout=60)
+        # budget exhausted: latched dead for good, typed refusals up front
+        for _ in range(200):
             if b._stop.is_set():
                 break
             import time as _time
 
             _time.sleep(0.05)
-        with pytest.raises(RuntimeError, match="closed"):
+        assert b.health == "dead"
+        assert b.stats["batcher_restarts"] == 1  # one rebuild landed first
+        with pytest.raises(BatcherDead, match="crash-loop"):
             b.submit([1, 2, 3])
     finally:
         b.close()

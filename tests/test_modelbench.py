@@ -84,6 +84,19 @@ def test_model_tier_tiny_end_to_end():
     assert dg["isolation"]["disagg_injected"]["long_injected"] > 0
     assert dg["transfer_dedup"]["kv_transfer_bytes_saved"] > 0
     assert any(h > 0 for h in dg["transfer_dedup"]["cache_hit_tokens"])
+    # chaos harness: every completed request byte-identical under every
+    # seeded fault class + the induced scheduler death, bounded errors,
+    # no hangs, and all three recovery counters exercised
+    ch = results["llm_1b_chaos"]
+    assert ch["greedy_identical"] is True
+    assert ch["fault_free_identical"] is True
+    assert ch["no_hang"] is True
+    assert ch["errors_bounded"] is True
+    assert ch["recovery_counters"]["all_exercised"] is True
+    assert ch["windows"]["scheduler_death"]["recovered"] is True
+    for w in ("connect_refused", "corrupt", "truncate", "frame_drop",
+              "stall", "pool_down"):
+        assert ch["windows"][w]["completed_identical"] is True, w
     # CPU has no published peak -> MFU is None there; on TPU it's a number
     mfu = results["resnet50_rest"]["mfu_pct"]
     assert mfu is None or 0 < mfu < 100

@@ -1,0 +1,191 @@
+#!/usr/bin/env python3
+"""CI smoke for the fault-tolerant disaggregated generate path.
+
+Boots a two-listener prefill pool and a decode engine over the chunked
+TCP transport with the **SELDON_FAULTS env grammar** driving the chaos:
+seeded KV-transport faults on both peers (CRC corruption on one,
+connect-refused on the other) plus one induced scheduler poll death on
+the decode batcher. Then asserts:
+
+* every greedy response through the chaotic decode engine is
+  byte-identical to the fault-free unified server's (failover retries
+  and local degradation absorb the faults; a transient 503 with
+  Retry-After during the supervised restart is the only tolerated
+  non-200, and the retry must succeed);
+* the recovery counters are exercised — ``peer_ejections`` from the
+  transport faults, ``batcher_restarts`` from the induced poll death,
+  and ``degraded_local_prefill`` once both listeners are torn down;
+* the ``seldon_engine_batcher_restarts`` / ``seldon_engine_peer_ejections``
+  (and ``_degraded_local_prefill`` / ``_batcher_healthy``) series land
+  in the Prometheus exposition.
+
+Run directly (``JAX_PLATFORMS=cpu python tools/chaos_smoke.py``) or from
+the CI chaos step. Exits non-zero on any failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import http.client
+
+    from seldon_core_tpu.graph.engine_metrics import REGISTRY
+    from seldon_core_tpu.modelbench import EngineHarness, write_model_dir
+    from seldon_core_tpu.serving.disagg import PrefillTransportServer
+    from seldon_core_tpu.servers.generateserver import GenerateServer
+
+    failures = []
+
+    def check(name: str, ok: bool, detail: str = ""):
+        print(f"{'ok  ' if ok else 'FAIL'} {name}" + (f": {detail}" if detail else ""))
+        if not ok:
+            failures.append(name)
+
+    with tempfile.TemporaryDirectory(prefix="chaos-smoke-") as root:
+        cfg = {"vocab_size": 256, "d_model": 32, "n_layers": 2, "n_heads": 2,
+               "n_kv_heads": 2, "d_ff": 64, "max_seq": 64}
+        model_dir = write_model_dir(root, "llm", cfg)
+        common = dict(model_uri=model_dir, steps_per_poll=4,
+                      warmup_prompt_lens=[4], warmup_max_new_tokens=6)
+
+        # reference + prefill pool load BEFORE the fault env exists:
+        # only the decode engine runs chaotic
+        unified = GenerateServer(slots=2, **common)
+        unified.load()
+        pf1 = GenerateServer(role="prefill", **common)
+        pf1.load()
+        pf2 = GenerateServer(role="prefill", **common)
+        pf2.load()
+        l1 = PrefillTransportServer(pf1, port=0)
+        l2 = PrefillTransportServer(pf2, port=0)
+
+        # the SELDON_FAULTS grammar under test: kv targets per peer +
+        # the scheduler-death section (docs/operate.md "Resilience")
+        os.environ["SELDON_FAULTS"] = json.dumps({
+            "seed": 7,
+            "rules": [
+                {"unit": f"kv:127.0.0.1:{l1.port}", "kv_corrupt_rate": 0.7},
+                {"unit": f"kv:127.0.0.1:{l2.port}",
+                 "kv_connect_refused_rate": 0.5},
+            ],
+            "scheduler": {"die_after_polls": 6, "times": 1},
+        })
+        try:
+            dec = GenerateServer(
+                slots=2, role="decode",
+                peer=f"127.0.0.1:{l1.port},127.0.0.1:{l2.port}",
+                peer_eject_backoff_s=0.2, restart_backoff_s=0.1,
+                **common,
+            )
+            dec.load()
+        finally:
+            del os.environ["SELDON_FAULTS"]
+
+        uni_h = EngineHarness(unified, name="chaos-unified").start()
+        dec_h = EngineHarness(dec, name="chaos-decode").start()
+        headers = {"Content-Type": "application/json"}
+
+        def greedy(port: int, prompt, retries: int = 4) -> dict:
+            """One greedy request; a 503 (supervised restart in flight)
+            must carry Retry-After and succeed on retry."""
+            last = None
+            for _ in range(retries):
+                conn = http.client.HTTPConnection("127.0.0.1", port)
+                conn.request("POST", "/api/v0.1/predictions", json.dumps({
+                    "jsonData": {"prompt_tokens": [list(prompt)],
+                                 "max_new_tokens": 6, "temperature": 0.0},
+                }).encode(), headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+                retry_after = resp.getheader("Retry-After")
+                conn.close()
+                if resp.status == 200:
+                    return json.loads(payload)["jsonData"]
+                last = (resp.status, retry_after, payload[:120])
+                check("503 during restart carries Retry-After",
+                      resp.status == 503 and retry_after is not None,
+                      f"status={resp.status} retry_after={retry_after}")
+                time.sleep(min(2.0, float(retry_after or 1)))
+            raise RuntimeError(f"request never succeeded: {last}")
+
+        try:
+            prompts = [[5, 6, 7, 8], [9, 10, 11], [1, 2, 3, 4, 5],
+                       [7, 7, 7, 7], [2, 4, 6, 8], [11, 12, 13]]
+            refs = [greedy(uni_h.http_port, p)["tokens"][0] for p in prompts]
+
+            # drive chaotic traffic until the induced scheduler death has
+            # fired and restarted (plus enough transfers to eject peers)
+            identical = True
+            deadline = time.monotonic() + 60.0
+            rounds = 0
+            while time.monotonic() < deadline:
+                for p, r in zip(prompts, refs):
+                    got = greedy(dec_h.http_port, p)["tokens"][0]
+                    if got != r:
+                        identical = False
+                rounds += 1
+                if (dec.batcher.stats["batcher_restarts"] >= 1
+                        and dec.batcher.stats["peer_ejections"] >= 1
+                        and dec.batcher.health == "serving"
+                        and rounds >= 2):
+                    break
+            st = dec.batcher.stats
+            check("chaotic greedy responses byte-identical", identical)
+            check("peer ejections exercised", st["peer_ejections"] >= 1,
+                  f"ejections={st['peer_ejections']}")
+            check("induced scheduler death recovered",
+                  st["batcher_restarts"] >= 1
+                  and dec.batcher.health == "serving",
+                  f"restarts={st['batcher_restarts']} "
+                  f"health={dec.batcher.health}")
+
+            # full-pool outage: both listeners torn down -> local prefill
+            l1.close()
+            l2.close()
+            time.sleep(0.3)
+            for p, r in zip(prompts[:3], refs[:3]):
+                got = greedy(dec_h.http_port, p)["tokens"][0]
+                check("pool-down greedy identical (local prefill)",
+                      got == r, "" if got == r else f"{got} != {r}")
+            check("degraded_local_prefill exercised",
+                  st["degraded_local_prefill"] >= 1,
+                  f"degraded={st['degraded_local_prefill']}")
+
+            # recovery series in the Prometheus exposition
+            expo = REGISTRY.expose()
+            for series in ("seldon_engine_batcher_restarts",
+                           "seldon_engine_peer_ejections",
+                           "seldon_engine_degraded_local_prefill",
+                           "seldon_engine_batcher_healthy"):
+                check(f"exposition has {series}", series in expo)
+            check("batcher restart counter counts the death",
+                  REGISTRY.counter_total(
+                      "seldon_engine_batcher_restarts", {}) >= 1)
+            check("peer ejection counter counts the faults",
+                  REGISTRY.counter_total(
+                      "seldon_engine_peer_ejections", {}) >= 1)
+        finally:
+            uni_h.stop()
+            dec_h.stop()
+            for listener in (l1, l2):
+                listener.close()
+            for c in (unified, pf1, pf2, dec):
+                c.close()
+
+    if failures:
+        print(f"\nchaos smoke FAILED: {failures}", file=sys.stderr)
+        return 1
+    print("\nchaos smoke passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -12,7 +12,10 @@ transport — then asserts:
   reports ``cache_hit_tokens`` (the transfer-dedup accounting) and
   bumps ``kv_transfer_bytes_saved``;
 * the ``seldon_engine_kv_transfer_*`` series are present in the
-  Prometheus exposition with export/import directions.
+  Prometheus exposition with export/import directions;
+* peer death mid-run: with the prefill listener killed, the decode
+  pool ejects the peer (``peer_ejected`` flight record) and keeps
+  serving byte-identical greedy output via failover/local degradation.
 
 Run directly (``JAX_PLATFORMS=cpu python tools/disagg_smoke.py``) or
 from the CI disaggregation step. Exits non-zero on any failure.
@@ -129,6 +132,37 @@ def main() -> int:
                       "seldon_engine_kv_transfer_bytes_saved", {},
                   ) > 0)
             _ = first  # first shared request seeds the radix cache
+
+            # -- peer death mid-run: failover / local degradation ---------
+            # kill the TCP listener, then keep issuing requests through
+            # the decode engine: the dead peer is ejected (peer_ejected
+            # flight record + counter) and every request still answers
+            # byte-identical to unified — this pool's only peer is gone,
+            # so service degrades to LOCAL unified prefill
+            probe = [12, 13, 14, 15]
+            ref3 = greedy(uni_h.http_port, probe)["tokens"][0]
+            kv_listener.close()
+            import time as _time
+
+            _time.sleep(0.2)  # let the OS actually drop the listen port
+            for i in range(3):
+                got = greedy(tcp_h.http_port, probe)["tokens"][0]
+                check(f"peer-death request {i} byte-identical", got == ref3,
+                      "" if got == ref3 else f"{got} != {ref3}")
+            st = dec_tcp.batcher.stats
+            check("peer ejected after listener death",
+                  st["peer_ejections"] >= 1,
+                  f"ejections={st['peer_ejections']}")
+            check("decode degraded to local prefill",
+                  st["degraded_local_prefill"] >= 1,
+                  f"degraded={st['degraded_local_prefill']}")
+            eject_recs = [
+                e for e in dec_tcp.batcher.flight.dump()["entries"]
+                if e["type"] == "peer_ejected"
+            ]
+            check("peer_ejected flight record present", bool(eject_recs))
+            check("peer-ejection series in exposition",
+                  "seldon_engine_peer_ejections" in REGISTRY.expose())
         finally:
             uni_h.stop()
             lo_h.stop()

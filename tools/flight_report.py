@@ -86,6 +86,63 @@ def _kv_lines(
     return lines
 
 
+def _fault_lines(
+    restarts: List[Dict[str, Any]],
+    ejects: List[Dict[str, Any]],
+    readmits: List[Dict[str, Any]],
+    degraded: List[Dict[str, Any]],
+) -> List[str]:
+    """Fault-tolerance records, shown inline with the scheduling story:
+    supervised batcher restarts, prefill-peer ejections/readmissions and
+    local-prefill degradation — the diagnosis trail of a chaotic run."""
+    lines: List[str] = []
+    if restarts:
+        latched = [r for r in restarts if r.get("outcome") == "latched_dead"]
+        lines.append(
+            f"scheduler supervision: {len(restarts)} loop death(s) — "
+            + ", ".join(
+                f"attempt {r.get('attempt')}/{r.get('budget')} "
+                f"({r.get('outcome')}, backoff {r.get('backoff_s')}s)"
+                for r in restarts
+            )
+        )
+        if latched:
+            lines.append(
+                "DIAGNOSIS: the crash-loop budget is EXHAUSTED — this "
+                "member is latched unready and will only recover by "
+                "replacement; look at the paired loop-death tracebacks "
+                "in the server log"
+            )
+        elif len(restarts) > 1:
+            lines.append(
+                "DIAGNOSIS: repeated loop deaths inside one ring window "
+                "— the fault is recurring, not transient; each restart "
+                "pays a cache rebuild + re-warm and fails every "
+                "in-flight request"
+            )
+    if ejects:
+        peers: Dict[str, int] = {}
+        for e in ejects:
+            peers[e.get("peer", "?")] = peers.get(e.get("peer", "?"), 0) + 1
+        lines.append(
+            "prefill-peer failover: "
+            + ", ".join(f"{p} ejected {n}x" for p, n in sorted(peers.items()))
+            + f"; {len(readmits)} readmission(s)"
+        )
+    if degraded:
+        lines.append(
+            f"degraded local prefill: {len(degraded)} remote prefills "
+            "served LOCALLY (entire prefill pool ejected) — decode kept "
+            "answering, but the isolation win is suspended"
+        )
+        lines.append(
+            "DIAGNOSIS: the decode pool is doing prefill work; check "
+            "the prefill listeners (seldon_engine_peer_ejections) and "
+            "expect TTFT isolation to regress until readmission"
+        )
+    return lines
+
+
 def diagnose(dump: Dict[str, Any]) -> List[str]:
     """Report lines for one unit's flight-recorder dump."""
     lines: List[str] = []
@@ -95,6 +152,12 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
     swaps = [e for e in entries if e.get("type") == "weight_swap"]
     kv_exports = [e for e in entries if e.get("type") == "kv_export"]
     kv_inserts = [e for e in entries if e.get("type") == "remote_insert"]
+    restarts = [e for e in entries if e.get("type") == "batcher_restart"]
+    ejects = [e for e in entries if e.get("type") == "peer_ejected"]
+    readmits = [e for e in entries if e.get("type") == "peer_readmitted"]
+    degraded = [
+        e for e in entries if e.get("type") == "degraded_local_prefill"
+    ]
     lines.append(
         f"recorded {dump.get('recorded_total', len(entries))} records "
         f"(ring holds {len(entries)}, dropped "
@@ -144,6 +207,7 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
         # a prefill-role pool member never polls: its whole story is the
         # export stream
         lines.extend(_kv_lines(kv_exports, kv_inserts))
+        lines.extend(_fault_lines(restarts, ejects, readmits, degraded))
         return lines
 
     # -- batch composition --------------------------------------------------
@@ -194,6 +258,9 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
 
     # -- disaggregated serving (KV-slab handoff) ------------------------------
     lines.extend(_kv_lines(kv_exports, kv_inserts))
+
+    # -- fault tolerance (supervision, peer failover, degradation) -----------
+    lines.extend(_fault_lines(restarts, ejects, readmits, degraded))
 
     # -- prefix cache ---------------------------------------------------------
     hits = sum(p.get("prefix_hits", 0) for p in polls)

@@ -216,6 +216,21 @@ def rows_from(mt, fronts):
             + (f"; {fmt(dd.get('kv_transfer_bytes_saved', 0))} B "
                "transfer-deduped" if dd.get("kv_transfer_bytes_saved") else ""),
         ))
+    gc = mt.get("llm_1b_chaos") or {}
+    if gc:
+        rc = gc.get("recovery_counters") or {}
+        rows.append((
+            "generate(), chaos (fault-tolerant disagg)",
+            f"error rate {gc.get('error_rate', '—')} over "
+            f"{fmt(gc.get('requests_total'))} chaotic requests",
+            "KV faults x5 + pool outage + scheduler death"
+            + ("; completed outputs byte-identical"
+               if gc.get("greedy_identical") else "")
+            + ("; no hangs" if gc.get("no_hang") else "")
+            + (f"; {rc.get('batcher_restarts', 0)} restart(s), "
+               f"{rc.get('peer_ejections', 0)} ejection(s)"
+               if rc.get("all_exercised") else ""),
+        ))
     g1l = mt.get("llm_1b_long") or {}
     if g1l:
         mbu = f", MBU {g1l['mbu_pct']}%" if g1l.get("mbu_pct") is not None else ""
