@@ -1,0 +1,64 @@
+"""seldon-lint: invariant-aware static analysis for the serving stack.
+
+Seven PRs of multi-threaded scheduler growth left the repo's correctness
+invariants living in comments and reviewers' heads: device state is
+touched on the scheduler thread only, nothing blocks under a lock in the
+hot loop, deadline math uses monotonic clocks, and the
+``seldon_engine_*`` metric / ``seldon.io/*`` annotation vocabularies
+must agree across the code that emits them, the registry that maps them,
+and the docs that operators read. This package turns those conventions
+into machine-checked contracts (InferLine / DeepServe both argue serving
+planes need exactly this before fleet operation).
+
+Stdlib ``ast`` only — no new dependencies. Entry points:
+
+* ``tools/seldon_lint.py`` — the CLI and CI gate.
+* :mod:`.roles` — ``@scheduler_only`` / ``@caller_thread`` thread-role
+  decorators, statically verified by the ``thread-role`` rule and
+  runtime-asserted under ``SELDON_DEBUG_THREADS=1``.
+* :func:`.core.run_lint` — programmatic runner (used by the tests).
+
+Rule catalog (ids are what ``# seldon-lint: disable=<rule>`` takes):
+
+==================== ====================================================
+``thread-role``      caller-thread entry points must not reach
+                     scheduler-only device mutations through the call
+                     graph (the admit queue is the only legal handoff)
+``blocking-under-lock`` no sleeps, socket/queue waits, future results or
+                     device syncs inside a ``with <lock>:`` body
+``lock-order``       the cross-module lock acquisition graph must be
+                     acyclic
+``host-sync-hot-path`` no implicit host syncs (``.item()``,
+                     ``np.asarray`` / ``int()`` on jitted results,
+                     ``block_until_ready``) in poll-loop-reachable code
+``retrace-hazard``   no unbounded/unhashable Python values at static
+                     positions of jitted callables
+``metric-drift``     ``seldon_engine_*`` series must agree across
+                     engine_metrics maps, server emitters, tools and docs
+``annotation-drift`` ``seldon.io/*`` annotations parsed by the control
+                     plane must match the documented tables
+``wall-clock``       ``time.time()`` is reserved for named wall anchors;
+                     interval/deadline/ordering math uses monotonic time
+``parse-error``      a scanned file failed to parse
+==================== ====================================================
+"""
+
+from .core import Finding, LintResult, load_baseline, run_lint, write_baseline
+from .roles import (
+    ThreadRoleViolation,
+    caller_thread,
+    debug_threads_enabled,
+    scheduler_only,
+)
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "ThreadRoleViolation",
+    "caller_thread",
+    "debug_threads_enabled",
+    "load_baseline",
+    "run_lint",
+    "scheduler_only",
+    "write_baseline",
+]

@@ -1,0 +1,182 @@
+"""Lightweight per-module AST index: classes, methods, ``self.`` edges.
+
+The serving stack's threading and locking invariants are all *intra-
+class* properties (the batcher's scheduler contract, per-object lock
+ordering), so the call graph deliberately resolves only what it can
+resolve soundly:
+
+* ``self.method(...)`` inside a class body → an edge to that class's
+  method (if defined). This follows the admit path, the poll loop, the
+  swap machinery — everything the thread-role and lock rules need.
+* ``self._some_fn(...)`` where ``_some_fn`` was assigned from
+  ``jax.jit(...)`` in the same class → recorded as a *jitted call site*
+  with the jit's ``static_argnums`` (the hot-path rules consume these).
+* Anything else (cross-object calls, dynamic dispatch) is NOT an edge.
+  Under-approximating keeps the rules quiet where they cannot be sure;
+  the runtime role assertions (``SELDON_DEBUG_THREADS=1``) cover the
+  dynamic remainder.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["ClassIndex", "MethodInfo", "index_classes", "decorator_names", "reach_path"]
+
+
+def decorator_names(node) -> Set[str]:
+    """Trailing identifiers of each decorator (``@roles.scheduler_only``
+    and ``@scheduler_only`` both yield ``scheduler_only``)."""
+    out: Set[str] = set()
+    for dec in getattr(node, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if isinstance(target, ast.Attribute):
+            out.add(target.attr)
+        elif isinstance(target, ast.Name):
+            out.add(target.id)
+    return out
+
+
+@dataclasses.dataclass
+class MethodInfo:
+    name: str
+    node: ast.AST
+    decorators: Set[str]
+    # callee method name -> first call-site line number
+    self_calls: Dict[str, int]
+    role: Optional[str] = None  # "scheduler" | "caller" | None
+
+
+@dataclasses.dataclass
+class ClassIndex:
+    name: str
+    node: ast.ClassDef
+    methods: Dict[str, MethodInfo]
+    # attr name -> static_argnums for self.<attr> = jax.jit(fn, ...)
+    jit_attrs: Dict[str, Tuple[int, ...]]
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "jit":
+        return True
+    return isinstance(f, ast.Name) and f.id == "jit"
+
+
+def _static_argnums(call: ast.Call) -> Tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnums" and isinstance(
+            kw.value, (ast.Tuple, ast.List)
+        ):
+            out = []
+            for elt in kw.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                    out.append(elt.value)
+            return tuple(out)
+        if kw.arg == "static_argnums" and isinstance(kw.value, ast.Constant):
+            if isinstance(kw.value.value, int):
+                return (kw.value.value,)
+    return ()
+
+
+def _index_method(fn: ast.AST) -> MethodInfo:
+    decs = decorator_names(fn)
+    calls: Dict[str, int] = {}
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+            recv = sub.func.value
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                calls.setdefault(sub.func.attr, sub.lineno)
+    role = None
+    if "scheduler_only" in decs:
+        role = "scheduler"
+    elif "caller_thread" in decs:
+        role = "caller"
+    return MethodInfo(
+        name=fn.name, node=fn, decorators=decs, self_calls=calls, role=role
+    )
+
+
+def index_classes(tree: ast.AST) -> List[ClassIndex]:
+    out: List[ClassIndex] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        methods: Dict[str, MethodInfo] = {}
+        jit_attrs: Dict[str, Tuple[int, ...]] = {}
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                methods[item.name] = _index_method(item)
+        # self.<attr> = jax.jit(...) anywhere in the class (usually __init__,
+        # including nested branches — speculation assigns conditionally)
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Assign) or not isinstance(
+                sub.value, ast.Call
+            ):
+                continue
+            if not _is_jit_call(sub.value):
+                continue
+            for tgt in sub.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    jit_attrs[tgt.attr] = _static_argnums(sub.value)
+        out.append(ClassIndex(node.name, node, methods, jit_attrs))
+    return out
+
+
+def reach_path(
+    cls: ClassIndex,
+    start: str,
+    hits: Set[str],
+    through: Optional[Set[str]] = None,
+) -> Optional[List[Tuple[str, int]]]:
+    """Shortest-ish self-call path from ``start`` to any method in
+    ``hits``, traversing only methods in ``through`` (None = any method
+    not itself in ``hits``). Returns ``[(callee, call lineno), ...]``
+    edges, or None when unreachable. BFS so reports stay minimal."""
+    from collections import deque
+
+    q = deque([(start, [])])
+    seen = {start}
+    while q:
+        cur, path = q.popleft()
+        info = cls.methods.get(cur)
+        if info is None:
+            continue
+        for callee, lineno in sorted(info.self_calls.items()):
+            edge = path + [(callee, lineno)]
+            if callee in hits:
+                return edge
+            if callee in seen or callee not in cls.methods:
+                continue
+            if through is not None and callee not in through:
+                continue
+            seen.add(callee)
+            q.append((callee, edge))
+    return None
+
+
+def reachable_set(cls: ClassIndex, roots: Sequence[str]) -> Set[str]:
+    """Every method reachable from ``roots`` via self-calls (inclusive)."""
+    out: Set[str] = set()
+    stack = [r for r in roots if r in cls.methods]
+    while stack:
+        cur = stack.pop()
+        if cur in out:
+            continue
+        out.add(cur)
+        stack.extend(
+            c for c in cls.methods[cur].self_calls if c in cls.methods
+        )
+    return out
+
+
+def iter_functions(tree: ast.AST) -> Iterator[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node

@@ -656,13 +656,13 @@ class KubeController:
         exact capability the next step needs — rather than parsing
         status.conditions, so it also works against minimal fake servers.
         """
-        deadline = time.time() + timeout_s
+        deadline = time.monotonic() + timeout_s
         while True:
             try:
                 self._list_crs()
                 return True
             except KubeApiError:
-                if time.time() >= deadline:
+                if time.monotonic() >= deadline:
                     return False
                 time.sleep(0.2)
 

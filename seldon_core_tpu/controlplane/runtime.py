@@ -87,6 +87,8 @@ class ComponentHandle:
 
     def __init__(self, spec: ComponentSpec):
         self.spec = spec
+        # absolute start stamp, display/status only — never interval math
+        # seldon-lint: disable=wall-clock
         self.started_at = time.time()
 
     @property

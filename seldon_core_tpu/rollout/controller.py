@@ -122,6 +122,7 @@ class RolloutState:
     events: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
 
     def event(self, kind: str, **fields) -> None:
+        # seldon-lint: disable=wall-clock (operator-facing event-trail stamp)
         entry = {"t": time.time(), "event": kind, **fields}
         self.events.append(entry)
         if len(self.events) > MAX_EVENTS:

@@ -125,6 +125,7 @@ class ShadowMirror:
                 "diverged", 1, predictor=name, kind=verdict.get("kind", "opaque")
             )
             self.recent.append(
+                # seldon-lint: disable=wall-clock (divergence-trail stamp)
                 {"t": time.time(), "predictor": name, **verdict}
             )
 

@@ -112,6 +112,7 @@ import logging
 from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
 
+from ..analysis.roles import caller_thread
 from ..user_model import SeldonComponent
 from .jaxserver import JAXServer
 
@@ -458,6 +459,7 @@ class GenerateServer(SeldonComponent):
         batcher can still serve prefill exports."""
         return self.batcher is not None and self.batcher.health == "serving"
 
+    @caller_thread
     def prefill_export(self, request: Dict[str, Any]):
         """PREFILL-side transport handler: run the prompt forward and
         return ``(meta, slab)`` for the wire codec. Called by the
@@ -477,6 +479,7 @@ class GenerateServer(SeldonComponent):
             covered_len=int(request.get("covered_len", 0)),
         )
 
+    @caller_thread
     def _remote_submit(self, toks, kw, deadline_s, covered=None,
                        on_tokens=None):
         """Decode-role submit: consult the local radix cache for the
@@ -524,6 +527,7 @@ class GenerateServer(SeldonComponent):
             slab, meta, on_tokens=on_tokens, deadline_s=deadline_s
         )
 
+    @caller_thread
     def _local_prefill_fallback(self, toks, kw, deadline_s, on_tokens,
                                 reason: str):
         """The whole prefill pool is ejected: serve the prompt with a
@@ -547,6 +551,7 @@ class GenerateServer(SeldonComponent):
         return b.submit(toks, deadline_s=deadline_s, on_tokens=on_tokens,
                         **kw)
 
+    @caller_thread
     def _collect_results(self, futures, token_lists, kw, deadline_s,
                          expires_at, retry_prefix_gone=False):
         """Await every request future under the remaining deadline budget
@@ -602,6 +607,7 @@ class GenerateServer(SeldonComponent):
             raise
         return results
 
+    @caller_thread
     def _predict_disagg(self, token_lists, kw, deadline_s, expires_at):
         """Decode-role submit loop: prefill at the peer pool, slab over
         the KV transport, then the shared all-or-nothing collection.
@@ -654,6 +660,7 @@ class GenerateServer(SeldonComponent):
         if self.batcher is not None:
             self.batcher.close()
 
+    @caller_thread
     def predict(self, X, names, meta=None):
         if self.batcher is None:
             self.load()
@@ -730,6 +737,7 @@ class GenerateServer(SeldonComponent):
             ]
         return out
 
+    @caller_thread
     def stream(self, body: Dict[str, Any]) -> "StreamHandle":
         """Streaming generate: validates and SUBMITS eagerly (malformed
         bodies and closed batchers raise HERE, before any response bytes
@@ -787,6 +795,7 @@ class GenerateServer(SeldonComponent):
 
         return StreamHandle(chunks=chunks(), cancel=fut.cancel)
 
+    @caller_thread
     def hot_swap(self, model_uri: str, wait_s: float = 30.0) -> Dict[str, Any]:
         """Live weight hot-swap: load a new checkpoint and replace the
         served weights WITHOUT restarting the process or dropping a

@@ -77,6 +77,9 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..analysis.roles import caller_thread, scheduler_only
+from ..tracing import wall_us
+
 logger = logging.getLogger(__name__)
 
 
@@ -1037,6 +1040,7 @@ class ContinuousBatcher:
             "tpot_ms": pct(tpots) if tpots else None,
         }
 
+    @caller_thread
     def _shed_check(self, deadline_s: Optional[float]) -> None:
         """Admit-queue shedding, BEFORE the request costs any device work:
         an explicit queue cap, and the deadline-aware rule (expected queue
@@ -1070,6 +1074,7 @@ class ContinuousBatcher:
                 retry_after_s=est_wait,
             )
 
+    @caller_thread
     def _note_shed(self, reason: str, depth: int, rate: Optional[float]) -> None:
         """Flight-recorder + trace breadcrumbs for a shed decision (runs on
         the SUBMITTING thread, where the request's span is still active)."""
@@ -1084,9 +1089,12 @@ class ContinuousBatcher:
         if tracer.enabled:
             parent = tracer.active_span()
             if parent is not None and parent.trace_id != "0":
+                # monotonic-anchored timestamp: a raw time.time() here
+                # could disorder the shed breadcrumb against the sibling
+                # spans' anchored clocks under an NTP step
                 tracer.record_span(
                     "gen.shed", parent.trace_id, parent.span_id,
-                    int(time.time() * 1e6), 0,
+                    wall_us(), 0,
                     tags={"reason": reason, "queue_depth": depth},
                 )
 
@@ -1111,6 +1119,7 @@ class ContinuousBatcher:
         if self._stop.is_set():
             raise self._dead_error()
 
+    @caller_thread
     def submit(
         self,
         tokens: Sequence[int],
@@ -1137,7 +1146,7 @@ class ContinuousBatcher:
             on_tokens=on_tokens,
         )
         req.submit_t = time.monotonic()
-        req.submit_wall_us = int(time.time() * 1e6)
+        req.submit_wall_us = wall_us(req.submit_t)
         # capture the submitting thread's sampled trace context so the
         # scheduler thread can parent this request's timeline spans under
         # the serving span (the engine's graph-hop span, propagated into
@@ -1164,6 +1173,7 @@ class ContinuousBatcher:
         self.start()
         return req.future
 
+    @caller_thread
     def generate(self, tokens, **kw) -> List[int]:
         """Blocking convenience: submit and wait for the generated ids."""
         return self.submit(tokens, **kw).result()
@@ -1176,6 +1186,7 @@ class ContinuousBatcher:
         the per-token unit the transfer-dedup accounting is priced in."""
         return self._kv_key_bytes
 
+    @caller_thread
     def export_prefill(
         self,
         tokens: Sequence[int],
@@ -1306,6 +1317,7 @@ class ContinuousBatcher:
             })
         return meta, {"k": k, "v": v}
 
+    @caller_thread
     def remote_covered_len(self, tokens: Sequence[int]) -> int:
         """DECODE-side consult before requesting a remote prefill: the
         longest locally cached prefix usable as the transfer-dedup base
@@ -1324,6 +1336,7 @@ class ContinuousBatcher:
             return 0  # donor wider than the prompt bucket: not a win
         return m
 
+    @caller_thread
     def admit_remote(
         self,
         slab: Dict[str, Any],
@@ -1414,7 +1427,7 @@ class ContinuousBatcher:
             on_tokens=on_tokens,
         )
         req.submit_t = time.monotonic()
-        req.submit_wall_us = int(time.time() * 1e6)
+        req.submit_wall_us = wall_us(req.submit_t)
         req.cache_hit_tokens = covered
         from ..tracing import get_tracer
 
@@ -1441,6 +1454,7 @@ class ContinuousBatcher:
         self.start()
         return req.future
 
+    @caller_thread
     def request_weight_swap(self, params, version=None) -> Future:
         """Stage a live weight hot-swap; returns a Future resolving to
         the new weight version once the scheduler flips.
@@ -1522,6 +1536,7 @@ class ContinuousBatcher:
         authoritative check stays inside request_weight_swap."""
         return self._pending_swap is not None
 
+    @caller_thread
     def cancel_weight_swap(self) -> bool:
         """Abort a staged-but-not-yet-executed weight swap, resuming
         admissions on the next poll. The escape hatch for a drain that
@@ -1540,6 +1555,7 @@ class ContinuousBatcher:
             )
         return True
 
+    @scheduler_only
     def _do_swap(self, swap: _SwapJob) -> None:
         """Execute a drained swap (scheduler thread, poll boundary).
 
@@ -1579,6 +1595,7 @@ class ContinuousBatcher:
         if not swap.future.done():
             swap.future.set_result(swap.version)
 
+    @scheduler_only
     def _alloc_device_state(self) -> None:
         """(Re)allocate everything the scheduler loop mutates on device:
         the unstacked per-layer KV cache (and the draft's), the per-lane
@@ -1603,6 +1620,7 @@ class ContinuousBatcher:
         self._pos = jnp.zeros((self.slots,), jnp.int32)
         self._keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(self.slots))
 
+    @scheduler_only
     def _rebuild(self) -> None:
         """Crash recovery (scheduler thread): fresh device state + a
         reset prefix index (its slabs referenced the invalidated cache
@@ -1627,6 +1645,7 @@ class ContinuousBatcher:
         if self._warm_args is not None:
             self.warm(**self._warm_args)
 
+    @caller_thread
     def start(self) -> None:
         if self._stop.is_set():
             raise BatcherDead(
@@ -1746,7 +1765,7 @@ class ContinuousBatcher:
                         )
                     )
                 # block so only one warm call is in flight at a time
-                self._cache["k"][0].block_until_ready()
+                self._cache["k"][0].block_until_ready()  # seldon-lint: disable=host-sync-hot-path (warm precompile: intentional sync while the loop is idle)
                 if self.speculate_tokens > 0:
                     dslab = self._draft_prefill_fn(
                         self._draft_params, prompts, last
@@ -1775,7 +1794,7 @@ class ContinuousBatcher:
                             jnp.int32(0), jnp.float32(0.0),
                             attn_len, is_last,
                         )
-                        slab["k"].block_until_ready()
+                        slab["k"].block_until_ready()  # seldon-lint: disable=host-sync-hot-path (warm precompile: intentional sync while the loop is idle)
                 del slab
         if self._prefix_index is not None:
             # prefix-cache executables: extract per donor bucket, and the
@@ -1792,7 +1811,7 @@ class ContinuousBatcher:
                     for b in buckets:
                         if b >= d and b > self.prefill_chunk:
                             out = self._splice_fn(self._new_slab(b), slab)
-                            out["k"].block_until_ready()
+                            out["k"].block_until_ready()  # seldon-lint: disable=host-sync-hot-path (warm precompile: intentional sync while the loop is idle)
                 for s_b in buckets:
                     if s_b > d:
                         continue
@@ -1809,7 +1828,7 @@ class ContinuousBatcher:
                             self._cur_tok, self._pos, self._keys,
                         )
                     )
-                    self._cache["k"][0].block_until_ready()
+                    self._cache["k"][0].block_until_ready()  # seldon-lint: disable=host-sync-hot-path (warm precompile: intentional sync while the loop is idle)
         active = jnp.zeros((self.slots,), bool)
         temps = jnp.zeros((self.slots,), jnp.float32)
         for attn_len in attn_lens:
@@ -1830,7 +1849,7 @@ class ContinuousBatcher:
                 )
                 self._cache = {"k": nc["k"], "v": nc["v"]}
                 self._draft_cache = {"k": nc["dk"], "v": nc["dv"]}
-                self._cache["k"][0].block_until_ready()
+                self._cache["k"][0].block_until_ready()  # seldon-lint: disable=host-sync-hot-path (warm precompile: intentional sync while the loop is idle)
             else:
                 toks, self._cur_tok, self._pos, self._cache, self._keys = (
                     self._burst_fn(
@@ -1838,7 +1857,7 @@ class ContinuousBatcher:
                         active, temps, self._keys, k, attn_len,
                     )
                 )
-                toks.block_until_ready()
+                toks.block_until_ready()  # seldon-lint: disable=host-sync-hot-path (warm precompile: intentional sync while the loop is idle)
                 if self.depth_groups > 1:
                     # grouped sub-burst variants: every pow2 group-size
                     # bucket at this attention bucket (mixed-depth polls
@@ -1857,7 +1876,7 @@ class ContinuousBatcher:
                                 0, k, attn_len,
                             )
                         )
-                        toks.block_until_ready()
+                        toks.block_until_ready()  # seldon-lint: disable=host-sync-hot-path (warm precompile: intentional sync while the loop is idle)
         # warm left garbage in cur_tok/pos; reset the host-visible lane
         # state so the first admissions start from a clean slate (the
         # device cache needs no scrub — see residue invariant above)
@@ -1865,6 +1884,7 @@ class ContinuousBatcher:
         self._pos = jnp.zeros((self.slots,), jnp.int32)
         self._keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(self.slots))
 
+    @caller_thread
     def close(self) -> None:
         if self.health != "dead":
             # a dead batcher stays "dead" (its unready latch is the
@@ -1924,6 +1944,7 @@ class ContinuousBatcher:
         ab = self.attn_bucket
         return min(self.max_seq, -(-hi // ab) * ab)
 
+    @scheduler_only
     def _emit_span(self, req: GenRequest, operation: str, start_t: float,
                    end_t: float, tags: Optional[Dict[str, Any]] = None) -> None:
         """Retroactive per-request timeline span, parented under the trace
@@ -1941,6 +1962,7 @@ class ContinuousBatcher:
             int((end_t - start_t) * 1e6), tags=tags,
         )
 
+    @scheduler_only
     def _plan_groups(self, adv: int):
         """Partition live lanes into <= depth_groups sub-bursts by
         attention-read bucket. Returns ``([(lanes, bucket)], need)`` with
@@ -1993,6 +2015,7 @@ class ContinuousBatcher:
             g <<= 1
         return min(g, self.slots)
 
+    @scheduler_only
     def _draft_admit(self, slot: int, req: GenRequest) -> None:
         """Give the draft its prompt K/V prefix (speculation only). Draft
         prefixes are RE-DERIVED from the full prompt, never cached or
@@ -2020,6 +2043,7 @@ class ContinuousBatcher:
         dt = jnp.dtype(getattr(self.model, "compute_dtype", cfg.dtype))
         return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
+    @scheduler_only
     def _start_chunked(self, slot: int, req: GenRequest, hit=None) -> None:
         """Reserve ``slot`` and queue the prompt for interleaved chunked
         prefill. On a prefix-cache hit the donor slab lands at the head
@@ -2060,6 +2084,7 @@ class ContinuousBatcher:
                   "cache_hit_tokens": req.cache_hit_tokens},
         )
 
+    @scheduler_only
     def _advance_chunks(self) -> None:
         """Run ONE prefill chunk for every pending chunked admission (the
         interleave: a chunk per job per decode poll). The final chunk
@@ -2138,6 +2163,7 @@ class ContinuousBatcher:
             else:
                 job.next_start = end
 
+    @scheduler_only
     def _prefix_match(self, req: GenRequest):
         """Longest usable cached prefix for this prompt: ``(m, slab)`` or
         None. Capped at n-1 (the last prompt token is always recomputed —
@@ -2162,6 +2188,7 @@ class ContinuousBatcher:
             return None
         return m, slab
 
+    @scheduler_only
     def _maybe_publish(self, slot: int, s: "_Slot") -> None:
         """Publish the request's prompt K/V back into the radix pool (the
         prompt region [0, n) is fully written from admit onward and decode
@@ -2182,6 +2209,7 @@ class ContinuousBatcher:
         self.stats["prefix_evicted"] += idx.insert(toks, slab, nbytes)
         self.stats["prefix_cache_bytes"] = idx.total_bytes
 
+    @scheduler_only
     def _admit_remote_lane(self, slot: int, req: GenRequest) -> None:
         """Splice a shipped prefill slab into ``slot`` (scheduler thread;
         the decode-side endpoint of the KV handoff). No prefill runs
@@ -2270,6 +2298,7 @@ class ContinuousBatcher:
         # upload buffer frees as soon as the insert's copy completes
         req.remote = None
 
+    @scheduler_only
     def _admit(self, slot: int, req: GenRequest, hit=None) -> None:
         # ``hit``: a (match_len, slab) the wave-routing loop already
         # computed — passed through so the radix walk (and its LRU touch)
@@ -2367,6 +2396,7 @@ class ContinuousBatcher:
         self._masks_dirty = True
         self.stats["admitted"] += 1
 
+    @scheduler_only
     def _admit_many(self, slots: List[int], reqs: List[GenRequest], bucket: int) -> None:
         """Admit m same-bucket requests with ONE batched prefill forward +
         ONE batched insert (see prefill_many). Only used without
@@ -2420,6 +2450,7 @@ class ContinuousBatcher:
         if self._prefix_index is not None:
             self.stats["prefix_misses"] += m
 
+    @scheduler_only
     def _resolve(self, s: _Slot) -> None:
         # a trailing eos token is kept in the output, like HF generate.
         # `finished` counts requests that ran to completion; `cancelled`
@@ -2479,6 +2510,7 @@ class ContinuousBatcher:
         # admit-queue shed uses for its expected-wait estimate
         self._finish_times.append(now)
 
+    @scheduler_only
     def _finish(self, slot: int) -> None:
         s = self._active.pop(slot)
         # publish while the lane still holds this request's prompt K/V —
@@ -2489,6 +2521,7 @@ class ContinuousBatcher:
         self._masks_dirty = True
         self._resolve(s)
 
+    @scheduler_only
     def _check_done(self) -> None:
         for slot in list(self._active):
             s = self._active[slot]
@@ -2503,6 +2536,7 @@ class ContinuousBatcher:
             ):
                 self._finish(slot)
 
+    @scheduler_only
     def _credit(self, s: _Slot, tokens) -> bool:
         """Append tokens to a request; True once it is done (budget/eos —
         the caller drops the rest of the burst's tokens for this lane)."""
@@ -2528,6 +2562,7 @@ class ContinuousBatcher:
                 logger.exception("on_tokens callback failed")
         return done
 
+    @scheduler_only
     def _process_burst(self, toks_dev, snapshot) -> None:
         """Credit one burst's tokens to the requests that occupied each lane
         AT DISPATCH TIME. Bursts execute on the device stream in dispatch
@@ -2550,6 +2585,7 @@ class ContinuousBatcher:
                     self._resolve(s)  # lane was pre-freed at dispatch time
         self._check_done()
 
+    @scheduler_only
     def _process_spec_burst(self, start_tok_dev, toks_dev, counts_dev, snapshot, k) -> None:
         """Spec-mode crediting: per round, a lane emitted counts[r, slot]
         tokens (accepted drafts + the target's correction). Also tightens
@@ -2589,6 +2625,7 @@ class ContinuousBatcher:
             if not self._loop():
                 return
 
+    @scheduler_only
     def _fail_inflight(self, pending, err: Exception) -> None:
         """Fail every request the dead loop had in flight: active lanes,
         pre-freed lanes living only in pending-burst snapshots (without
@@ -2612,6 +2649,7 @@ class ContinuousBatcher:
             if not job.request.future.done():
                 job.request.future.set_exception(err)
 
+    @scheduler_only
     def _crash_recover(self, pending) -> bool:
         """Supervise one loop death (scheduler thread). True = the loop
         may resume on rebuilt device state; False = the batcher is done
@@ -2679,6 +2717,7 @@ class ContinuousBatcher:
             )
             return True
 
+    @scheduler_only
     def _loop(self) -> bool:
         """One supervised run of the poll loop. Returns False on a clean
         ``close()`` stop, or :meth:`_crash_recover`'s verdict after a

@@ -28,9 +28,10 @@ diagnosis.
 from __future__ import annotations
 
 import itertools
-import time
 from collections import deque
 from typing import Any, Dict, List, Optional
+
+from ..tracing import wall_us
 
 
 class FlightRecorder:
@@ -52,7 +53,10 @@ class FlightRecorder:
         if not self.enabled:
             return
         entry["seq"] = next(self._seq)
-        entry.setdefault("t_us", int(time.time() * 1e6))
+        # monotonic-anchored wall stamp: flight_report diffs t_us between
+        # records to attribute poll gaps — an NTP step under a raw
+        # time.time() would turn those intervals into lies
+        entry.setdefault("t_us", wall_us())
         self._ring.append(entry)
 
     def snapshot(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:

@@ -35,6 +35,32 @@ from typing import Any, Dict, List, Optional
 TRACE_HEADER = "uber-trace-id"  # trace_id:span_id:parent_span_id:flags
 BAGGAGE_PREFIX = "uberctx-"
 
+# Monotonic->wall anchor, sampled ONCE at import: every span/flight-
+# recorder timestamp is derived as anchor + monotonic offset, so an NTP
+# step mid-flight can never disorder spans within a trace or corrupt
+# the intervals between recorder entries. time.time() appears only here
+# (the seldon-lint wall-clock rule allows *WALL* anchor assignments).
+_WALL_ANCHOR_US = int(time.time() * 1e6)
+_MONO_ANCHOR = time.monotonic()
+
+
+def wall_us(monotonic_t: Optional[float] = None) -> int:
+    """Wall-clock microseconds for event timestamps, derived from the
+    monotonic clock via the process-lifetime anchor. Pass a stored
+    ``time.monotonic()`` reading to place a past event; default is
+    now.
+
+    Deliberate tradeoff: a wall-clock step AFTER process start (late
+    NTP sync) leaves this process's timestamps offset from other
+    hosts' by the step size for the process lifetime — cross-process
+    span alignment degrades by that constant, but intra-process span
+    ordering and every recorded interval stay exact, which is what
+    deadline math and flight-recorder diffing depend on. Run serving
+    hosts with time synced before process start (standard fleet
+    practice) and the offset is bounded by normal NTP slew."""
+    m = time.monotonic() if monotonic_t is None else monotonic_t
+    return _WALL_ANCHOR_US + int((m - _MONO_ANCHOR) * 1e6)
+
 _current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
     "seldon_tpu_span", default=None
 )
@@ -64,7 +90,7 @@ class Span:
         return self
 
     def log(self, **fields) -> None:
-        self.logs.append({"timestamp": int(time.time() * 1e6), "fields": fields})
+        self.logs.append({"timestamp": wall_us(), "fields": fields})
 
     def context_header(self) -> str:
         return f"{self.trace_id}:{self.span_id}:{self.parent_id or '0'}:{self.flags:x}"
@@ -130,7 +156,7 @@ class Tracer:
             trace_id=parent.trace_id if parent else _rand_id(),
             span_id=_rand_id(),
             parent_id=parent.span_id if parent else None,
-            start_us=int(time.time() * 1e6),
+            start_us=wall_us(),
             tags=dict(tags or {}),
             # inherit the parent's flags byte so upstream bits beyond
             # SAMPLED (e.g. Jaeger's DEBUG 0x2) survive the hop instead

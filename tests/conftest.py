@@ -8,6 +8,14 @@ Must run before jax is imported anywhere.
 
 import os
 
+# Runtime thread-role assertions for the WHOLE tier-1 run: the
+# @scheduler_only/@caller_thread decorators (analysis/roles.py) check the
+# executing thread on every decorated call, so a scheduler-thread
+# violation fails a test loudly instead of corrupting device state.
+# Must be set before any seldon_core_tpu import (the decorators read it
+# at import time); set here, it covers every test module.
+os.environ.setdefault("SELDON_DEBUG_THREADS", "1")
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

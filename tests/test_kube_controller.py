@@ -362,3 +362,31 @@ def test_http_watch_stream_parses_json_lines():
                          timeout_s=5))
     srv.close()
     assert [e["type"] for e in got] == ["ADDED", "MODIFIED"]
+
+
+def test_wait_crd_established_never_reads_the_wall_clock(monkeypatch):
+    """Regression (seldon-lint wall-clock): the CRD poll deadline used
+    time.time(), so an NTP step during controller bootstrap could stall
+    the wait far past timeout_s (or expire it instantly). The loop must
+    run entirely on the monotonic clock."""
+    from seldon_core_tpu.controlplane import kube as kube_mod
+
+    kube = FakeKube()
+    ctl = KubeController(kube)
+
+    def boom():  # any wall-clock read in the wait loop is a regression
+        raise AssertionError("wait_crd_established read time.time()")
+
+    monkeypatch.setattr(kube_mod.time, "time", boom)
+    monkeypatch.setattr(kube_mod.time, "sleep", lambda s: None)
+    # apiserver not serving the endpoint yet: the wait must expire via
+    # the monotonic deadline without ever touching time.time()
+    real_list = kube.list
+
+    def not_established(path):
+        raise kube_mod.KubeApiError(404, "endpoint not established")
+
+    monkeypatch.setattr(kube, "list", not_established)
+    assert ctl.wait_crd_established(timeout_s=0.05) is False
+    monkeypatch.setattr(kube, "list", real_list)
+    assert ctl.wait_crd_established(timeout_s=0.05) is True

@@ -1,0 +1,791 @@
+"""seldon-lint analyzer tests: fixture snippets per rule.
+
+Every rule gets a must-flag / must-not-flag pair (the not-flag twin is
+the idiom the rule is supposed to leave alone), plus call-graph
+indirection cases, suppression and baseline semantics, and the
+acceptance-criteria fixtures: a device mutation reachable from submit, a
+``time.sleep`` under ``_lock``, and a renamed metric not reflected in
+the docs — each must be caught by its rule.
+
+Fixtures are written to tmp_path and linted through the same
+:func:`run_lint` entry point the CLI uses, so suppression parsing,
+baseline accounting, and rule wiring are all exercised end to end.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from seldon_core_tpu.analysis import core
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint(tmp_path, source, rules=None, name="mod.py", docs=None, baseline=None):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    docs_files = []
+    if docs is not None:
+        d = tmp_path / "docs.md"
+        d.write_text(textwrap.dedent(docs))
+        docs_files = [str(d)]
+    result = core.run_lint(
+        [str(p)], root=str(tmp_path), docs=docs_files, rules=rules,
+        baseline=baseline,
+    )
+    return result
+
+
+def rules_of(result):
+    return [f.rule for f in result.findings]
+
+
+# -- thread-role ------------------------------------------------------------
+
+ROLE_PREAMBLE = """
+    def scheduler_only(fn):
+        return fn
+
+    def caller_thread(fn):
+        return fn
+"""
+
+
+def test_thread_role_flags_direct_reach(tmp_path):
+    res = lint(tmp_path, ROLE_PREAMBLE + """
+    class B:
+        @caller_thread
+        def submit(self, req):
+            self._admit(0, req)  # wrong: device mutation on caller thread
+
+        @scheduler_only
+        def _admit(self, slot, req):
+            self._cache = req
+    """, rules=["thread-role"])
+    assert rules_of(res) == ["thread-role"]
+    assert "submit" in res.findings[0].message
+    assert "_admit" in res.findings[0].message
+
+
+def test_thread_role_flags_indirect_reach(tmp_path):
+    """A path through an undecorated helper is still a violation."""
+    res = lint(tmp_path, ROLE_PREAMBLE + """
+    class B:
+        @caller_thread
+        def submit(self, req):
+            self._helper(req)
+
+        def _helper(self, req):
+            self._deeper(req)
+
+        def _deeper(self, req):
+            self._admit(0, req)
+
+        @scheduler_only
+        def _admit(self, slot, req):
+            self._cache = req
+    """, rules=["thread-role"])
+    assert rules_of(res) == ["thread-role"]
+    assert "submit -> _helper -> _deeper -> _admit" in res.findings[0].message
+
+
+def test_thread_role_queue_handoff_is_clean(tmp_path):
+    """The admit-queue handoff (data flow, not a call) must NOT flag —
+    that is the legal path between the roles."""
+    res = lint(tmp_path, ROLE_PREAMBLE + """
+    class B:
+        @caller_thread
+        def submit(self, req):
+            self._check_alive()
+            self._queue.put(req)
+            self.start()
+
+        @caller_thread
+        def start(self):
+            pass
+
+        def _check_alive(self):
+            pass
+
+        @scheduler_only
+        def _loop(self):
+            req = self._queue.get_nowait()
+            self._admit(0, req)
+
+        @scheduler_only
+        def _admit(self, slot, req):
+            self._cache = req
+    """, rules=["thread-role"])
+    assert res.findings == []
+
+
+def test_thread_role_scheduler_calling_entry_point_flags(tmp_path):
+    res = lint(tmp_path, ROLE_PREAMBLE + """
+    class B:
+        @caller_thread
+        def generate(self, toks):
+            return None
+
+        @scheduler_only
+        def _loop(self):
+            self.generate([1])  # deadlock: loop blocks on itself
+    """, rules=["thread-role"])
+    assert rules_of(res) == ["thread-role"]
+
+
+def test_thread_role_real_serving_stack_is_clean():
+    res = core.run_lint(
+        [os.path.join(REPO, "seldon_core_tpu", "serving"),
+         os.path.join(REPO, "seldon_core_tpu", "servers")],
+        root=REPO, docs=[], rules=["thread-role"],
+    )
+    assert res.findings == []
+
+
+# -- runtime role assertions ------------------------------------------------
+
+
+def test_runtime_roles_assert_executing_thread():
+    """SELDON_DEBUG_THREADS=1 turns the decorators into executing-thread
+    assertions; without a live scheduler thread they are inert.
+
+    The debug flag is toggled directly (no importlib.reload): reloading
+    would mint a second ThreadRoleViolation class and split exception
+    identity from the one analysis/__init__ exports for the rest of the
+    pytest process."""
+    import threading
+
+    import seldon_core_tpu.analysis.roles as roles
+
+    prev = roles._DEBUG
+    roles._DEBUG = True
+    try:
+        assert roles.debug_threads_enabled()
+
+        class Batcher:
+            def __init__(self):
+                self._thread = None
+
+            @roles.scheduler_only
+            def _admit(self):
+                return "ok"
+
+            @roles.caller_thread
+            def submit(self):
+                return "ok"
+
+        b = Batcher()
+        # no scheduler running: both roles pass (init-time calls)
+        assert b._admit() == "ok"
+        assert b.submit() == "ok"
+
+        ran = {}
+
+        def run():
+            ran["admit"] = b._admit()  # on the scheduler thread: fine
+            try:
+                b.submit()
+            except roles.ThreadRoleViolation as e:
+                ran["submit_err"] = str(e)
+
+        t = threading.Thread(target=run, name="sched")
+        b._thread = t
+        t.start()
+        t.join()
+        assert ran["admit"] == "ok"
+        assert "caller_thread" in ran.get("submit_err", "")
+        # from the main thread while the scheduler runs, _admit refuses.
+        # The stand-in scheduler blocks on an Event (not a timed sleep)
+        # so a descheduled CI runner cannot flake the aliveness check.
+        stop = threading.Event()
+        t2 = threading.Thread(target=stop.wait, name="sched2")
+        b._thread = t2
+        t2.start()
+        try:
+            with pytest.raises(roles.ThreadRoleViolation):
+                b._admit()
+            assert b.submit() == "ok"
+        finally:
+            stop.set()
+            t2.join()
+    finally:
+        roles._DEBUG = prev
+
+
+# -- blocking-under-lock ----------------------------------------------------
+
+
+def test_blocking_under_lock_flags_sleep(tmp_path):
+    res = lint(tmp_path, """
+    import time
+
+    class C:
+        def poll(self):
+            with self._lock:
+                time.sleep(0.1)
+    """, rules=["blocking-under-lock"])
+    assert rules_of(res) == ["blocking-under-lock"]
+
+
+def test_blocking_under_lock_flags_queue_and_socket_waits(tmp_path):
+    res = lint(tmp_path, """
+    class C:
+        def a(self):
+            with self._lock:
+                return self._queue.get(timeout=1)
+
+        def b(self):
+            with self._swap_lock:
+                data = self.sock.recv(4096)
+                fut.result()
+                arr.block_until_ready()
+    """, rules=["blocking-under-lock"])
+    assert len(res.findings) == 4
+
+
+def test_blocking_under_lock_not_flagging_bookkeeping(tmp_path):
+    """Pointer work, dict .get, str.join, os.path.join, get_nowait and
+    blocking calls OUTSIDE the lock are all fine."""
+    res = lint(tmp_path, """
+    import os
+    import time
+
+    class C:
+        def a(self):
+            with self._lock:
+                self.stats["x"] += 1
+                v = self._cache.get("k")
+                name = ", ".join(self.names)
+                path = os.path.join("a", "b")
+                try:
+                    item = self._queue.get_nowait()
+                except Exception:
+                    item = None
+            time.sleep(0.1)  # after release: fine
+            return v, name, path, item
+    """, rules=["blocking-under-lock"])
+    assert res.findings == []
+
+
+def test_blocking_under_lock_one_level_indirection(tmp_path):
+    res = lint(tmp_path, """
+    import time
+
+    class C:
+        def flip(self):
+            with self._swap_lock:
+                self._settle()
+
+        def _settle(self):
+            time.sleep(0.5)
+    """, rules=["blocking-under-lock"])
+    assert rules_of(res) == ["blocking-under-lock"]
+    assert "_settle" in res.findings[0].message
+
+
+# -- lock-order -------------------------------------------------------------
+
+
+def test_lock_order_flags_ab_ba_cycle(tmp_path):
+    res = lint(tmp_path, """
+    class C:
+        def one(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+
+        def two(self):
+            with self._b_lock:
+                with self._a_lock:
+                    pass
+    """, rules=["lock-order"])
+    assert rules_of(res) == ["lock-order"]
+    assert "cycle" in res.findings[0].message
+
+
+def test_lock_order_flags_cycle_through_call(tmp_path):
+    res = lint(tmp_path, """
+    class C:
+        def one(self):
+            with self._a_lock:
+                self._takes_b()
+
+        def _takes_b(self):
+            with self._b_lock:
+                pass
+
+        def two(self):
+            with self._b_lock:
+                with self._a_lock:
+                    pass
+    """, rules=["lock-order"])
+    assert rules_of(res) == ["lock-order"]
+
+
+def test_lock_order_flags_reacquisition(tmp_path):
+    res = lint(tmp_path, """
+    class C:
+        def one(self):
+            with self._lock:
+                with self._lock:
+                    pass
+    """, rules=["lock-order"])
+    assert rules_of(res) == ["lock-order"]
+    assert "re-acquisition" in res.findings[0].message
+
+
+def test_lock_order_consistent_nesting_is_clean(tmp_path):
+    res = lint(tmp_path, """
+    class C:
+        def one(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+
+        def two(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+
+        def distinct_classes_dont_alias(self):
+            with self._b_lock:
+                pass
+    """, rules=["lock-order"])
+    assert res.findings == []
+
+
+# -- host-sync-hot-path -----------------------------------------------------
+
+JIT_PREAMBLE = """
+    import jax
+    import numpy as np
+
+    class C:
+        def __init__(self):
+            self._burst_fn = jax.jit(step, static_argnums=(2,))
+"""
+
+
+def test_host_sync_flags_cast_on_jitted_result(tmp_path):
+    res = lint(tmp_path, JIT_PREAMBLE + """
+        def _loop(self):
+            toks = self._burst_fn(self.params, self.cache, 8)
+            if int(toks):  # implicit sync in the hot loop
+                return np.asarray(toks)
+    """, rules=["host-sync-hot-path"])
+    assert rules_of(res) == ["host-sync-hot-path"] * 2
+
+
+def test_host_sync_flags_item_and_block_until_ready(tmp_path):
+    res = lint(tmp_path, JIT_PREAMBLE + """
+        def _loop(self):
+            self._helper()
+
+        def _helper(self):
+            self.cur.block_until_ready()
+            return self.tok.item()
+    """, rules=["host-sync-hot-path"])
+    assert len(res.findings) == 2
+    assert all("_helper" in f.message for f in res.findings)
+
+
+def test_host_sync_not_flagging_cold_paths_or_metadata(tmp_path):
+    """Casts outside poll-reachable code, casts of untracked values, and
+    metadata reads (.nbytes/.shape) off jitted results are all fine."""
+    res = lint(tmp_path, JIT_PREAMBLE + """
+        def _loop(self):
+            slab = self._burst_fn(self.params, self.cache, 8)
+            nbytes = int(slab.nbytes)      # metadata: no device round-trip
+            depth = int(self.depth_host)   # host value: fine
+            return nbytes, depth
+
+        def export(self):  # not reachable from _loop
+            out = self._burst_fn(self.params, self.cache, 8)
+            return np.asarray(out)  # designed host pull on a cold path
+    """, rules=["host-sync-hot-path"])
+    assert res.findings == []
+
+
+# -- retrace-hazard ---------------------------------------------------------
+
+
+def test_retrace_flags_len_and_float_at_static_positions(tmp_path):
+    res = lint(tmp_path, JIT_PREAMBLE + """
+        def _loop(self):
+            self._burst_fn(self.params, self.cache, len(self.lanes))
+            self._burst_fn(self.params, self.cache, 0.5)
+    """, rules=["retrace-hazard"])
+    assert rules_of(res) == ["retrace-hazard"] * 2
+    assert "len(...)" in res.findings[0].message
+
+
+def test_retrace_not_flagging_bucketized_statics(tmp_path):
+    res = lint(tmp_path, JIT_PREAMBLE + """
+        def _loop(self):
+            g = self._bucket(len(self.lanes))
+            self._burst_fn(self.params, self.cache, g)
+
+        def _bucket(self, n):
+            return 8
+    """, rules=["retrace-hazard"])
+    assert res.findings == []
+
+
+# -- metric-drift -----------------------------------------------------------
+
+METRICS_MOD = """
+    class MetricsRegistry:
+        _SLO_TIMERS = {
+            "gen_ttft_ms": "seldon_engine_generate_ttft_seconds",
+        }
+"""
+EMITTER_MOD = """
+    def metrics(self):
+        return [{"type": "TIMER", "key": "gen_ttft_ms", "value": 1.0}]
+"""
+
+
+def _write_pkg(tmp_path, metrics_src, emitter_src, docs_text):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "engine_metrics.py").write_text(textwrap.dedent(metrics_src))
+    (pkg / "server.py").write_text(textwrap.dedent(emitter_src))
+    docs = tmp_path / "docs.md"
+    docs.write_text(textwrap.dedent(docs_text))
+    return pkg, docs
+
+
+def test_metric_drift_clean_when_all_four_agree(tmp_path):
+    pkg, docs = _write_pkg(
+        tmp_path, METRICS_MOD, EMITTER_MOD,
+        "Watch `seldon_engine_generate_ttft_seconds` for TTFT.",
+    )
+    res = core.run_lint(
+        [str(pkg)], root=str(tmp_path), docs=[str(docs)],
+        rules=["metric-drift"],
+    )
+    assert res.findings == []
+
+
+def test_metric_drift_renamed_metric_not_in_docs(tmp_path):
+    """The acceptance fixture: a renamed series the docs don't know."""
+    pkg, docs = _write_pkg(
+        tmp_path,
+        METRICS_MOD.replace(
+            "seldon_engine_generate_ttft_seconds",
+            "seldon_engine_generate_first_token_seconds",  # renamed
+        ),
+        EMITTER_MOD,
+        "Watch `seldon_engine_generate_ttft_seconds` for TTFT.",
+    )
+    res = core.run_lint(
+        [str(pkg)], root=str(tmp_path), docs=[str(docs)],
+        rules=["metric-drift"],
+    )
+    got = {(f.rule, f.path.split("/")[-1]) for f in res.findings}
+    # undocumented new name (code side) AND stale documented name (docs side)
+    assert ("metric-drift", "engine_metrics.py") in got
+    assert ("metric-drift", "docs.md") in got
+
+
+def test_metric_drift_unemitted_mapped_key(tmp_path):
+    pkg, docs = _write_pkg(
+        tmp_path, METRICS_MOD,
+        EMITTER_MOD.replace("gen_ttft_ms", "gen_first_tok_ms"),
+        "Watch `seldon_engine_generate_ttft_seconds` for TTFT.",
+    )
+    res = core.run_lint(
+        [str(pkg)], root=str(tmp_path), docs=[str(docs)],
+        rules=["metric-drift"],
+    )
+    assert any("emitted by no server" in f.message for f in res.findings)
+
+
+def test_metric_drift_tool_referencing_unknown_metric(tmp_path):
+    pkg, docs = _write_pkg(
+        tmp_path, METRICS_MOD, EMITTER_MOD,
+        "Watch `seldon_engine_generate_ttft_seconds` for TTFT.",
+    )
+    tools = tmp_path / "tools"
+    tools.mkdir()
+    (tools / "report.py").write_text(
+        'SERIES = "seldon_engine_generate_latency_seconds"\n'
+    )
+    res = core.run_lint(
+        [str(pkg), str(tools)], root=str(tmp_path), docs=[str(docs)],
+        rules=["metric-drift"],
+    )
+    assert any(
+        "tool references metric" in f.message and f.path == "tools/report.py"
+        for f in res.findings
+    )
+
+
+# -- annotation-drift -------------------------------------------------------
+
+
+def test_annotation_drift_both_directions(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "parse.py").write_text(
+        'A = meta.get("seldon.io/retries")\n'
+        'B = meta.get("seldon.io/new-knob")\n'
+    )
+    docs = tmp_path / "docs.md"
+    docs.write_text(
+        "| `seldon.io/retries` | 0 | retries |\n"
+        "| `seldon.io/old-knob` | — | removed |\n"
+    )
+    res = core.run_lint(
+        [str(pkg)], root=str(tmp_path), docs=[str(docs)],
+        rules=["annotation-drift"],
+    )
+    msgs = " | ".join(f.message for f in res.findings)
+    assert "seldon.io/new-knob" in msgs  # parsed, undocumented
+    assert "seldon.io/old-knob" in msgs  # documented, unparsed
+    assert "seldon.io/retries" not in msgs
+
+
+def test_annotation_drift_prefix_family(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "parse.py").write_text('PREFIX = "seldon.io/engine-env-"\n')
+    docs = tmp_path / "docs.md"
+    docs.write_text("| `seldon.io/engine-env-<NAME>` | — | env prefix |\n")
+    res = core.run_lint(
+        [str(pkg)], root=str(tmp_path), docs=[str(docs)],
+        rules=["annotation-drift"],
+    )
+    assert res.findings == []
+
+
+# -- wall-clock -------------------------------------------------------------
+
+
+def test_wall_clock_flags_interval_math(tmp_path):
+    res = lint(tmp_path, """
+    import time
+
+    def wait(timeout_s):
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            pass
+    """, rules=["wall-clock"])
+    assert rules_of(res) == ["wall-clock"] * 2
+
+
+def test_wall_clock_allows_anchors_and_monotonic(tmp_path):
+    res = lint(tmp_path, """
+    import time
+
+    class R:
+        def submit(self):
+            self.submit_t = time.monotonic()
+            self.submit_wall_us = int(time.time() * 1e6)  # named anchor
+
+    _WALL_ANCHOR_US = int(time.time() * 1e6)
+    """, rules=["wall-clock"])
+    assert res.findings == []
+
+
+# -- suppression + baseline semantics ---------------------------------------
+
+
+def test_suppression_same_line_and_line_above(tmp_path):
+    res = lint(tmp_path, """
+    import time
+
+    def a():
+        t = time.time()  # seldon-lint: disable=wall-clock
+
+    def b():
+        # seldon-lint: disable=wall-clock
+        t = time.time()
+
+    def c():
+        t = time.time()  # unsuppressed
+    """, rules=["wall-clock"])
+    assert len(res.findings) == 1
+    assert len(res.suppressed) == 2
+    assert res.findings[0].line_text.endswith("# unsuppressed")
+
+
+def test_suppression_wrong_rule_does_not_silence(tmp_path):
+    res = lint(tmp_path, """
+    import time
+
+    def a():
+        t = time.time()  # seldon-lint: disable=thread-role
+    """, rules=["wall-clock"])
+    assert len(res.findings) == 1
+
+
+def test_suppression_code_on_previous_line_does_not_leak(tmp_path):
+    """A trailing directive belongs to ITS line only — it must not
+    silence a finding on the following line."""
+    res = lint(tmp_path, """
+    import time
+
+    def a():
+        x = time.time()  # seldon-lint: disable=wall-clock
+        y = time.time()
+    """, rules=["wall-clock"])
+    assert len(res.findings) == 1
+    assert len(res.suppressed) == 1
+
+
+def test_baseline_covers_existing_and_catches_new(tmp_path):
+    src = """
+    import time
+
+    def a():
+        return time.time()
+    """
+    res = lint(tmp_path, src, rules=["wall-clock"])
+    assert len(res.findings) == 1
+    baseline_path = tmp_path / "baseline.json"
+    core.write_baseline(str(baseline_path), res.findings)
+    baseline = core.load_baseline(str(baseline_path))
+
+    # the baselined finding no longer fails the gate
+    res2 = lint(tmp_path, src, rules=["wall-clock"], baseline=baseline)
+    assert res2.findings == [] and len(res2.baselined) == 1
+
+    # a NEW finding on a different line still fails
+    res3 = lint(tmp_path, src + """
+    def b():
+        return time.time() + 1
+    """, rules=["wall-clock"], baseline=baseline)
+    assert len(res3.findings) == 1
+    assert "time.time() + 1" in res3.findings[0].line_text
+
+
+def test_baseline_counts_are_per_occurrence(tmp_path):
+    """Two identical lines, one accepted: the second stays a finding."""
+    src = """
+    import time
+
+    def a():
+        return time.time()
+    """
+    res = lint(tmp_path, src, rules=["wall-clock"])
+    bl_path = tmp_path / "bl.json"
+    core.write_baseline(str(bl_path), res.findings)
+    res2 = lint(tmp_path, src + """
+    def b():
+        return time.time()
+    """, rules=["wall-clock"], baseline=core.load_baseline(str(bl_path)))
+    assert len(res2.baselined) == 1
+    assert len(res2.findings) == 1
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    res = lint(tmp_path, "def broken(:\n", rules=["wall-clock"])
+    assert [f.rule for f in res.findings] == ["parse-error"]
+
+
+# -- acceptance-criteria fixtures (one per deliberate break) ----------------
+
+
+def test_acceptance_device_mutation_reachable_from_submit(tmp_path):
+    """ISSUE acceptance: a device mutation reachable from submit."""
+    res = lint(tmp_path, ROLE_PREAMBLE + """
+    class ContinuousBatcher:
+        @caller_thread
+        def submit(self, req):
+            self._shed_check(req)
+            self._start_chunked(0, req)  # BROKEN: bypasses the queue
+
+        def _shed_check(self, req):
+            pass
+
+        @scheduler_only
+        def _start_chunked(self, slot, req):
+            self._cache["k"] = req
+    """, rules=["thread-role"])
+    assert rules_of(res) == ["thread-role"]
+
+
+def test_acceptance_sleep_under_lock(tmp_path):
+    """ISSUE acceptance: a time.sleep under _lock."""
+    res = lint(tmp_path, """
+    import time
+
+    class B:
+        def _do_swap(self, swap):
+            with self._swap_lock:
+                time.sleep(0.01)  # BROKEN: drain-wait under the mutex
+    """, rules=["blocking-under-lock"])
+    assert rules_of(res) == ["blocking-under-lock"]
+
+
+def test_acceptance_renamed_metric_not_in_docs(tmp_path):
+    """ISSUE acceptance: renamed metric not reflected in docs — covered
+    in detail by test_metric_drift_renamed_metric_not_in_docs; this one
+    pins the CLI-visible behavior (exit code 1)."""
+    pkg, docs = _write_pkg(
+        tmp_path,
+        METRICS_MOD.replace("ttft", "renamed"), EMITTER_MOD,
+        "Watch `seldon_engine_generate_ttft_seconds`.",
+    )
+    res = core.run_lint(
+        [str(pkg)], root=str(tmp_path), docs=[str(docs)],
+        rules=["metric-drift"],
+    )
+    assert res.exit_code == 1
+
+
+# -- CLI + repo gate --------------------------------------------------------
+
+
+def test_cli_gate_is_clean_on_the_repo():
+    """The shipped tree must pass its own gate: zero unsuppressed,
+    non-baselined findings over the exact CI invocation."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "seldon_lint.py"),
+         "seldon_core_tpu", "tools"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_write_baseline_roundtrip(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text("import time\nT = time.time()\n")
+    bl = tmp_path / "bl.json"
+    argv = [sys.executable, os.path.join(REPO, "tools", "seldon_lint.py"),
+            "--root", str(tmp_path), "--baseline", str(bl),
+            "--rules", "wall-clock", str(mod)]
+    proc = subprocess.run(argv, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1  # finding, no baseline yet
+    proc = subprocess.run(
+        argv + ["--write-baseline"], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0
+    data = json.loads(bl.read_text())
+    assert data["findings"] and data["findings"][0]["rule"] == "wall-clock"
+    proc = subprocess.run(argv, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0  # baselined now
+
+
+def test_lock_order_flags_reacquisition_through_call(tmp_path):
+    """Re-taking a held non-reentrant lock BEHIND a call is the same
+    deadlock as lexical re-nesting and must not slip past the rule."""
+    res = lint(tmp_path, """
+    class C:
+        def outer(self):
+            with self._lock:
+                self._helper()
+
+        def _helper(self):
+            with self._lock:
+                pass
+    """, rules=["lock-order"])
+    assert rules_of(res) == ["lock-order"]
+    assert "re-acquisition" in res.findings[0].message
+    assert "_helper" in res.findings[0].message

@@ -372,3 +372,52 @@ def test_probabilistic_sampling_gates_root_spans(monkeypatch):
         pass
     assert len(tracer.finished_spans()) == 1
     init_tracer(enabled=False)
+
+
+# -- wall_us: the monotonic-anchored wall clock (seldon-lint wall-clock
+# rule). Regression tests for the PR-8 fixes: span/flight-recorder
+# timestamps must be derived from time.monotonic() via the process
+# anchor, so an NTP step can never disorder spans or corrupt intervals.
+
+
+def test_wall_us_ignores_wall_clock_steps(monkeypatch):
+    """A backwards wall-clock step between two events must not reorder
+    their anchored timestamps (the old code stamped raw time.time())."""
+    a = tracing.wall_us()
+    monkeypatch.setattr(tracing.time, "time", lambda: 0.0)  # epoch jump
+    b = tracing.wall_us()
+    assert b >= a  # derived from monotonic: unaffected by the step
+
+
+def test_wall_us_places_past_monotonic_readings():
+    m0 = tracing.time.monotonic()
+    now = tracing.wall_us()
+    past = tracing.wall_us(m0)
+    assert past <= now
+    # the offset between the readings matches the monotonic gap (~0)
+    assert now - past < 1_000_000
+
+
+def test_span_start_us_survives_wall_step(monkeypatch):
+    tracer = Tracer(enabled=True)
+    with tracer.span("first"):
+        pass
+    monkeypatch.setattr(tracing.time, "time", lambda: 0.0)
+    with tracer.span("second"):
+        pass
+    first, second = tracer.finished_spans()[-2:]
+    assert second.start_us >= first.start_us
+
+
+def test_flight_recorder_t_us_survives_wall_step(monkeypatch):
+    """flight_report diffs t_us between records: ordering must follow
+    seq even when the wall clock steps backwards mid-run."""
+    from seldon_core_tpu.serving import flightrecorder as fr
+
+    rec = fr.FlightRecorder(capacity=4)
+    rec.record({"type": "poll"})
+    monkeypatch.setattr(tracing.time, "time", lambda: 0.0)
+    rec.record({"type": "poll"})
+    entries = rec.snapshot()
+    assert entries[1]["seq"] == entries[0]["seq"] + 1
+    assert entries[1]["t_us"] >= entries[0]["t_us"]

@@ -34,6 +34,10 @@ import time
 
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # runtime thread-role assertions (analysis/roles.py): a scheduler
+    # thread violation during chaos recovery fails the smoke loudly
+    # instead of corrupting device state (must precede seldon imports)
+    os.environ.setdefault("SELDON_DEBUG_THREADS", "1")
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
     import http.client
 

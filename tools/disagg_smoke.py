@@ -31,6 +31,10 @@ import tempfile
 
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # runtime thread-role assertions (analysis/roles.py): remote-admit /
+    # failover paths run on worker threads — a scheduler-thread violation
+    # fails the smoke loudly (must precede seldon imports)
+    os.environ.setdefault("SELDON_DEBUG_THREADS", "1")
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
     import http.client
 
