@@ -101,6 +101,16 @@ class MetricsRegistry:
         "gen_peer_readmissions": "seldon_engine_peer_readmissions",
         "gen_degraded_local_prefill":
             "seldon_engine_degraded_local_prefill",
+        # HBM pressure: decode-lane preemptions + recompute-resumes, the
+        # admission-watermark sheds/refusals, and the reclaim ladder's
+        # prefix evictions — the observable half of the pressure matrix
+        # in docs/operate.md "Failure modes & recovery"
+        "gen_preemptions": "seldon_engine_preemptions",
+        "gen_preempt_resumes": "seldon_engine_preemption_resumes",
+        "gen_pressure_sheds": "seldon_engine_pressure_sheds",
+        "gen_pressure_refused": "seldon_engine_pressure_refused",
+        "gen_pressure_prefix_evictions":
+            "seldon_engine_pressure_prefix_evictions",
     }
 
     # first-class health gauge: 1 = the generate scheduler is serving,
@@ -108,6 +118,13 @@ class MetricsRegistry:
     # view an alert can watch across the fleet)
     _RECOVERY_GAUGES = {
         "gen_batcher_healthy": "seldon_engine_batcher_healthy",
+        # HBM-pressure ledger levels: used vs budget, and whether the
+        # high watermark is latched (1 = pressure active, admissions
+        # shedding until reclaim reaches the low watermark)
+        "gen_pressure_used_bytes": "seldon_engine_pressure_used_bytes",
+        "gen_pressure_budget_bytes":
+            "seldon_engine_pressure_budget_bytes",
+        "gen_pressure_active": "seldon_engine_pressure_active",
     }
 
     # generate SLO TIMERs (per completed request, shipped by the generate

@@ -564,6 +564,11 @@ class EngineApp:
                         headers={"Retry-After": str(max(1, int(after + 0.5)))
                                  if after else "1"},
                     )
+                if status == 413:
+                    # over-bucket prompt / prompt+budget past max_seq:
+                    # the typed 413 the unary path answers, not a
+                    # generic 400
+                    return Response(error_body(413, str(e)), 413)
                 if isinstance(e, (ValueError, RuntimeError)):
                     return Response(error_body(400, str(e)), 400)
                 raise
@@ -699,6 +704,11 @@ class EngineApp:
                     code = grpc.StatusCode.DEADLINE_EXCEEDED
                 elif e.status == 503:
                     code = grpc.StatusCode.UNAVAILABLE
+                elif e.status in (400, 413):
+                    # client-fault requests (over-bucket prompt,
+                    # prompt+budget past max_seq): typed INVALID_ARGUMENT,
+                    # never INTERNAL — retrying unchanged cannot succeed
+                    code = grpc.StatusCode.INVALID_ARGUMENT
                 else:
                     code = grpc.StatusCode.INTERNAL
                 await context.abort(code, e.info)

@@ -2175,6 +2175,170 @@ def bench_chaos(
     }
 
 
+def bench_pressure(
+    root: str,
+    n_requests: int = 8,
+    prompt_len: int = 6,
+    max_new_tokens: int = 24,
+    slots: int = 4,
+    steps_per_poll: int = 4,
+    config: Optional[Dict[str, Any]] = None,
+    deadline_s: float = 120.0,
+    shrink_lanes: float = 1.3,
+    after_polls: int = 4,
+    restore_after_polls: int = 24,
+    label: str = "llm-pressure",
+) -> Dict[str, Any]:
+    """HBM-pressure chaos window: the ledger budget shrinks mid-run (the
+    ``SELDON_FAULTS`` pressure grammar's hook) to roughly one decode
+    lane's live footprint, forcing the real reclaim ladder — admission
+    watermark holds, decode-lane preemption with checkpoint-to-host,
+    recompute-resume — then restores so every preempted request
+    completes.
+
+    The acceptance bits: every request completes (zero hangs — the
+    min-one-lane rule guarantees forward progress under any budget);
+    greedy AND seeded-sampling outputs are byte-identical to the
+    pressure-free run (recompute-resume continues the exact sampling
+    stream from the checkpointed RNG key); at least one preemption
+    actually fired (the window exercised the mechanism, not just the
+    watermarks); and TTFT inflation stays bounded (preemption trades
+    tail latency for survival, never correctness). With
+    ``hbm_ledger_bytes=0`` the serving path is byte-identical to a
+    pre-pressure build (off-by-default convention)."""
+    from .resilience.faults import FaultInjector
+    from .servers.generateserver import GenerateServer
+
+    cfg = dict(config or {})
+    cfg.setdefault("max_seq", 64)
+    model_dir = write_model_dir(root, "llm", cfg)
+    vocab = cfg.get("vocab_size", 256)
+    common = dict(
+        model_uri=model_dir, steps_per_poll=steps_per_poll,
+        warmup_prompt_lens=[prompt_len],
+        warmup_max_new_tokens=max_new_tokens,
+    )
+    rs = np.random.RandomState(17)
+    prompts = [rs.randint(1, vocab, prompt_len).tolist()
+               for _ in range(n_requests)]
+    greedy_kw = dict(max_new_tokens=max_new_tokens, temperature=0.0,
+                     eos_id=None, seed=0)
+
+    # pressure-free reference (and per-request TTFT baseline)
+    ref = GenerateServer(slots=slots, **common)
+    ref.load()
+    refs = [ref.batcher.generate(list(p), **greedy_kw) for p in prompts]
+    srefs = [
+        ref.batcher.generate(
+            list(p), max_new_tokens=max_new_tokens, temperature=0.8,
+            eos_id=None, seed=100 + i,
+        )
+        for i, p in enumerate(prompts)
+    ]
+    ref_stats = dict(ref.batcher.stats)
+    ref_ttft = (
+        ref_stats["ttft_s_sum"] / max(1, ref_stats["slo_samples"])
+    )
+    ref.close()
+
+    srv = GenerateServer(slots=slots, hbm_ledger_bytes=1 << 40, **common)
+    srv.load()
+    b = srv.batcher
+    # shrink to ~shrink_lanes decode lanes at end-of-generation depth:
+    # small enough that a full slot pool must preempt, large enough that
+    # one lane always fits (the no-livelock floor)
+    lane_bytes = b._attn_need(prompt_len + max_new_tokens) * b._kv_key_bytes
+    shrink_to = max(1, int(shrink_lanes * lane_bytes))
+
+    def arm(polls_from_now: int) -> None:
+        # after_polls is in WORKING polls (the pressure hook's clock),
+        # so the shrink lands mid-window regardless of idle churn
+        inj = FaultInjector([], pressure={
+            "shrink_to_bytes": shrink_to,
+            "after_polls": b._work_poll_count + polls_from_now,
+            "restore_after_polls": restore_after_polls,
+        })
+        b.pressure_hook = inj.pressure_hook()
+
+    def run_window(submits) -> Dict[str, Any]:
+        futs = [s() for s in submits]
+        outs, slowest = [], 0.0
+        for f in futs:
+            t0 = time.perf_counter()
+            try:
+                outs.append(f.result(timeout=deadline_s))
+            except Exception as e:  # noqa: BLE001 - typed failures counted
+                outs.append(type(e).__name__)
+            slowest = max(slowest, time.perf_counter() - t0)
+        return {"outs": outs, "slowest_s": slowest}
+
+    t_start = time.perf_counter()
+    try:
+        s0 = dict(b.stats)
+        arm(after_polls)
+        g = run_window([
+            (lambda p=p: b.submit(list(p), **greedy_kw)) for p in prompts
+        ])
+        greedy_identical = g["outs"] == refs
+        arm(after_polls)
+        s_win = run_window([
+            (lambda p=p, i=i: b.submit(
+                list(p), max_new_tokens=max_new_tokens, temperature=0.8,
+                eos_id=None, seed=100 + i,
+            ))
+            for i, p in enumerate(prompts)
+        ])
+        sampled_identical = s_win["outs"] == srefs
+        slowest_s = max(g["slowest_s"], s_win["slowest_s"])
+        stats = dict(b.stats)
+        ttft = (
+            (stats["ttft_s_sum"] - s0["ttft_s_sum"])
+            / max(1, stats["slo_samples"] - s0["slo_samples"])
+        )
+        pressure = b.pressure_summary() or {}
+    finally:
+        elapsed = time.perf_counter() - t_start
+        srv.close()
+
+    completed_all = all(isinstance(o, list) for o in g["outs"] + s_win["outs"])
+    ttft_inflation = round(ttft / ref_ttft, 2) if ref_ttft > 0 else None
+    tokens_done = 2 * n_requests * max_new_tokens if completed_all else 0
+    return {
+        "model": label,
+        "scenario": (
+            "mid-run HBM-ledger shrink to ~1 lane: admission watermark "
+            "holds, decode-lane preemption + recompute-resume, budget "
+            "restore; byte-identity (greedy + seeded sampling), zero "
+            "hangs, bounded TTFT inflation"
+        ),
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new_tokens,
+        "requests_total": 2 * n_requests,
+        "shrink_to_bytes": shrink_to,
+        # the acceptance bits
+        "greedy_identical": greedy_identical,
+        "sampled_identical": sampled_identical,
+        "completed_all": completed_all,
+        "no_hang": slowest_s <= deadline_s,
+        "slowest_request_s": round(slowest_s, 3),
+        "preemptions": stats["preemptions"],
+        "preempt_resumes": stats["preempt_resumes"],
+        "preemption_exercised": stats["preemptions"] >= 1,
+        "pressure_sheds": stats["pressure_sheds"],
+        "pressure_prefix_evictions": stats["pressure_prefix_evictions"],
+        "pressure_activations": pressure.get("activations", 0),
+        "ttft_ms": round(ttft * 1e3, 3),
+        "ttft_baseline_ms": round(ref_ttft * 1e3, 3),
+        "ttft_inflation_x": ttft_inflation,
+        # generous CI-stable bound: preemption may trade tail latency for
+        # survival but must never park TTFT anywhere near the hang budget
+        "ttft_bounded": ttft <= max(2.0, 20.0 * ref_ttft),
+        "tokens_per_s": round(tokens_done / max(elapsed, 1e-9), 2),
+        "p50_ms": None,
+        "p99_ms": None,
+    }
+
+
 def _ablate_generate(
     root: str,
     base_kw: Dict[str, Any],
@@ -2360,6 +2524,19 @@ def run_model_tier(
             # exercised (chip scales the same harness)
             results["llm_1b_chaos"] = bench_chaos(
                 root, n_requests=4, prompt_len=6, max_new_tokens=8,
+                slots=2, steps_per_poll=4,
+                config={
+                    "vocab_size": 256, "d_model": 32, "n_layers": 2,
+                    "n_heads": 2, "n_kv_heads": 2, "d_ff": 64, "max_seq": 64,
+                },
+            )
+            # overload-as-a-scenario proof: the HBM ledger shrinks to ~1
+            # lane mid-run — decode lanes preempt (checkpoint-to-host),
+            # requests requeue and recompute-resume byte-identically
+            # (greedy + seeded sampling), nothing hangs, TTFT inflation
+            # stays bounded (chip scales the same harness)
+            results["llm_1b_pressure"] = bench_pressure(
+                root, n_requests=6, prompt_len=6, max_new_tokens=16,
                 slots=2, steps_per_poll=4,
                 config={
                     "vocab_size": 256, "d_model": 32, "n_layers": 2,
@@ -2701,6 +2878,16 @@ def run_model_tier(
             results["llm_1b_chaos"] = bench_chaos(
                 root, label="llm-1.26b-chaos",
                 n_requests=4, prompt_len=128, max_new_tokens=32,
+                slots=4, steps_per_poll=16,
+                config={**big_cfg, "max_seq": 256},
+            )
+            # pressure at flagship scale: preemption checkpoints and
+            # recompute-resumes are paid at real model size (a 1.26B
+            # recompute prefill is the true preemption price), byte-
+            # identity and the no-hang bound still required
+            results["llm_1b_pressure"] = bench_pressure(
+                root, label="llm-1.26b-pressure",
+                n_requests=8, prompt_len=128, max_new_tokens=64,
                 slots=4, steps_per_poll=16,
                 config={**big_cfg, "max_seq": 256},
             )

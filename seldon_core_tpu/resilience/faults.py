@@ -44,9 +44,22 @@ in the continuous batcher's loop (the supervised crash-restart path):
 raises on the Nth poll (``times`` deaths max, spaced ``die_after_polls``
 apart), exercising BatcherDead + rebuild end to end.
 
+Pressure faults: a top-level ``pressure`` section shrinks the continuous
+batcher's HBM ledger budget mid-run, driving the REAL reclaim ladder
+(prefix eviction, speculation cancel, decode-lane preemption +
+recompute-resume, admission watermark sheds) rather than a synthetic
+trigger: ``{"pressure": {"shrink_to_bytes": 65536, "after_polls": 20,
+"restore_after_polls": 100}}`` — on the Nth *working* poll (polls with
+live lanes or queued work — idle churn doesn't tick the clock, so the
+shrink always lands relative to traffic) the ledger budget drops to
+``shrink_to_bytes``; ``restore_after_polls`` working polls later
+(optional) the boot budget is restored so preempted requests resume and
+complete.
+
 Env wiring: ``SELDON_FAULTS`` holds the JSON config
-(``{"seed": 7, "rules": [{...}], "scheduler": {...}}``) or
-``@/path/to/faults.json``. See docs/operate.md "Resilience".
+(``{"seed": 7, "rules": [{...}], "scheduler": {...},
+"pressure": {...}}``) or ``@/path/to/faults.json``. See
+docs/operate.md "Resilience".
 """
 
 from __future__ import annotations
@@ -106,7 +119,7 @@ class FaultRule:
 
 
 class FaultInjector:
-    def __init__(self, rules, seed: int = 0, scheduler=None):
+    def __init__(self, rules, seed: int = 0, scheduler=None, pressure=None):
         self.seed = int(seed)
         self.rules: List[FaultRule] = [
             r if isinstance(r, FaultRule) else FaultRule(**r) for r in rules
@@ -114,6 +127,10 @@ class FaultInjector:
         # scheduler-level induced poll death: {"die_after_polls": N,
         # "times": M} — wired onto ContinuousBatcher.fault_hook
         self.scheduler = dict(scheduler or {})
+        # HBM-ledger shrink window: {"shrink_to_bytes": B,
+        # "after_polls": N, "restore_after_polls": M} — wired onto
+        # ContinuousBatcher.pressure_hook
+        self.pressure = dict(pressure or {})
         self._rngs: Dict[Tuple[str, str], random.Random] = {}
         self._calls: Dict[Tuple[str, str], int] = {}
         # observability for tests/bench: what actually got injected
@@ -132,6 +149,7 @@ class FaultInjector:
             cfg.get("rules") or [],
             seed=cfg.get("seed", 0),
             scheduler=cfg.get("scheduler"),
+            pressure=cfg.get("pressure"),
         )
 
     def _rng(self, unit: str, method: str) -> random.Random:
@@ -227,6 +245,37 @@ class FaultInjector:
                     f"injected scheduler poll death "
                     f"{state['deaths']}/{times} at poll {poll_count}",
                 )
+
+        return hook
+
+    def pressure_hook(self):
+        """Ledger re-budget hook for ContinuousBatcher.pressure_hook, or
+        None when no pressure section is configured. Returns the new
+        budget (``shrink_to_bytes``) on the configured poll, ``-1`` (the
+        restore-boot-budget sentinel) ``restore_after_polls`` polls
+        later, and None in between — so the shrink window drives the
+        real reclaim ladder and then lets preempted requests resume."""
+        shrink = int(self.pressure.get("shrink_to_bytes", 0) or 0)
+        after = int(self.pressure.get("after_polls", 0) or 0)
+        if shrink <= 0 or after <= 0:
+            return None
+        restore = self.pressure.get("restore_after_polls")
+        state = {"fired_at": None, "restored": False}
+
+        def hook(poll_count: int):
+            if state["fired_at"] is None:
+                if poll_count >= after:
+                    state["fired_at"] = poll_count
+                    return shrink
+                return None
+            if (
+                restore is not None
+                and not state["restored"]
+                and poll_count - state["fired_at"] >= int(restore)
+            ):
+                state["restored"] = True
+                return -1
+            return None
 
         return hook
 

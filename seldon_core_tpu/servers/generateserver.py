@@ -91,6 +91,19 @@ Model URI layout: same ``jax_config.json`` as jaxserver with
     restart_backoff_s
                      initial crash-restart backoff (exponential,
                      default 0.5)
+    hbm_ledger_bytes HBM-pressure budget for the scheduler's unified
+                     ledger (live decode footprint + staging slabs +
+                     prefix cache + pending-swap double buffer; 0 =
+                     off, the disable flag). Over the high watermark
+                     the reclaim ladder runs: evict prefixes, cancel
+                     speculation, preempt decode lanes
+                     (checkpoint-to-host + recompute-resume, byte-
+                     identical output), shed admissions — see
+                     docs/generate.md "HBM pressure & preemption"
+    pressure_high    high watermark as a fraction of the ledger budget
+                     (default 0.90): crossing it latches pressure
+    pressure_low     low watermark (default 0.75): reclaim runs until
+                     usage drops here, then admissions resume
 
 Request (jsonData)::
 
@@ -164,6 +177,9 @@ class GenerateServer(SeldonComponent):
         peer_eject_backoff_s: float = 1.0,
         restart_budget: int = 3,
         restart_backoff_s: float = 0.5,
+        hbm_ledger_bytes: int = 0,
+        pressure_high: float = 0.90,
+        pressure_low: float = 0.75,
         warmup_prompt_lens: Optional[Sequence[int]] = None,
         warmup_max_new_tokens: int = 0,
         **kwargs,
@@ -181,6 +197,9 @@ class GenerateServer(SeldonComponent):
         self._peer_eject_backoff_s = float(peer_eject_backoff_s)
         self._restart_budget = int(restart_budget)
         self._restart_backoff_s = float(restart_backoff_s)
+        self._hbm_ledger_bytes = int(hbm_ledger_bytes)
+        self._pressure_high = float(pressure_high)
+        self._pressure_low = float(pressure_low)
         self._kv_server = None   # PrefillTransportServer (prefill role)
         self._kv_client = None   # FailoverKVClient over the peer list (decode)
         self._faults = None      # FaultInjector (chaos harness), set at load
@@ -325,9 +344,13 @@ class GenerateServer(SeldonComponent):
             flight_recorder_capacity=self._flight_recorder,
             restart_budget=self._restart_budget,
             restart_backoff_s=self._restart_backoff_s,
+            hbm_ledger_bytes=self._hbm_ledger_bytes,
+            pressure_high=self._pressure_high,
+            pressure_low=self._pressure_low,
         )
         # chaos harness (off without SELDON_FAULTS): the scheduler
-        # section wires induced poll death onto the batcher's fault hook;
+        # section wires induced poll death onto the batcher's fault
+        # hook, the pressure section wires mid-run ledger re-budgeting;
         # kv rules are resolved per peer when transports are built below
         from ..resilience import FaultInjector
 
@@ -336,6 +359,9 @@ class GenerateServer(SeldonComponent):
             hook = self._faults.scheduler_hook()
             if hook is not None:
                 self.batcher.fault_hook = hook
+            phook = self._faults.pressure_hook()
+            if phook is not None:
+                self.batcher.pressure_hook = phook
         if self._warmup_prompt_lens:
             # compile-before-listen: every prefill/insert/burst variant the
             # declared traffic shape needs is built here, so the first
@@ -498,11 +524,26 @@ class GenerateServer(SeldonComponent):
                 "decode role has no prefill peer (set `peer` or call "
                 "set_peer())"
             )
+        # bounds-check BEFORE the handoff: over the TCP transport a
+        # prefill-side PromptTooLong/BudgetExceeded comes back as a
+        # generic error frame the failover layer reads as peer death —
+        # one unservable request must never eject healthy prefill peers
+        from ..serving.continuous import PromptTooLong
+
+        n = len(toks)
+        if n >= self.batcher.max_seq:
+            raise PromptTooLong(
+                f"prompt of {n} exceeds max_seq {self.batcher.max_seq}"
+            )
+        self.batcher._check_budget(n, kw.get("max_new_tokens", 32))
         # shed BEFORE the handoff costs anything: an overloaded decode
         # pool must not amplify load onto the prefill pool and the wire
         # only to reject the slab on arrival (admit_remote re-checks,
-        # but by then the transfer is paid)
-        self.batcher._shed_check(deadline_s)
+        # but by then the transfer is paid). remote=True makes an
+        # HBM-pressure refusal the typed PressureRefused (503 +
+        # Retry-After) — the decode pool pushes back to its prefill
+        # peers instead of half-admitting slabs.
+        self.batcher._shed_check(deadline_s, remote=True)
         if covered is None:
             covered = self.batcher.remote_covered_len(toks)
         request = {
@@ -900,6 +941,9 @@ class GenerateServer(SeldonComponent):
         out["slo"] = self.batcher.slo_summary()
         out["stats"] = {k: v for k, v in self.batcher.stats.items()}
         out["weight_version"] = self.batcher.weight_version
+        pressure = self.batcher.pressure_summary()
+        if pressure is not None:
+            out["pressure"] = pressure
         return out
 
     def metrics(self) -> List[Dict]:
@@ -968,6 +1012,31 @@ class GenerateServer(SeldonComponent):
         if s.get("degraded_local_prefill"):
             out.append(delta("gen_degraded_local_prefill",
                              s["degraded_local_prefill"]))
+        # HBM pressure: preemption/resume/shed counters plus the ledger
+        # gauges — engine_metrics maps them to the first-class
+        # seldon_engine_pressure_* / seldon_engine_preemptions series so
+        # an overload window is diagnosable straight off /metrics
+        if s.get("preemptions"):
+            out.append(delta("gen_preemptions", s["preemptions"]))
+        if s.get("preempt_resumes"):
+            out.append(delta("gen_preempt_resumes", s["preempt_resumes"]))
+        if s.get("pressure_sheds"):
+            out.append(delta("gen_pressure_sheds", s["pressure_sheds"]))
+        if s.get("pressure_refused"):
+            out.append(delta("gen_pressure_refused", s["pressure_refused"]))
+        if s.get("pressure_prefix_evictions"):
+            out.append(delta("gen_pressure_prefix_evictions",
+                             s["pressure_prefix_evictions"]))
+        pressure = self.batcher.pressure_summary()
+        if pressure is not None:
+            out.extend([
+                {"type": "GAUGE", "key": "gen_pressure_used_bytes",
+                 "value": float(pressure["used_bytes"])},
+                {"type": "GAUGE", "key": "gen_pressure_budget_bytes",
+                 "value": float(pressure["budget_bytes"])},
+                {"type": "GAUGE", "key": "gen_pressure_active",
+                 "value": 1.0 if pressure["active"] else 0.0},
+            ])
         if s.get("kv_exports") or s.get("kv_imports"):
             # disaggregated serving: slab/byte counters per direction plus
             # the transfer-dedup savings — engine_metrics maps these to

@@ -83,6 +83,26 @@ from ..tracing import wall_us
 logger = logging.getLogger(__name__)
 
 
+class PromptTooLong(ValueError):
+    """The request cannot fit the serving cache: the prompt exceeds
+    every prefill bucket and ``max_seq``. Carries a wire status so the
+    engine answers a typed **413** (REST) / ``INVALID_ARGUMENT`` (gRPC)
+    instead of a 500 traceback — the client sent an unservable request;
+    retrying it unchanged can never succeed."""
+
+    status = 413
+
+
+class BudgetExceeded(PromptTooLong):
+    """``prompt_len + max_new_tokens > max_seq``: the generation would
+    outgrow the decode cache. Rejected **at submit/admit time** with the
+    same 413-class status as :class:`PromptTooLong` — before this check
+    the overrun was silently clamped (a client asking for 512 tokens got
+    40 with no signal) and anything slipping past surfaced deep in the
+    scheduler as a shape error. Size ``max_seq`` to prompt + budget, or
+    lower ``max_new_tokens``."""
+
+
 class BatcherDead(RuntimeError):
     """The continuous batcher's scheduler loop is not serving: it died
     (in-flight work at crash time), its crash-loop budget is exhausted
@@ -141,6 +161,19 @@ class GenRequest:
     # "version"}) and skips local prefill entirely — the wave-routing
     # loop routes it to _admit_remote_lane (see admit_remote)
     remote: Optional[Dict[str, Any]] = None
+    # decode-lane preemption checkpoint ({"emitted": [...], "key":
+    # [hi, lo]}): set when a pressure reclaim evicted this request from
+    # its lane mid-decode. The K/V is NOT checkpointed — resume
+    # recomputes it with a prefill over prompt+generated-so-far and
+    # continues the exact sampling stream from the checkpointed
+    # post-split RNG lane key (see _admit_resume). None = never
+    # preempted, or preempted before any token was credited (a plain
+    # re-admit reproduces the identical stream from the seed alone).
+    resume: Optional[Dict[str, Any]] = None
+    # absolute deadline (monotonic seconds) when the submit carried a
+    # budget — the preemption victim policy reads it (a lane that must
+    # answer soon is preempted only after every deadline-free lane)
+    deadline_t: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -158,6 +191,10 @@ class _ChunkJob:
     # prompt tokens already covered by a spliced prefix-cache slab
     # (chunking then starts at the splice point)
     hit_tokens: int = 0
+    # preemption recompute-resume: (emitted tokens, checkpointed lane
+    # key) — the final chunk then inserts the checkpointed continuation
+    # state instead of its own sample and replays the emitted K/V
+    resume: Optional[Tuple[List[int], Any]] = None
 
 
 @dataclasses.dataclass
@@ -176,6 +213,9 @@ class _SwapJob:
     # (flight-recorder attribution), and polls spent draining them
     drain_lanes: Optional[int] = None
     waited_polls: int = 0
+    # double-buffered param bytes — the pressure ledger's "swap"
+    # component while the drain holds both versions resident
+    nbytes: int = 0
 
 
 @dataclasses.dataclass
@@ -235,6 +275,9 @@ class ContinuousBatcher:
         flight_recorder_capacity: int = 512,
         restart_budget: int = 3,
         restart_backoff_s: float = 0.5,
+        hbm_ledger_bytes: int = 0,
+        pressure_high: float = 0.90,
+        pressure_low: float = 0.75,
     ):
         import jax
         import jax.numpy as jnp
@@ -335,6 +378,11 @@ class ContinuousBatcher:
         # SELDON_FAULTS scheduler section; tests set it directly)
         self.fault_hook: Optional[Any] = None
         self._poll_count = 0
+        # WORKING polls only (lanes live, chunked jobs pending, bursts
+        # in flight, or queued work): the pressure hook's clock, so a
+        # SELDON_FAULTS shrink window lands relative to traffic instead
+        # of firing during idle churn
+        self._work_poll_count = 0
         # warm() records its arguments here so a crash-restart re-runs
         # the same precompile before resuming admissions
         self._warm_args: Optional[Dict[str, Any]] = None
@@ -390,6 +438,14 @@ class ContinuousBatcher:
             "batcher_restarts": 0,
             "peer_ejections": 0, "peer_readmissions": 0,
             "degraded_local_prefill": 0,
+            # HBM pressure: decode lanes preempted (checkpoint-to-host +
+            # requeue), recompute-resumes that landed, admissions shed /
+            # remote admits refused while over the high watermark, and
+            # prefix slabs the reclaim ladder evicted (a subset of
+            # prefix_evicted — the pressure-attributed share)
+            "preemptions": 0, "preempt_resumes": 0,
+            "pressure_sheds": 0, "pressure_refused": 0,
+            "pressure_prefix_evictions": 0,
         }
         # export_prefill runs on caller threads (the prefill transport's
         # handlers), concurrently with each other; its stat updates take
@@ -424,6 +480,29 @@ class ContinuousBatcher:
         # scheduler-level proof that no lane's read bound exceeds its
         # group's bucket
         self.trace_groups: Optional[List[Dict[str, Any]]] = None
+        # -- HBM pressure: unified ledger + watermark controller ----------
+        # live decode footprint + staging slabs + prefix cache + pending
+        # swap double buffer against hbm_ledger_bytes (0 = off: the hot
+        # loop never consults it). Over the HIGH watermark the reclaim
+        # ladder runs each poll (evict prefixes -> cancel speculation ->
+        # preempt lanes -> shed admissions) until usage drops to LOW.
+        from .pressure import PressureController
+
+        self._pressure = PressureController(
+            hbm_ledger_bytes, high=pressure_high, low=pressure_low
+        )
+        # chaos hook: called each poll with the poll count; a returned
+        # int re-budgets the ledger (-1 restores the boot budget) — the
+        # SELDON_FAULTS "pressure" section wires it (resilience.faults)
+        self.pressure_hook: Optional[Any] = None
+        # preempted requests awaiting recompute-resume: drained BEFORE
+        # the admit queue so a victim re-acquires a lane ahead of newer
+        # work (its recompute is the price already paid once)
+        self._resume_queue: "collections.deque" = collections.deque()
+        # reclaim rung 2: speculation cancelled under pressure (draft
+        # cache freed; plain bursts decode — greedy streams identical by
+        # the spec-exactness contract). Restored when pressure clears.
+        self._spec_suppressed = False
         # chunked-prefill jobs in flight, keyed by reserved slot
         self._chunked: Dict[int, _ChunkJob] = {}
         # -- live weight hot-swap -----------------------------------------
@@ -755,6 +834,53 @@ class ContinuousBatcher:
             )
             return toks, cur_tok, pos, new, keys
 
+        # -- preemption recompute-resume: teacher-forced decode replay -------
+        def replay_burst(params, cache, lane_ix, toks, act, start_pos,
+                         attn_len):
+            """Rebuild the K/V of already-emitted tokens for ONE gathered
+            lane by replaying them through the SAME fused decode step
+            that wrote them originally. A prefill over prompt+generated
+            would recompute those positions with different matmul shapes
+            — visibly different K/V at bf16, enough to flip a near-tied
+            argmax downstream — so byte-identical resume REQUIRES the
+            decode op. ``toks``/``act`` are a fixed-length (k) forced
+            chunk (pads inactive: their writes land at the unadvanced
+            position the lane's next real step overwrites before any
+            read). One executable per (k, attn_len) pair, same discipline
+            as group_burst; the gathered [1]-lane execution is bitwise
+            equal to the full-batch row (the depth-grouping invariant)."""
+            g_ks = [layer[lane_ix, :, :attn_len, :] for layer in cache["k"]]
+            g_vs = [layer[lane_ix, :, :attn_len, :] for layer in cache["v"]]
+            pos0 = jnp.full((1,), start_pos, jnp.int32)
+
+            def body(carry, x):
+                ks, vs, pos = carry
+                tok, a = x
+                _logits, ks, vs = model.decode_step_ragged_list(
+                    params, ks, vs, tok[None, None], pos, attn_len=None
+                )
+                pos = jnp.where(a, pos + 1, pos)
+                return (ks, vs, pos), None
+
+            (g_ks, g_vs, _pos), _ = lax.scan(
+                body, (g_ks, g_vs, pos0), (toks, act)
+            )
+            new = {
+                "k": [
+                    layer.at[lane_ix, :, :attn_len, :].set(g)
+                    for layer, g in zip(cache["k"], g_ks)
+                ],
+                "v": [
+                    layer.at[lane_ix, :, :attn_len, :].set(g)
+                    for layer, g in zip(cache["v"], g_vs)
+                ],
+            }
+            return new
+
+        self._replay_fn = jax.jit(
+            replay_burst, donate_argnums=(1,), static_argnums=(6,)
+        )
+
         # -- chunked prefill (interleaved with decode polls) -----------------
         def chunk_prefill_step(params, slab, tokens, start_pos, last_index,
                                seed, temp, attn_len, is_last):
@@ -810,6 +936,17 @@ class ContinuousBatcher:
         self._kv_key_bytes = 2 * sum(
             layer.dtype.itemsize * layer.shape[1] * layer.shape[3]
             for layer in self._cache["k"]
+        )
+        # the draft cache's per-token K/V price (speculation only): the
+        # pressure ledger charges live lanes for BOTH caches while the
+        # draft is resident, and stops when rung 2 frees it
+        self._draft_kv_key_bytes = (
+            2 * sum(
+                layer.dtype.itemsize * layer.shape[1] * layer.shape[3]
+                for layer in self._draft_cache["k"]
+            )
+            if self.speculate_tokens > 0
+            else 0
         )
         self._param_bytes = sum(
             leaf.nbytes
@@ -1041,10 +1178,49 @@ class ContinuousBatcher:
         }
 
     @caller_thread
-    def _shed_check(self, deadline_s: Optional[float]) -> None:
+    def _shed_check(
+        self, deadline_s: Optional[float], remote: bool = False
+    ) -> None:
         """Admit-queue shedding, BEFORE the request costs any device work:
-        an explicit queue cap, and the deadline-aware rule (expected queue
-        wait = depth / observed completion rate > remaining budget)."""
+        the HBM-pressure admission watermark, an explicit queue cap, and
+        the deadline-aware rule (expected queue wait = depth / observed
+        completion rate > remaining budget).
+
+        The pressure rung is the ladder's last resort — it only fires
+        while the ledger is latched over the high watermark. ``remote``
+        selects the typed refusal: a local submit sheds with the PR 2
+        :class:`~..resilience.ShedError` (429 + Retry-After); a remote
+        admit refuses with :class:`~.pressure.PressureRefused` (503 +
+        Retry-After) so a decode pool under pressure pushes back to its
+        prefill peers BEFORE a slab crosses the wire, instead of
+        half-admitting it."""
+        pc = self._pressure
+        if pc.budget_bytes > 0 and pc.active:
+            after = pc.retry_after_s()
+            if remote:
+                from .pressure import PressureRefused
+
+                self.stats["pressure_refused"] += 1
+                self._note_shed("pressure", self._queue.qsize(), None)
+                raise PressureRefused(
+                    f"decode pool over its HBM ledger high watermark "
+                    f"({pc.used} of {pc.budget_bytes} bytes); refusing "
+                    "remote admits until reclaim reaches the low "
+                    "watermark",
+                    retry_after_s=after,
+                )
+            from ..resilience import ShedError
+
+            self.stats["shed"] += 1
+            self.stats["pressure_sheds"] += 1
+            self._note_shed("pressure", self._queue.qsize(),
+                            self.observed_rate())
+            raise ShedError(
+                f"HBM ledger over its high watermark ({pc.used} of "
+                f"{pc.budget_bytes} bytes) — admissions shed until the "
+                "reclaim ladder reaches the low watermark",
+                retry_after_s=after,
+            )
         depth = self._queue.qsize()
         if self.admit_queue_limit and depth >= self.admit_queue_limit:
             from ..resilience import ShedError
@@ -1119,6 +1295,21 @@ class ContinuousBatcher:
         if self._stop.is_set():
             raise self._dead_error()
 
+    def _check_budget(self, prompt_len: int, max_new_tokens) -> None:
+        """Reject ``prompt_len + max_new_tokens > max_seq`` at the
+        boundary with a typed :class:`BudgetExceeded` (413-class).
+        Historically the overrun was silently clamped to the remaining
+        headroom — a client asking for 512 tokens got 40 with no signal
+        — and anything slipping past surfaced deep in the scheduler as
+        an opaque shape error."""
+        m = int(max_new_tokens)
+        if prompt_len + m > self.max_seq:
+            raise BudgetExceeded(
+                f"prompt of {prompt_len} + max_new_tokens {m} exceeds "
+                f"max_seq {self.max_seq}; raise max_seq or lower the "
+                "generation budget"
+            )
+
     @caller_thread
     def submit(
         self,
@@ -1134,18 +1325,22 @@ class ContinuousBatcher:
         if not len(tokens):
             raise ValueError("empty prompt")
         if len(tokens) >= self.max_seq:
-            raise ValueError(f"prompt of {len(tokens)} exceeds max_seq {self.max_seq}")
+            raise PromptTooLong(
+                f"prompt of {len(tokens)} exceeds max_seq {self.max_seq}"
+            )
+        self._check_budget(len(tokens), max_new_tokens)
         self._shed_check(deadline_s)
-        budget = self.max_seq - len(tokens)
         req = GenRequest(
             tokens=list(map(int, tokens)),
-            max_new_tokens=min(int(max_new_tokens), budget),
+            max_new_tokens=int(max_new_tokens),
             temperature=float(temperature),
             eos_id=eos_id,
             seed=int(seed),
             on_tokens=on_tokens,
         )
         req.submit_t = time.monotonic()
+        if deadline_s is not None:
+            req.deadline_t = req.submit_t + float(deadline_s)
         req.submit_wall_us = wall_us(req.submit_t)
         # capture the submitting thread's sampled trace context so the
         # scheduler thread can parent this request's timeline spans under
@@ -1229,9 +1424,10 @@ class ContinuousBatcher:
         if not n:
             raise ValueError("empty prompt")
         if n >= self.max_seq:
-            raise ValueError(
+            raise PromptTooLong(
                 f"prompt of {n} exceeds max_seq {self.max_seq}"
             )
+        self._check_budget(n, max_new_tokens)
         tokens = [int(t) for t in tokens]
         bucket = self._bucket(n)
         covered = max(0, min(int(covered_len), n - 1))
@@ -1377,6 +1573,7 @@ class ContinuousBatcher:
             raise DisaggError(
                 f"remote prompt of {n} exceeds max_seq {self.max_seq}"
             )
+        self._check_budget(n, meta.get("max_new_tokens", 32))
         if meta.get("prompt_hash") and meta["prompt_hash"] != _phash(tokens):
             raise DisaggError("slab prompt hash mismatch — corrupt meta")
         if meta.get("layout", "cache_one") != "cache_one":
@@ -1395,7 +1592,7 @@ class ContinuousBatcher:
                 "suffix-only slab but this decode pool runs no prefix "
                 "cache — re-request with covered_len=0"
             )
-        self._shed_check(deadline_s)
+        self._shed_check(deadline_s, remote=True)
         cfg = self.model.cfg
         k = np.asarray(slab["k"])
         v = np.asarray(slab["v"])
@@ -1417,16 +1614,17 @@ class ContinuousBatcher:
         if meta.get("first_token") is None:
             raise DisaggError("slab meta carries no first_token")
         key_arr = np.asarray(meta.get("rng_key", [0, 0]), np.uint32)
-        budget = self.max_seq - n
         req = GenRequest(
             tokens=tokens,
-            max_new_tokens=min(int(meta.get("max_new_tokens", 32)), budget),
+            max_new_tokens=int(meta.get("max_new_tokens", 32)),
             temperature=float(meta.get("temperature", 0.0)),
             eos_id=meta.get("eos_id"),
             seed=int(meta.get("seed", 0)),
             on_tokens=on_tokens,
         )
         req.submit_t = time.monotonic()
+        if deadline_s is not None:
+            req.deadline_t = req.submit_t + float(deadline_s)
         req.submit_wall_us = wall_us(req.submit_t)
         req.cache_hit_tokens = covered
         from ..tracing import get_tracer
@@ -1523,7 +1721,15 @@ class ContinuousBatcher:
                     f"weight swap version {version!r} is already the "
                     "served version; pick a new version id"
                 )
-            job = _SwapJob(params=params, version=version)
+            job = _SwapJob(
+                params=params,
+                version=version,
+                nbytes=sum(
+                    leaf.nbytes
+                    for leaf in jax.tree_util.tree_leaves(params)
+                    if hasattr(leaf, "nbytes")
+                ),
+            )
             self._pending_swap = job
         # the loop must be alive to execute the swap, traffic or not
         self.start()
@@ -1635,6 +1841,10 @@ class ContinuousBatcher:
         self._masks_dirty = True
         self._active_dev = None
         self._temps_dev = None
+        # _alloc_device_state rebuilds the draft cache, so a suppression
+        # that was live at crash time is simply over; preempted requests
+        # in the resume queue survive (their checkpoints are host-side)
+        self._spec_suppressed = False
         self._alloc_device_state()
         if self._prefix_index is not None:
             from .prefix_cache import RadixPrefixIndex
@@ -1904,6 +2114,13 @@ class ContinuousBatcher:
             swap.future.set_exception(err)
 
     def _drain_queue(self, err: Exception) -> None:
+        while self._resume_queue:
+            try:
+                req = self._resume_queue.popleft()
+            except IndexError:  # raced another drainer
+                break
+            if not req.future.done():
+                req.future.set_exception(err)
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -1929,10 +2146,11 @@ class ContinuousBatcher:
                 return b
         if n <= self.max_seq:
             return self.max_seq
-        # a too-long request must fail HERE with a clear message, not as
-        # an opaque downstream broadcast/shape error when the prompt is
-        # packed into a bucket-sized array it cannot fit
-        raise ValueError(
+        # a too-long request must fail HERE with a clear, TYPED message
+        # (413 / INVALID_ARGUMENT at the engine), not as an opaque
+        # downstream broadcast/shape error when the prompt is packed
+        # into a bucket-sized array it cannot fit
+        raise PromptTooLong(
             f"request of {n} tokens exceeds the largest prefill bucket "
             f"({self.prefill_buckets[-1]}) and max_seq ({self.max_seq}); "
             "raise max_seq or shorten the prompt"
@@ -2020,11 +2238,20 @@ class ContinuousBatcher:
         """Give the draft its prompt K/V prefix (speculation only). Draft
         prefixes are RE-DERIVED from the full prompt, never cached or
         chunked — the draft forward is cheap by construction."""
+        self._draft_admit_tokens(slot, req.tokens)
+
+    @scheduler_only
+    def _draft_admit_tokens(self, slot: int, tokens: List[int]) -> None:
+        """Draft prefill over an arbitrary token sequence — the prompt
+        at admit, or prompt+generated-so-far when a preempted lane
+        resumes (or rung 2's cancelled speculation re-enables): the
+        draft's K/V is a pure function of the tokens, so re-derivation
+        lands it in exactly the state incremental drafting left it."""
         import jax.numpy as jnp
 
-        n = len(req.tokens)
+        n = len(tokens)
         prompt = np.zeros((1, self._bucket(n)), np.int32)
-        prompt[0, :n] = req.tokens
+        prompt[0, :n] = tokens
         dcache_one = self._draft_prefill_fn(
             self._draft_params, jnp.asarray(prompt),
             jnp.asarray([n - 1], jnp.int32),
@@ -2044,7 +2271,8 @@ class ContinuousBatcher:
         return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
     @scheduler_only
-    def _start_chunked(self, slot: int, req: GenRequest, hit=None) -> None:
+    def _start_chunked(self, slot: int, req: GenRequest, hit=None,
+                       resume=None) -> None:
         """Reserve ``slot`` and queue the prompt for interleaved chunked
         prefill. On a prefix-cache hit the donor slab lands at the head
         of the staging slab and chunking starts at the splice point —
@@ -2076,7 +2304,7 @@ class ContinuousBatcher:
             self.stats["prefix_misses"] += 1
         self._chunked[slot] = _ChunkJob(
             request=req, slot=slot, next_start=start, slab=slab,
-            bucket=bucket, hit_tokens=start,
+            bucket=bucket, hit_tokens=start, resume=resume,
         )
         self._emit_span(
             req, "gen.queue_wait", req.submit_t, t_admit,
@@ -2126,10 +2354,22 @@ class ContinuousBatcher:
                         attn_len, is_last,
                     )
                 if is_last:
+                    if job.resume is not None:
+                        # recompute-resume: the checkpointed continuation
+                        # state replaces the chunk's own sample
+                        import jax.numpy as _jnp
+
+                        emitted_r, key_r = job.resume
+                        first = _jnp.int32(int(emitted_r[-1]))
+                        lane_key = key_r
+                        insert_pos = n + len(emitted_r) - 1
+                    else:
+                        insert_pos = n
                     with device_trace("gen.lane_insert"):
                         self._cache, self._cur_tok, self._pos, self._keys = (
                             self._insert_fn(
-                                self._cache, job.slab, slot, first, n, lane_key,
+                                self._cache, job.slab, slot, first,
+                                insert_pos, lane_key,
                                 self._cur_tok, self._pos, self._keys,
                             )
                         )
@@ -2152,9 +2392,17 @@ class ContinuousBatcher:
                       "last": is_last, "dispatch": True},
             )
             if is_last:
-                if self.speculate_tokens > 0:
-                    self._draft_admit(slot, req)
                 del self._chunked[slot]
+                if job.resume is not None:
+                    # shared resume tail: replay emitted K/V, draft
+                    # re-derivation, lane re-activation with crediting
+                    # continuing after the checkpoint
+                    self._activate_resumed(slot, req, job.resume[0])
+                    continue
+                if self._spec_active():
+                    # (suppressed speculation skips this: the lane gets
+                    # its draft prefix at _resume_speculation instead)
+                    self._draft_admit(slot, req)
                 req.decode_start_t = time.monotonic()
                 self._active[slot] = _Slot(request=req)
                 self._pos_host[slot] = n
@@ -2165,14 +2413,21 @@ class ContinuousBatcher:
 
     @scheduler_only
     def _prefix_match(self, req: GenRequest):
+        return self._prefix_match_tokens(req.tokens)
+
+    @scheduler_only
+    def _prefix_match_tokens(self, tokens: List[int]):
         """Longest usable cached prefix for this prompt: ``(m, slab)`` or
         None. Capped at n-1 (the last prompt token is always recomputed —
         its forward produces the logits the first new token samples from)
-        and rejected when the suffix bucket would not fit the cache."""
+        and rejected when the suffix bucket would not fit the cache.
+        Takes a raw token list so a recompute-resume (prompt + generated
+        so far) can splice cached prompt prefixes exactly like a fresh
+        admission."""
         if self._prefix_index is None:
             return None
-        n = len(req.tokens)
-        m, slab = self._prefix_index.match(req.tokens)
+        n = len(tokens)
+        m, slab = self._prefix_index.match(tokens)
         m = min(m, n - 1)
         if slab is None or m < self.prefix_cache_min_tokens:
             return None
@@ -2298,6 +2553,459 @@ class ContinuousBatcher:
         # upload buffer frees as soon as the insert's copy completes
         req.remote = None
 
+    # -- HBM pressure: ledger, reclaim ladder, decode-lane preemption ------
+
+    def pressure_summary(self) -> Optional[Dict[str, Any]]:
+        """Ledger snapshot for metrics/flight dumps; None when the
+        pressure subsystem is off (budget 0)."""
+        pc = self._pressure
+        if pc.budget_bytes <= 0 and not pc.stats["budget_changes"]:
+            return None
+        return pc.summary()
+
+    def _spec_active(self) -> bool:
+        """Speculation is configured AND not cancelled by the pressure
+        ladder's rung 2."""
+        return self._spec_burst_fn is not None and not self._spec_suppressed
+
+    @scheduler_only
+    def _ledger_components(self) -> Dict[str, int]:
+        """The unified HBM ledger, priced the way the reclaim ladder can
+        free it: live decode footprint per lane (current attention-read
+        bucket x per-token K/V bytes, draft cache included while
+        resident), chunked-prefill staging slabs, the radix prefix
+        cache's published bytes, and a staged hot-swap's double-buffered
+        params. Pure host arithmetic over at most ``slots`` entries —
+        cheap enough to run every poll."""
+        per_tok = self._kv_key_bytes
+        if self.speculate_tokens > 0 and not self._spec_suppressed:
+            per_tok += self._draft_kv_key_bytes
+        decode = sum(
+            self._attn_need(pos) for pos in self._pos_host.values()
+        ) * per_tok
+        staging = sum(
+            job.bucket for job in self._chunked.values()
+        ) * self._kv_key_bytes
+        prefix = (
+            self._prefix_index.total_bytes
+            if self._prefix_index is not None else 0
+        )
+        swap = self._pending_swap
+        swap_bytes = getattr(swap, "nbytes", 0) if swap is not None else 0
+        return {
+            "decode": decode, "staging": staging,
+            "prefix": prefix, "swap": swap_bytes,
+        }
+
+    @scheduler_only
+    def _drain_pending(self, pending) -> None:
+        """Read every in-flight burst NOW (oldest first). Preemption
+        checkpoints must see the lane's exact host state — emitted
+        tokens and the device position they imply — so the pipeline is
+        flushed before any victim is chosen. Preemption is rare; one
+        flushed pipeline is its cheapest cost."""
+        while pending:
+            mode, payload = pending.popleft()
+            if mode == "spec":
+                self._process_spec_burst(*payload)
+            else:
+                self._process_burst(*payload)
+
+    @scheduler_only
+    def _pressure_poll(self, pending) -> None:
+        """Per-poll pressure work: apply the chaos hook's re-budget,
+        refresh the ledger, and — while latched over the high watermark
+        — run the reclaim ladder. With ``budget == 0`` and no hook this
+        is two attribute checks: the no-pressure hot loop stays clean."""
+        pc = self._pressure
+        if self.pressure_hook is not None:
+            nb = self.pressure_hook(self._work_poll_count)
+            if nb is not None:
+                if int(nb) < 0:
+                    pc.restore_budget()
+                else:
+                    pc.set_budget(int(nb))
+                if self.flight is not None and self.flight.enabled:
+                    self.flight.record({
+                        "type": "pressure_budget",
+                        "budget_bytes": pc.budget_bytes,
+                        "restored": int(nb) < 0,
+                    })
+        if pc.budget_bytes <= 0:
+            # a restore can land back on a ZERO boot budget (pressure
+            # configured purely via the chaos hook): cancelled
+            # speculation must still come back, or the fault window
+            # would silently disable drafting for the process lifetime
+            if self._spec_suppressed:
+                self._resume_speculation()
+            if pc.active:
+                pc.update(self._ledger_components())
+            return
+        pc.update(self._ledger_components())
+        if not pc.active:
+            if self._spec_suppressed:
+                self._resume_speculation()
+            return
+        self._reclaim(pending, pc)
+
+    @scheduler_only
+    def _reclaim(self, pending, pc) -> None:
+        """The reclaim ladder, cheapest rung first, until usage drops to
+        the low watermark:
+
+        1. **evict prefixes** — pure cache, zero work lost;
+        2. **cancel speculation** — free the draft cache, decode falls
+           back to plain bursts (greedy streams identical by the spec
+           exactness contract; skipped while any stochastic lane is
+           live — seeded-sampling byte-identity outranks this rung);
+        3. **preempt lanes** — checkpoint a victim to host and requeue
+           it for recompute-resume, freeing its slot and cache columns
+           at this poll boundary (never the last lane: one lane always
+           makes forward progress, so pressure cannot livelock);
+        4. **shed admissions** — implicit: the latched ``active`` flag
+           holds the wave loop and sheds/refuses new submits
+           (:meth:`_shed_check`) until reclaim reaches the low
+           watermark."""
+        idx = self._prefix_index
+        if pc.active and idx is not None and idx.total_bytes > 0:
+            target = max(0, idx.total_bytes - pc.overshoot_bytes())
+            evicted = idx.evict_to(target)
+            if evicted:
+                self.stats["prefix_evicted"] += evicted
+                self.stats["pressure_prefix_evictions"] += evicted
+                self.stats["prefix_cache_bytes"] = idx.total_bytes
+                if self.flight is not None and self.flight.enabled:
+                    self.flight.record({
+                        "type": "pressure_reclaim",
+                        "action": "evict_prefix",
+                        "evicted": evicted,
+                        "used_bytes": pc.used,
+                    })
+                pc.update(self._ledger_components())
+        if (
+            pc.active
+            and self._spec_burst_fn is not None
+            and not self._spec_suppressed
+            and all(
+                s.request.temperature == 0.0 for s in self._active.values()
+            )
+            and all(
+                j.request.temperature == 0.0 for j in self._chunked.values()
+            )
+        ):
+            self._drain_pending(pending)
+            self._suppress_speculation()
+            pc.update(self._ledger_components())
+        if pc.active and len(self._active) + len(self._chunked) > 1:
+            self._drain_pending(pending)
+            # the drain may have finished lanes outright
+            pc.update(self._ledger_components())
+            while pc.active and len(self._active) + len(self._chunked) > 1:
+                victim = self._pick_victim()
+                if victim is None:
+                    break
+                kind, slot = victim
+                if kind == "chunked":
+                    self._preempt_chunked(slot)
+                else:
+                    self._preempt_lane(slot)
+                pc.update(self._ledger_components())
+
+    @scheduler_only
+    def _admit_cost_bytes(self, req: GenRequest) -> int:
+        """Projected END-of-generation ledger footprint of admitting
+        ``req``: the attention bucket its final position will need,
+        priced per token. The watermark-aware admission check uses it so
+        a lane that must inevitably trip the high watermark is held at
+        the head of the line instead of admitted-then-preempted (the
+        thrash the hysteresis gap exists to prevent)."""
+        per_tok = self._kv_key_bytes
+        if self.speculate_tokens > 0 and not self._spec_suppressed:
+            per_tok += self._draft_kv_key_bytes
+        end = min(self.max_seq, len(req.tokens) + req.max_new_tokens)
+        return self._attn_need(end) * per_tok
+
+    @scheduler_only
+    def _pick_victim(self):
+        """Deadline/progress-aware victim choice: chunked admissions
+        first (no tokens emitted yet — preemption loses zero work and
+        frees a whole staging slab), then decode lanes — deadline-free
+        lanes before deadline-bearing ones (a lane that must answer
+        soon is spared as long as anything else can give way), most
+        remaining generation budget first within each class (the lane
+        that would hold its slot longest yields it; lanes close to done
+        are left to finish and free themselves)."""
+        if self._chunked:
+            slot = max(
+                self._chunked, key=lambda s: self._chunked[s].bucket
+            )
+            return ("chunked", slot)
+        if len(self._active) <= 1:
+            return None
+        now = time.monotonic()
+
+        def order(slot: int):
+            s = self._active[slot]
+            req = s.request
+            slack = (
+                req.deadline_t - now if req.deadline_t is not None else None
+            )
+            return (
+                0 if slack is None else 1,
+                -(slack if slack is not None else 0.0),
+                -(req.max_new_tokens - len(s.emitted)),
+            )
+
+        return ("lane", min(self._active, key=order))
+
+    @scheduler_only
+    def _preempt_chunked(self, slot: int) -> None:
+        """Preempt a mid-chunked-prefill admission: drop the staging
+        slab and requeue the request whole (no tokens were emitted, so
+        a fresh admit reproduces the identical stream from the seed)."""
+        job = self._chunked.pop(slot)
+        req = job.request
+        self.stats["preemptions"] += 1
+        if self.flight is not None and self.flight.enabled:
+            self.flight.record({
+                "type": "preempt", "lane": slot, "kind": "chunked",
+                "prompt_tokens": len(req.tokens), "emitted": 0,
+            })
+        self._emit_span(req, "gen.preempt", time.monotonic(),
+                        time.monotonic(), tags={"lane": slot,
+                                                "kind": "chunked"})
+        self._resume_queue.append(req)
+
+    @scheduler_only
+    def _preempt_lane(self, slot: int) -> None:
+        """Preempt one decode lane: checkpoint to host (generated tokens
+        + the lane's post-split RNG key + the sampling params already on
+        the request — NOT its K/V), free the slot and its cache columns
+        at this poll boundary, and requeue for recompute-resume. The
+        caller has drained the pipeline, so ``emitted`` and the device
+        state agree exactly; the one tiny host read here (an [2] uint32
+        key) is the whole checkpoint cost."""
+        s = self._active.pop(slot)
+        req = s.request
+        # the lane's CURRENT key — sampling resumes mid-stream from it,
+        # which is what makes seeded-sampling output byte-identical
+        # preempt-on vs off
+        key = np.asarray(self._keys[slot]).astype(np.uint32).tolist()  # seldon-lint: disable=host-sync-hot-path (preemption checkpoint: one 8-byte key read at a rare reclaim point, pipeline already drained)
+        self._pos_host.pop(slot, None)
+        self._masks_dirty = True
+        if s.emitted:
+            req.resume = {"emitted": list(s.emitted), "key": key}
+        self.stats["preemptions"] += 1
+        if self.flight is not None and self.flight.enabled:
+            self.flight.record({
+                "type": "preempt", "lane": slot, "kind": "lane",
+                "prompt_tokens": len(req.tokens),
+                "emitted": len(s.emitted),
+                "remaining": req.max_new_tokens - len(s.emitted),
+            })
+        self._emit_span(
+            req, "gen.preempt", time.monotonic(), time.monotonic(),
+            tags={"lane": slot, "emitted": len(s.emitted)},
+        )
+        self._resume_queue.append(req)
+
+    @scheduler_only
+    def _suppress_speculation(self) -> None:
+        """Reclaim rung 2: free the draft cache and decode with plain
+        bursts. Greedy lanes keep byte-identical streams (spec greedy IS
+        the target argmax decode); the caller guarantees no stochastic
+        lane is live. Restored by :meth:`_resume_speculation` when
+        pressure clears."""
+        self._spec_suppressed = True
+        self._draft_cache = None
+        if self.flight is not None and self.flight.enabled:
+            self.flight.record({
+                "type": "pressure_reclaim", "action": "cancel_speculation",
+            })
+        logger.warning(
+            "HBM pressure: speculation cancelled (draft cache freed); "
+            "plain decode bursts until the ledger clears"
+        )
+
+    @scheduler_only
+    def _resume_speculation(self) -> None:
+        """Pressure cleared: reallocate the draft cache and re-derive
+        every live lane's draft prefix from prompt + generated-so-far
+        (the draft K/V is a pure function of the tokens). Runs BEFORE
+        admissions resume in the same poll, so no lane is ever admitted
+        into a half-restored draft world."""
+        self._draft_cache = self._unstack_cache(
+            self.draft_model,
+            self._cache_sharding_for(self.draft_model.cfg.n_kv_heads),
+        )
+        for slot, s in self._active.items():
+            full = (
+                s.request.tokens + s.emitted[:-1]
+                if s.emitted else s.request.tokens
+            )
+            self._draft_admit_tokens(slot, full)
+        self._spec_suppressed = False
+        if self.flight is not None and self.flight.enabled:
+            self.flight.record({
+                "type": "pressure_reclaim", "action": "resume_speculation",
+                "lanes": len(self._active),
+            })
+
+    @scheduler_only
+    def _replay_emitted(self, slot: int, start_pos: int,
+                        replay_toks: List[int]) -> None:
+        """Teacher-forced decode replay: rebuild positions
+        ``[start_pos, start_pos + len(replay_toks))`` of ``slot``'s
+        cache from the already-emitted tokens, through the SAME fused
+        decode step that wrote them originally (see replay_burst — a
+        prefill recompute differs at bf16 and breaks byte-identity).
+        Chunked to the burst length ``k`` so one executable exists per
+        (k, attn_len), never per resume length."""
+        import jax.numpy as jnp
+
+        if not replay_toks:
+            return
+        k = self._k
+        attn_len = self._attn_need(start_pos + len(replay_toks))
+        lane_ix = jnp.asarray([slot], jnp.int32)
+        for off in range(0, len(replay_toks), k):
+            chunk = replay_toks[off:off + k]
+            toks = np.zeros((k,), np.int32)
+            toks[: len(chunk)] = chunk
+            act = np.zeros((k,), bool)
+            act[: len(chunk)] = True
+            self._cache = self._replay_fn(
+                self.params, self._cache, lane_ix, jnp.asarray(toks),
+                jnp.asarray(act), jnp.int32(start_pos + off), attn_len,
+            )
+        self.stats["steps"] += -(-len(replay_toks) // k) * k
+        self.stats["lane_steps"] += -(-len(replay_toks) // k) * k
+
+    @scheduler_only
+    def _activate_resumed(self, slot: int, req: GenRequest,
+                          emitted: List[int]) -> None:
+        """Shared tail of the plain and chunked resume paths: replay the
+        emitted tokens' K/V, re-derive the draft prefix (speculation),
+        and re-activate the lane with crediting continuing AFTER the
+        checkpoint (already-delivered stream spans are never re-sent;
+        first_pending False keeps the insert's token — emitted[-1] —
+        from being credited twice)."""
+        n = len(req.tokens)
+        self._replay_emitted(slot, n, emitted[:-1])
+        if self._spec_active():
+            self._draft_admit_tokens(slot, req.tokens + emitted[:-1])
+        s = _Slot(request=req)
+        s.emitted = list(emitted)
+        s.first_pending = False
+        s.dispatched = len(emitted)
+        self._active[slot] = s
+        self._pos_host[slot] = n + len(emitted) - 1
+        self._masks_dirty = True
+        req.resume = None
+        self.stats["preempt_resumes"] += 1
+        if self.flight is not None and self.flight.enabled:
+            self.flight.record({
+                "type": "preempt_resume", "lane": slot,
+                "prompt_tokens": n,
+                "replayed_tokens": max(0, len(emitted) - 1),
+                "emitted": len(emitted),
+                "cache_hit_tokens": req.cache_hit_tokens,
+            })
+
+    @scheduler_only
+    def _admit_resume(self, slot: int, req: GenRequest) -> None:
+        """Recompute-resume a preempted request: rebuild the PROMPT K/V
+        through the ordinary admission machinery (bucketed prefill, a
+        prefix-cache hit splicing naturally, or the PR 3 staging-slab
+        chunked path for long prompts), insert with the checkpointed
+        continuation state instead of the prefill's own sample —
+        ``cur_tok`` = the last emitted token, ``pos`` = the exact device
+        position the preempted lane held, ``key`` = the checkpointed
+        post-split RNG key — then replay the emitted tokens' K/V with
+        the decode step itself (:meth:`_replay_emitted`). Decode from
+        there is the same computation the uninterrupted lane would have
+        run, so greedy AND seeded-sampling outputs are byte-identical
+        preempt-on vs off."""
+        import jax.numpy as jnp
+
+        from ..tracing import device_trace
+
+        ck = req.resume
+        emitted = list(ck["emitted"])
+        n = len(req.tokens)
+        end_pos = n + len(emitted) - 1
+        first_tok = jnp.int32(int(emitted[-1]))
+        lane_key = jnp.asarray(np.asarray(ck["key"], np.uint32))
+        t_admit = time.monotonic()
+        hit = self._prefix_match(req)
+        C = self.prefill_chunk
+        if C and (
+            (hit is None and self._bucket(n) > C)
+            or (hit is not None and n - hit[0] > C)
+        ):
+            # long prompt: rebuild through the SAME staging-slab chunked
+            # path the original admission used (byte-identity again —
+            # chunked and whole prefill K/V need not agree at bf16)
+            self._start_chunked(slot, req, hit=hit,
+                                resume=(emitted, lane_key))
+            self._emit_span(
+                req, "gen.resume", t_admit, time.monotonic(),
+                tags={"lane": slot, "emitted": len(emitted),
+                      "chunked": True},
+            )
+            return
+        if hit is not None:
+            m, slab = hit
+            wb = self._bucket(n - m)
+            suffix = np.zeros((1, wb), np.int32)
+            suffix[0, : n - m] = req.tokens[m:]
+            with device_trace("gen.prefill"):
+                _f, suffix_slab, _k = self._prefix_prefill_fn(
+                    self.params, slab, jnp.asarray(suffix), jnp.int32(m),
+                    jnp.asarray([n - 1 - m], jnp.int32),
+                    jnp.int32(req.seed), jnp.float32(req.temperature),
+                )
+            with device_trace("gen.lane_insert"):
+                self._cache, self._cur_tok, self._pos, self._keys = (
+                    self._insert_prefix_fn(
+                        self._cache, slab, suffix_slab, slot, jnp.int32(m),
+                        first_tok, end_pos, lane_key,
+                        self._cur_tok, self._pos, self._keys,
+                    )
+                )
+            req.cache_hit_tokens = m
+            self.stats["prefix_hits"] += 1
+            self.stats["prefix_tokens_saved"] += m
+            self.stats["prefill_steps"] += 1
+            self.stats["prefill_tokens"] += wb
+        else:
+            bucket = self._bucket(n)
+            prompt = np.zeros((1, bucket), np.int32)
+            prompt[0, :n] = req.tokens
+            with device_trace("gen.prefill"):
+                _f, cache_one, _k = self._prefill_fn(
+                    self.params, jnp.asarray(prompt),
+                    jnp.asarray([n - 1], jnp.int32),
+                    jnp.int32(req.seed), jnp.float32(req.temperature),
+                )
+            with device_trace("gen.lane_insert"):
+                self._cache, self._cur_tok, self._pos, self._keys = (
+                    self._insert_fn(
+                        self._cache, cache_one, slot, first_tok, end_pos,
+                        lane_key, self._cur_tok, self._pos, self._keys,
+                    )
+                )
+            if self._prefix_index is not None:
+                self.stats["prefix_misses"] += 1
+            self.stats["prefill_steps"] += 1
+            self.stats["prefill_tokens"] += bucket
+        self._activate_resumed(slot, req, emitted)
+        self._emit_span(
+            req, "gen.resume", t_admit, time.monotonic(),
+            tags={"lane": slot, "emitted": len(emitted),
+                  "cache_hit_tokens": req.cache_hit_tokens},
+        )
+
     @scheduler_only
     def _admit(self, slot: int, req: GenRequest, hit=None) -> None:
         # ``hit``: a (match_len, slab) the wave-routing loop already
@@ -2383,7 +3091,7 @@ class ContinuousBatcher:
             tags={"lane": slot,
                   "cache_hit_tokens": req.cache_hit_tokens},
         )
-        if self.speculate_tokens > 0:
+        if self._spec_active():
             # the draft needs the prompt's K/V prefix too so its proposals
             # attend over the real context (see _draft_admit: re-derived
             # from the full prompt, never cached — the radix pool holds
@@ -2739,6 +3447,22 @@ class ContinuousBatcher:
                 self._poll_count += 1
                 if self.fault_hook is not None:
                     self.fault_hook(self._poll_count)
+                # HBM pressure: refresh the ledger and, over the high
+                # watermark, run the reclaim ladder (may drain `pending`
+                # and preempt lanes at this poll boundary). Two attribute
+                # checks when the subsystem is off.
+                if (
+                    self._active or self._chunked or pending
+                    or self._resume_queue or not self._queue.empty()
+                ):
+                    self._work_poll_count += 1
+                if self.pressure_hook is not None or (
+                    self._pressure.budget_bytes > 0
+                ):
+                    self._pressure_poll(pending)
+                pressure_hold = (
+                    self._pressure.budget_bytes > 0 and self._pressure.active
+                )
                 # flight recorder: counter snapshot at poll start so the
                 # poll record carries DELTAS (what this poll did), plus the
                 # decode plan captured at dispatch below. One small dict
@@ -2779,14 +3503,40 @@ class ContinuousBatcher:
                 # batched prefill forward (pow2 chunks bound executables)
                 wave: List[GenRequest] = []
                 busy = len(self._active) + len(self._chunked)
-                while swap is None and busy + len(wave) < self.slots:
-                    try:
-                        req = self._queue.get_nowait()
-                    except queue.Empty:
-                        break
+                wave_cost = 0
+                while (
+                    swap is None
+                    and not pressure_hold
+                    and busy + len(wave) < self.slots
+                ):
+                    # preempted requests resume AHEAD of newer work —
+                    # their recompute is a price already paid once
+                    if self._resume_queue:
+                        req = self._resume_queue.popleft()
+                    else:
+                        try:
+                            req = self._queue.get_nowait()
+                        except queue.Empty:
+                            break
                     if req.future.cancelled():
                         self.stats["cancelled"] += 1
                         continue  # caller gave up while queued
+                    if self._pressure.budget_bytes > 0:
+                        # watermark-aware admission: if this request's
+                        # end-of-generation footprint would trip the high
+                        # watermark, hold it at the HEAD of the line until
+                        # completions/reclaim open headroom — admitting it
+                        # now would only earn it a preemption. With no
+                        # other lane live it always admits: one lane of
+                        # forward progress can never starve.
+                        cost = self._admit_cost_bytes(req)
+                        if (busy + len(wave)) and (
+                            self._pressure.used + wave_cost + cost
+                            >= self._pressure.high_bytes
+                        ):
+                            self._resume_queue.appendleft(req)
+                            break
+                        wave_cost += cost
                     wave.append(req)
                 if wave:
                     free_iter = iter(
@@ -2796,6 +3546,18 @@ class ContinuousBatcher:
                     chunk_size = self.prefill_chunk
                     by_bucket: Dict[int, List[GenRequest]] = {}
                     for req in wave:
+                        if req.resume is not None:
+                            # recompute-resume of a preempted lane:
+                            # prefill over prompt+generated, continue the
+                            # exact sampling stream from the checkpoint
+                            slot = next(free_iter)
+                            try:
+                                self._admit_resume(slot, req)
+                            except Exception as e:  # noqa: BLE001 - bad state
+                                logger.exception("preemption resume failed")
+                                if not req.future.done():
+                                    req.future.set_exception(e)
+                            continue
                         if req.remote is not None:
                             # disaggregated handoff: the prompt K/V came
                             # over the wire — splice it, no local prefill
@@ -2888,7 +3650,10 @@ class ContinuousBatcher:
                                 for req in chunk:
                                     if not req.future.done():
                                         req.future.set_exception(e)
-                if not self._active and not pending and not self._chunked:
+                if (
+                    not self._active and not pending and not self._chunked
+                    and not (self._resume_queue and not pressure_hold)
+                ):
                     try:
                         req = self._queue.get(timeout=0.05)
                     except queue.Empty:
@@ -2929,7 +3694,9 @@ class ContinuousBatcher:
                     k = self._k
                     # per-burst worst-case position advance (spec rounds can
                     # emit up to gamma+1 tokens each)
-                    adv = k * (self.speculate_tokens + 1 if self._spec_burst_fn else 1)
+                    adv = k * (
+                        self.speculate_tokens + 1 if self._spec_active() else 1
+                    )
                     # attention-read bucket: the smallest attn_bucket
                     # multiple covering every active lane's end-of-burst
                     # position (host-tracked, no sync). One executable per
@@ -2938,7 +3705,7 @@ class ContinuousBatcher:
                     attn_len = self._attn_need(
                         max(self._pos_host[i] for i in self._active) + adv
                     )
-                    if self._spec_burst_fn is not None:
+                    if self._spec_active():
                         # snapshot BEFORE dispatch: tokens of this burst
                         # belong to these occupants, whatever the host
                         # learns later. (Spec bursts stay whole-batch:

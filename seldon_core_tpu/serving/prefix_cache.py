@@ -244,8 +244,11 @@ class RadixPrefixIndex:
         return self._evict_to_budget()
 
     def _evict_to_budget(self) -> int:
+        return self._evict_down_locked(self.budget_bytes)
+
+    def _evict_down_locked(self, target_bytes: int) -> int:
         evicted = 0
-        while self.total_bytes > self.budget_bytes:
+        while self.total_bytes > target_bytes:
             nodes = self._slab_nodes()
             if not nodes:
                 break
@@ -257,6 +260,16 @@ class RadixPrefixIndex:
             evicted += 1
             self._prune(victim)
         return evicted
+
+    def evict_to(self, target_bytes: int) -> int:
+        """LRU-evict slabs until ``total_bytes <= target_bytes`` (the
+        pressure ladder's first rung: the batcher demotes the cache
+        below its own budget to reclaim HBM for live lanes). Returns the
+        number of slabs evicted. Eviction only drops the tree's
+        reference — an admit that matched a slab moments earlier keeps
+        it alive exactly as long as the splice needs it."""
+        with self._lock:
+            return self._evict_down_locked(max(0, int(target_bytes)))
 
     def set_version(self, version) -> int:
         with self._lock:

@@ -195,3 +195,23 @@ def test_bench_chaos_scenario_anchor():
     assert '"no_hang"' in mb_src
     gen_src = open(os.path.join(root, "tools", "gen_arch_numbers.py")).read()
     assert "llm_1b_chaos" in gen_src
+
+
+def test_bench_pressure_scenario_anchor():
+    """The ``llm_1b_pressure`` bench scenario is an acceptance artifact
+    (byte-identity of greedy AND seeded-sampling outputs across a
+    mid-run HBM-ledger shrink — preemption + recompute-resume — plus
+    the no-hang bound and the preemption-exercised bit are read from
+    its entry): it must stay wired through BOTH model tiers, and the
+    numbers-table generator must know its key."""
+    import seldon_core_tpu.modelbench as modelbench
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    mb_src = open(modelbench.__file__).read()
+    assert mb_src.count('results["llm_1b_pressure"]') >= 2  # tiny + chip
+    assert hasattr(modelbench, "bench_pressure")
+    # the entry asserts the acceptance bits like prior scenarios
+    assert '"sampled_identical": sampled_identical' in mb_src
+    assert '"no_hang"' in mb_src
+    gen_src = open(os.path.join(root, "tools", "gen_arch_numbers.py")).read()
+    assert "llm_1b_pressure" in gen_src

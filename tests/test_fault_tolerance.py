@@ -289,13 +289,19 @@ def test_supervised_restart_fails_inflight_typed_then_recovers(
     b = _fast_batcher(model, params, restart_budget=2)
     try:
         ref = b.generate([1, 2, 3], max_new_tokens=6)
-        # admit a long request and wait until it is mid-decode
-        fut = b.submit([4, 5, 6], max_new_tokens=40)
-        deadline = time.monotonic() + 10
-        while not b._active and time.monotonic() < deadline:
-            time.sleep(0.01)
-        hook, _state = _die_once()
+        # arm BEFORE the admit, firing on the first poll that sees a
+        # live lane: the death is guaranteed to land mid-decode (waiting
+        # to arm until the main thread OBSERVES the lane raced the tiny
+        # model's generation — the request could finish first)
+        state = {"armed": True}
+
+        def hook(_poll):
+            if state["armed"] and b._active:
+                state["armed"] = False
+                raise RuntimeError("injected poll death")
+
         b.fault_hook = hook
+        fut = b.submit([4, 5, 6], max_new_tokens=40)
         with pytest.raises(BatcherDead) as ei:
             fut.result(timeout=60)
         assert ei.value.retry_after_s > 0
@@ -509,8 +515,10 @@ def test_stream_midstream_batcher_death_surfaces_typed_no_hang(model_dir):
                          pipeline_depth=1, restart_budget=1)
     srv.load()
     try:
+        # a long-but-legal budget (prompt 3 + 58 <= max_seq 64): the
+        # overrun case is now a typed 413 at submit, not a silent clamp
         handle = srv.stream({"prompt_tokens": [3, 4, 5],
-                             "max_new_tokens": 512})
+                             "max_new_tokens": 58})
         got_spans = []
         err = None
         done = threading.Event()

@@ -97,6 +97,18 @@ def test_model_tier_tiny_end_to_end():
     for w in ("connect_refused", "corrupt", "truncate", "frame_drop",
               "stall", "pool_down"):
         assert ch["windows"][w]["completed_identical"] is True, w
+    # HBM pressure: the mid-run ledger shrink must actually preempt a
+    # lane, every request must complete byte-identically (greedy AND
+    # seeded sampling — recompute-resume continues the exact stream),
+    # nothing may hang, and TTFT inflation stays bounded
+    pr = results["llm_1b_pressure"]
+    assert pr["greedy_identical"] is True
+    assert pr["sampled_identical"] is True
+    assert pr["completed_all"] is True
+    assert pr["no_hang"] is True
+    assert pr["preemption_exercised"] is True
+    assert pr["preempt_resumes"] >= 1
+    assert pr["ttft_bounded"] is True
     # CPU has no published peak -> MFU is None there; on TPU it's a number
     mfu = results["resnet50_rest"]["mfu_pct"]
     assert mfu is None or 0 < mfu < 100

@@ -144,3 +144,76 @@ def test_interior_slab_survives_deeper_inserts():
     idx.match([5, 6])  # short most-recent
     assert idx._evict_to_budget() == 1
     assert idx.match([5, 6, 7, 8])[1] is short
+
+
+def test_evict_to_target_bytes_lru_order():
+    """The pressure ladder's rung-1 entry point: evict_to() drops LRU
+    slabs until the byte target holds, returns the eviction count, and
+    leaves the most-recently-used entries serving."""
+    idx = RadixPrefixIndex(1 << 20)
+    idx.insert([1, 1, 1], _slab("a"), 100)
+    idx.insert([2, 2, 2], _slab("b"), 100)
+    idx.insert([3, 3, 3], _slab("c"), 100)
+    idx.match([3, 3, 3])  # c most recent; a is LRU
+    assert idx.evict_to(250) == 1
+    assert idx.total_bytes == 200
+    assert idx.match([1, 1, 1]) == (0, None)
+    assert idx.match([3, 3, 3])[1] is not None
+    assert idx.evict_to(0) == 2
+    assert idx.total_bytes == 0
+    # idempotent on an empty index
+    assert idx.evict_to(0) == 0
+
+
+def test_eviction_races_concurrent_match_under_load():
+    """Regression (PR 6 added the lock; nothing exercised contention):
+    a decode-pool worker thread hammering match()/covered_len() while
+    the scheduler thread churns insert-with-eviction (tiny budget, every
+    insert evicts) must never see a half-split edge — no exceptions, no
+    dangling matches, byte accounting exact at quiesce."""
+    import threading
+
+    import numpy as np
+
+    idx = RadixPrefixIndex(350)  # ~3 slabs: every insert evicts
+    rs = np.random.RandomState(7)
+    prompts = [
+        [int(t) for t in rs.randint(0, 8, rs.randint(3, 10))]
+        for _ in range(64)
+    ]
+    errors = []
+    stop = threading.Event()
+
+    def matcher():
+        # the decode-pool consult path: match (LRU-touching) and
+        # covered_len (non-touching) interleaved, like remote admits
+        # racing local publishes
+        i = 0
+        try:
+            while not stop.is_set():
+                p = prompts[i % len(prompts)]
+                depth, slab = idx.match(p)
+                assert 0 <= depth <= len(p)
+                if depth:
+                    assert slab is not None
+                assert 0 <= idx.covered_len(p) <= len(p)
+                i += 1
+        except Exception as e:  # noqa: BLE001 - the assertion target
+            errors.append(e)
+
+    threads = [threading.Thread(target=matcher) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for round_ in range(30):
+            for i, p in enumerate(prompts):
+                idx.insert(p, _slab(f"{round_}/{i}"), 100)
+                assert idx.total_bytes <= 350
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors, errors
+    # quiesced: accounting must be exact (sum over surviving slab nodes)
+    assert idx.total_bytes == 100 * idx.slab_count
+    assert idx.total_bytes <= 350

@@ -1,0 +1,693 @@
+"""HBM-pressure management: ledger watermarks, the reclaim ladder,
+decode-lane preemption with recompute-resume, and the typed boundary
+errors (413 prompt/budget rejection, pressure sheds/refusals).
+
+The load-bearing contract: greedy AND seeded-sampling outputs are
+byte-identical preempt-on vs preempt-off — including mid-stream, under
+speculation, and with prefix-cache hits on resume — and nothing ever
+hangs (the min-one-lane rule guarantees forward progress under any
+budget).
+"""
+
+import json
+import time
+
+import pytest
+
+from seldon_core_tpu.models.llm import DecoderLM
+from seldon_core_tpu.resilience import ShedError
+from seldon_core_tpu.resilience.faults import FaultInjector
+from seldon_core_tpu.serving.continuous import (
+    BudgetExceeded,
+    ContinuousBatcher,
+    GenRequest,
+    PromptTooLong,
+)
+from seldon_core_tpu.serving.pressure import (
+    PressureController,
+    PressureRefused,
+)
+
+CFG = dict(
+    vocab_size=256,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    d_ff=64,
+    max_seq=64,
+    dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = DecoderLM(**CFG)
+    return model, model.init_params(0)
+
+
+def make_batcher(model_and_params, **kw):
+    model, params = model_and_params
+    kw.setdefault("slots", 4)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("prefill_buckets", (8, 16, 32))
+    kw.setdefault("steps_per_poll", 2)
+    return ContinuousBatcher(model, params, **kw)
+
+
+PROMPTS = [[3, 17, 42, 99, 7], [1, 2, 3], [9, 8, 7, 6], [5, 5, 5, 5, 5, 5]]
+
+
+@pytest.fixture(scope="module")
+def references(model_and_params):
+    """Pressure-free outputs: greedy and seeded-sampling, per prompt."""
+    b = make_batcher(model_and_params)
+    try:
+        greedy = [
+            b.generate(p, max_new_tokens=40, temperature=0.0)
+            for p in PROMPTS
+        ]
+        sampled = [
+            b.generate(p, max_new_tokens=30, temperature=0.8, seed=11 + i)
+            for i, p in enumerate(PROMPTS)
+        ]
+    finally:
+        b.close()
+    return {"greedy": greedy, "sampled": sampled}
+
+
+def arm_shrink(b, lanes=1.3, after=4, restore=12, end_pos=None):
+    """Arm a mid-run ledger shrink to ~``lanes`` live decode lanes via
+    the SELDON_FAULTS pressure hook (the real chaos wiring)."""
+    end = end_pos if end_pos is not None else b.max_seq
+    shrink = int(lanes * b._attn_need(end) * b._kv_key_bytes)
+    inj = FaultInjector([], pressure={
+        "shrink_to_bytes": shrink,
+        "after_polls": b._work_poll_count + after,
+        "restore_after_polls": restore,
+    })
+    b.pressure_hook = inj.pressure_hook()
+    return shrink
+
+
+def wait_lanes(b, n, timeout=60.0):
+    """Wait until >= n lanes/chunk jobs are live (so a shrink armed NOW
+    deterministically preempts instead of merely holding admissions)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(b._active) + len(b._chunked) >= n:
+            return True
+        time.sleep(0.002)
+    return False
+
+
+# -- PressureController unit ------------------------------------------------
+
+
+def test_controller_watermark_hysteresis():
+    pc = PressureController(1000, high=0.9, low=0.5)
+    assert not pc.update({"decode": 800})      # under high: stays clear
+    assert pc.update({"decode": 950})          # crosses high: latches
+    assert pc.update({"decode": 700})          # between: stays latched
+    assert not pc.update({"decode": 400})      # under low: clears
+    assert pc.stats["activations"] == 1
+    assert pc.overshoot_bytes() == 0
+
+
+def test_controller_budget_and_restore():
+    pc = PressureController(0)
+    assert not pc.update({"decode": 1 << 40})  # budget 0 = off
+    pc.set_budget(100)
+    assert pc.update({"decode": 95})
+    pc.restore_budget()
+    assert pc.budget_bytes == 0
+    assert not pc.update({"decode": 95})
+    assert pc.stats["budget_changes"] == 2
+
+
+def test_controller_rejects_bad_watermarks():
+    with pytest.raises(ValueError):
+        PressureController(100, high=1.5)
+    with pytest.raises(ValueError):
+        PressureController(100, high=0.5, low=0.9)
+
+
+def test_fault_injector_pressure_hook_fires_and_restores():
+    inj = FaultInjector([], pressure={
+        "shrink_to_bytes": 4096, "after_polls": 3,
+        "restore_after_polls": 5,
+    })
+    hook = inj.pressure_hook()
+    assert hook(1) is None and hook(2) is None
+    assert hook(3) == 4096            # fires on the Nth working poll
+    assert hook(4) is None and hook(7) is None
+    assert hook(8) == -1              # restore sentinel
+    assert hook(9) is None            # one-shot
+    # no pressure section -> no hook
+    assert FaultInjector([]).pressure_hook() is None
+
+
+# -- typed boundary errors (satellites 1 + 2) --------------------------------
+
+
+def test_prompt_too_long_typed_413(model_and_params):
+    b = make_batcher(model_and_params, slots=2)
+    try:
+        with pytest.raises(PromptTooLong) as ei:
+            b.submit([1] * 70)
+        assert ei.value.status == 413
+        with pytest.raises(PromptTooLong):
+            b._bucket(b.max_seq + 1)
+    finally:
+        b.close()
+
+
+def test_budget_overrun_rejected_at_submit(model_and_params):
+    """prompt_len + max_new_tokens > max_seq is a typed 413-class
+    rejection, not a silent clamp: unary submit and export_prefill."""
+    b = make_batcher(model_and_params, slots=2)
+    try:
+        with pytest.raises(BudgetExceeded) as ei:
+            b.submit([1, 2, 3], max_new_tokens=512)
+        assert ei.value.status == 413
+        assert isinstance(ei.value, ValueError)  # old catch sites still work
+        with pytest.raises(BudgetExceeded):
+            b.export_prefill([1, 2, 3], max_new_tokens=512)
+        # exactly-at-budget is legal
+        out = b.generate([1, 2, 3], max_new_tokens=61)
+        assert len(out) == 64
+    finally:
+        b.close()
+
+
+def test_decode_role_bounds_checked_before_transfer(model_and_params):
+    """Regression: an unservable request (over-long prompt / budget
+    overrun) must be refused at the decode boundary BEFORE any KV
+    transfer — over TCP the prefill-side typed error comes back as a
+    generic frame the failover layer reads as peer death, so without
+    the pre-check one bad client request ejects healthy prefill
+    peers."""
+    from seldon_core_tpu.servers.generateserver import GenerateServer
+
+    b = make_batcher(model_and_params, slots=2)
+
+    class _Exploding:
+        def prefill(self, *a, **kw):  # pragma: no cover - must not run
+            raise AssertionError("transfer dispatched for an unservable "
+                                 "request")
+
+    srv = GenerateServer.__new__(GenerateServer)
+    srv._role = "decode"
+    srv.batcher = b
+    srv._kv_client = _Exploding()
+    try:
+        kw = dict(max_new_tokens=512, temperature=0.0, eos_id=None, seed=0)
+        with pytest.raises(BudgetExceeded):
+            srv._remote_submit([1, 2, 3], kw, None)
+        kw["max_new_tokens"] = 4
+        with pytest.raises(PromptTooLong):
+            srv._remote_submit([1] * 70, kw, None)
+    finally:
+        b.close()
+
+
+def test_budget_overrun_rejected_at_admit_remote(model_and_params):
+    """A slab whose meta carries an over-budget max_new_tokens is
+    refused typed BEFORE any lane state exists on the decode side."""
+    pf = make_batcher(model_and_params, slots=1)
+    dec = make_batcher(model_and_params, slots=2)
+    try:
+        meta, slab = pf.export_prefill([5, 6, 7], max_new_tokens=8)
+        meta = dict(meta)
+        meta["max_new_tokens"] = 512
+        with pytest.raises(BudgetExceeded):
+            dec.admit_remote(slab, meta)
+        assert dec.stats["admitted"] == 0
+    finally:
+        pf.close()
+        dec.close()
+
+
+def test_engine_maps_prompt_errors_to_413(model_and_params, tmp_path,
+                                          rest_client):
+    """REST: over-bucket prompts and budget overruns answer a typed 413
+    on the unary AND stream routes (satellite: no 500 traceback)."""
+    import asyncio
+
+    from seldon_core_tpu.graph.service import EngineApp
+    from seldon_core_tpu.graph.spec import PredictorSpec, default_predictor
+    from seldon_core_tpu.servers.generateserver import GenerateServer
+
+    d = tmp_path / "llm"
+    d.mkdir()
+    (d / "jax_config.json").write_text(
+        json.dumps({"family": "llm", "config": CFG})
+    )
+    srv = GenerateServer(model_uri=str(d), slots=2, steps_per_poll=2)
+    spec = default_predictor(PredictorSpec.from_dict(
+        {"name": "p", "graph": {"name": "gen", "type": "MODEL"}}
+    ))
+    app = EngineApp(spec, registry={"gen": srv})
+    client = rest_client(app.rest_app())
+    try:
+        status, body = client.call("/api/v0.1/predictions", {
+            "jsonData": {"prompt_tokens": [[1] * 70], "max_new_tokens": 4},
+        })
+        assert status == 413, body
+        status, body = client.call("/api/v0.1/predictions", {
+            "jsonData": {"prompt_tokens": [[1, 2, 3]],
+                         "max_new_tokens": 512},
+        })
+        assert status == 413, body
+        status, body = client.call("/api/v0.1/generate", {
+            "jsonData": {"prompt_tokens": [1, 2, 3],
+                         "max_new_tokens": 512},
+        })
+        assert status == 413, body
+        # gRPC-facing classification: the executor surfaces the typed
+        # status the RPC front maps to INVALID_ARGUMENT
+        from seldon_core_tpu.graph.client import UnitCallError
+
+        with pytest.raises(UnitCallError) as ei:
+            asyncio.run(app.predict({"jsonData": {
+                "prompt_tokens": [[1] * 70], "max_new_tokens": 4,
+            }}))
+        assert ei.value.status == 413
+    finally:
+        srv.close()
+
+
+# -- preemption + recompute-resume ------------------------------------------
+
+
+def test_preemption_greedy_byte_identical(model_and_params, references):
+    b = make_batcher(model_and_params, hbm_ledger_bytes=1 << 40)
+    try:
+        futs = [
+            b.submit(p, max_new_tokens=40, temperature=0.0) for p in PROMPTS
+        ]
+        assert wait_lanes(b, 2)
+        arm_shrink(b, after=1)
+        outs = [f.result(timeout=120) for f in futs]
+        assert outs == references["greedy"]
+        assert b.stats["preemptions"] >= 1
+        assert b.stats["preempt_resumes"] == b.stats["preemptions"]
+    finally:
+        b.close()
+
+
+def test_preemption_seeded_sampling_byte_identical(model_and_params,
+                                                   references):
+    """The hard half of the contract: the checkpointed post-split RNG
+    key continues the exact sampling stream across preempt/resume."""
+    b = make_batcher(model_and_params, hbm_ledger_bytes=1 << 40)
+    try:
+        futs = [
+            b.submit(p, max_new_tokens=30, temperature=0.8, seed=11 + i)
+            for i, p in enumerate(PROMPTS)
+        ]
+        assert wait_lanes(b, 2)
+        arm_shrink(b, after=1)
+        outs = [f.result(timeout=120) for f in futs]
+        assert outs == references["sampled"]
+        assert b.stats["preemptions"] >= 1
+    finally:
+        b.close()
+
+
+def test_preemption_mid_stream_no_duplicate_spans(model_and_params,
+                                                  references):
+    """A streaming lane preempted mid-stream: already-delivered spans
+    are never re-sent, the resumed stream continues them, and the
+    concatenation equals the uninterrupted output exactly."""
+    b = make_batcher(model_and_params, hbm_ledger_bytes=1 << 40)
+    try:
+        spans = []
+        futs = [b.submit(PROMPTS[0], max_new_tokens=40, temperature=0.0,
+                         on_tokens=spans.append)]
+        futs += [
+            b.submit(p, max_new_tokens=40, temperature=0.0)
+            for p in PROMPTS[1:]
+        ]
+        assert wait_lanes(b, 2)
+        arm_shrink(b, after=1)
+        outs = [f.result(timeout=120) for f in futs]
+        assert outs == references["greedy"]
+        assert b.stats["preemptions"] >= 1
+        streamed = [t for span in spans for t in span]
+        assert streamed == references["greedy"][0][len(PROMPTS[0]):]
+    finally:
+        b.close()
+
+
+def test_preemption_under_speculation(model_and_params):
+    """Preempt/resume with a draft model live: the draft prefix is
+    re-derived from prompt+generated at resume, and — if pressure
+    cancelled speculation (rung 2) — restored when it clears. Greedy
+    output must equal both the plain and the unpressured-spec runs."""
+    model, params = model_and_params
+    draft = DecoderLM(
+        vocab_size=CFG["vocab_size"], d_model=16, n_layers=1, n_heads=2,
+        n_kv_heads=1, d_ff=32, max_seq=64, dtype="float32",
+    )
+    dparams = draft.init_params(99)
+    spec_kw = dict(draft_model=draft, draft_params=dparams,
+                   speculate_tokens=3)
+
+    ref = make_batcher(model_and_params, slots=2, **spec_kw)
+    try:
+        refs = [
+            ref.generate(p, max_new_tokens=40, temperature=0.0)
+            for p in PROMPTS[:2]
+        ]
+    finally:
+        ref.close()
+
+    b = make_batcher(model_and_params, slots=2,
+                     hbm_ledger_bytes=1 << 40, **spec_kw)
+    try:
+        futs = [
+            b.submit(p, max_new_tokens=40, temperature=0.0)
+            for p in PROMPTS[:2]
+        ]
+        assert wait_lanes(b, 2)
+        arm_shrink(b, lanes=1.1, after=1, restore=16)
+        outs = [f.result(timeout=120) for f in futs]
+        assert outs == refs
+        st = b.stats
+        assert st["preemptions"] >= 1
+        # after the window, speculation must be live again: a fresh
+        # request runs spec rounds and still matches the plain decode
+        deadline = time.monotonic() + 30
+        while b._spec_suppressed and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not b._spec_suppressed
+        again = b.generate(PROMPTS[0], max_new_tokens=40, temperature=0.0)
+        assert again == refs[0]
+    finally:
+        b.close()
+
+
+def test_spec_resumes_after_restore_to_zero_boot_budget(model_and_params):
+    """Regression: a chaos window on a server whose BOOT ledger budget
+    is 0 (pressure purely hook-driven) must still restore cancelled
+    speculation when the budget restores to 0 — the budget<=0 early
+    return must not leave _spec_suppressed latched forever."""
+    model, params = model_and_params
+    draft = DecoderLM(
+        vocab_size=CFG["vocab_size"], d_model=16, n_layers=1, n_heads=2,
+        n_kv_heads=1, d_ff=32, max_seq=64, dtype="float32",
+    )
+    dparams = draft.init_params(99)
+    b = make_batcher(model_and_params, slots=2, draft_model=draft,
+                     draft_params=dparams, speculate_tokens=3,
+                     hbm_ledger_bytes=0)
+    try:
+        ref = b.generate(PROMPTS[0], max_new_tokens=40, temperature=0.0)
+        futs = [
+            b.submit(p, max_new_tokens=40, temperature=0.0)
+            for p in PROMPTS[:2]
+        ]
+        assert wait_lanes(b, 2)
+        arm_shrink(b, lanes=1.1, after=1, restore=16)
+        [f.result(timeout=120) for f in futs]
+        deadline = time.monotonic() + 30
+        while b._spec_suppressed and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not b._spec_suppressed
+        assert b._pressure.budget_bytes == 0  # restored to the boot value
+        # the window must actually have exercised rung 2 both ways
+        actions = {
+            e.get("action") for e in b.flight.snapshot()
+            if e["type"] == "pressure_reclaim"
+        }
+        assert "cancel_speculation" in actions, actions
+        assert "resume_speculation" in actions, actions
+        assert b.generate(PROMPTS[0], max_new_tokens=40,
+                          temperature=0.0) == ref
+    finally:
+        b.close()
+
+
+def test_preemption_of_chunked_admission(model_and_params, references):
+    """A mid-chunked-prefill admission is preemptable too: the staging
+    slab is dropped and the request requeues whole, byte-identically."""
+    b = make_batcher(model_and_params, slots=2, prefill_chunk=8,
+                     hbm_ledger_bytes=1 << 40)
+    try:
+        ref = make_batcher(model_and_params, slots=2, prefill_chunk=8)
+        long_prompt = list(range(1, 21))  # bucket 32 > chunk 8: chunks
+        try:
+            want = ref.generate(long_prompt, max_new_tokens=20)
+            want_short = ref.generate(PROMPTS[1], max_new_tokens=40)
+        finally:
+            ref.close()
+        f1 = b.submit(PROMPTS[1], max_new_tokens=40)
+        f2 = b.submit(long_prompt, max_new_tokens=20)
+        # arm once the chunked admission is mid-flight, so the shrink
+        # preempts it rather than merely holding it at the queue
+        deadline = time.monotonic() + 60
+        while not b._chunked and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert b._chunked
+        arm_shrink(b, lanes=1.05, after=1, restore=20)
+        assert f1.result(timeout=120) == want_short
+        assert f2.result(timeout=120) == want
+        assert b.stats["preemptions"] >= 1
+    finally:
+        b.close()
+
+
+def test_resume_splices_prefix_cache_hit(model_and_params):
+    """Recompute-resume goes through the prefix cache: a cached prompt
+    prefix splices into the resume prefill (suffix-only recompute) and
+    the continuation is byte-identical. Greedy lanes ignore the RNG key,
+    so a crafted checkpoint exercises the exact resume path."""
+    b = make_batcher(model_and_params, slots=2,
+                     prefix_cache_hbm_bytes=1 << 20,
+                     prefix_cache_min_tokens=4)
+    try:
+        prompt = PROMPTS[0]
+        want = b.generate(prompt, max_new_tokens=24)  # publishes the prompt
+        assert b.stats["prefix_hits"] == 0
+        generated = want[len(prompt):]
+        cut = 10
+        req = GenRequest(tokens=list(prompt), max_new_tokens=24,
+                         temperature=0.0)
+        req.submit_t = time.monotonic()
+        req.future.gen_request = req
+        req.resume = {"emitted": generated[:cut], "key": [0, 0]}
+        hits_before = b.stats["prefix_hits"]
+        b._resume_queue.append(req)
+        b.start()
+        out = req.future.result(timeout=120)
+        assert out == want
+        assert b.stats["prefix_hits"] == hits_before + 1
+        assert b.stats["preempt_resumes"] >= 1
+    finally:
+        b.close()
+
+
+def test_no_hang_under_permanent_tiny_budget(model_and_params, references):
+    """The no-livelock floor: a budget smaller than ONE lane's footprint
+    (never restored) still completes every request — the last live lane
+    is never preempted and admissions serialize through it."""
+    b = make_batcher(model_and_params, hbm_ledger_bytes=1 << 40)
+    try:
+        inj = FaultInjector([], pressure={
+            "shrink_to_bytes": 64, "after_polls": 2,  # < one lane, forever
+        })
+        b.pressure_hook = inj.pressure_hook()
+        futs = [
+            b.submit(p, max_new_tokens=40, temperature=0.0) for p in PROMPTS
+        ]
+        outs = [f.result(timeout=120) for f in futs]
+        assert outs == references["greedy"]
+    finally:
+        b.close()
+
+
+# -- admission watermarks: sheds + typed remote refusal ----------------------
+
+
+def test_pressure_sheds_submit_with_429_contract(model_and_params):
+    b = make_batcher(model_and_params, slots=2, hbm_ledger_bytes=1 << 40)
+    try:
+        f = b.submit([1, 2, 3], max_new_tokens=58)
+        b._pressure.set_budget(256)  # far under one live lane
+        # while the lane is live the ledger stays latched: a new submit
+        # must shed with the 429 contract (retry_after_s attached)
+        shed = None
+        extra = []
+        while not f.done():
+            try:
+                extra.append(b.submit([4, 5, 6], max_new_tokens=4))
+            except ShedError as e:
+                shed = e
+                break
+            time.sleep(0.002)
+        assert shed is not None, "no shed before the lane completed"
+        assert shed.retry_after_s >= 1.0
+        assert b.stats["pressure_sheds"] >= 1
+        b._pressure.restore_budget()
+        f.result(timeout=120)
+        for e in extra:  # queued-before-latch submits still complete
+            e.result(timeout=120)
+    finally:
+        b.close()
+
+
+def test_pressure_refuses_remote_admit_typed(model_and_params):
+    """A decode pool over its high watermark refuses the remote admit
+    with the typed PressureRefused (503 + retry_after_s) BEFORE any
+    lane state exists — pushback to the prefill peers."""
+    pf = make_batcher(model_and_params, slots=1)
+    dec = make_batcher(model_and_params, slots=2,
+                       hbm_ledger_bytes=1 << 40)
+    try:
+        meta, slab = pf.export_prefill([5, 6, 7], max_new_tokens=8)
+        f = dec.submit([1, 2, 3], max_new_tokens=58)
+        dec._pressure.set_budget(256)
+        refusal = None
+        admitted = []
+        while not f.done():
+            try:
+                admitted.append(dec.admit_remote(slab, meta))
+            except PressureRefused as e:
+                refusal = e
+                break
+            time.sleep(0.002)
+        assert refusal is not None, "no refusal before the lane completed"
+        assert refusal.status == 503
+        assert refusal.retry_after_s >= 1.0
+        assert dec.stats["pressure_refused"] >= 1
+        dec._pressure.restore_budget()
+        f.result(timeout=120)
+        for a in admitted:  # pre-latch admits still complete
+            a.result(timeout=120)
+        # with the pressure gone the same slab admits fine
+        out = dec.admit_remote(slab, meta).result(timeout=120)
+        assert out[:3] == [5, 6, 7]
+    finally:
+        pf.close()
+        dec.close()
+
+
+# -- ladder rung 1 + ledger accounting ---------------------------------------
+
+
+def test_ladder_evicts_prefix_cache_first(model_and_params):
+    b = make_batcher(model_and_params, slots=2,
+                     prefix_cache_hbm_bytes=1 << 20,
+                     prefix_cache_min_tokens=4,
+                     hbm_ledger_bytes=1 << 40)
+    try:
+        b.generate(PROMPTS[0], max_new_tokens=8)
+        assert b._prefix_index.total_bytes > 0
+        f = b.submit(PROMPTS[1], max_new_tokens=58)
+        b._pressure.set_budget(1024)
+        deadline = time.monotonic() + 60
+        while (b.stats["pressure_prefix_evictions"] == 0
+               and not f.done() and time.monotonic() < deadline):
+            time.sleep(0.002)
+        f.cancel()
+        assert b.stats["pressure_prefix_evictions"] >= 1
+        assert b._prefix_index.total_bytes == 0
+    finally:
+        b.close()
+
+
+def test_ledger_components_track_live_state(model_and_params):
+    b = make_batcher(model_and_params, slots=2,
+                     prefix_cache_hbm_bytes=1 << 20,
+                     prefix_cache_min_tokens=4,
+                     hbm_ledger_bytes=1 << 30)
+    try:
+        # before the scheduler runs, the ledger is empty (direct call is
+        # legal: no scheduler thread is alive yet)
+        assert b._ledger_components() == {
+            "decode": 0, "staging": 0, "prefix": 0, "swap": 0,
+        }
+        b.generate(PROMPTS[0], max_new_tokens=8)
+        # the running scheduler refreshes the controller every poll;
+        # after completion+publish the prefix component carries the slab
+        deadline = time.monotonic() + 30
+        while (b._pressure.components.get("prefix", 0)
+               != b._prefix_index.total_bytes
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+        assert b._pressure.components["prefix"] == \
+            b._prefix_index.total_bytes > 0
+        summary = b.pressure_summary()
+        assert summary is not None
+        assert summary["budget_bytes"] == 1 << 30
+        # metrics surface: the server-side gauges read this summary
+        assert set(summary["components"]) == {
+            "decode", "staging", "prefix", "swap",
+        }
+    finally:
+        b.close()
+
+
+def test_pressure_off_is_byte_identical_and_unconsulted(model_and_params,
+                                                        references):
+    """hbm_ledger_bytes=0 (the default): outputs match, nothing is
+    preempted, and the controller never accounts a poll."""
+    b = make_batcher(model_and_params)
+    try:
+        futs = [
+            b.submit(p, max_new_tokens=40, temperature=0.0) for p in PROMPTS
+        ]
+        assert [f.result(timeout=120) for f in futs] == references["greedy"]
+        assert b.stats["preemptions"] == 0
+        assert b._pressure.stats["updates"] == 0
+        assert b.pressure_summary() is None
+    finally:
+        b.close()
+
+
+def test_flight_records_and_report_render_preemption(model_and_params,
+                                                     references):
+    """preempt / preempt_resume / pressure_budget records land in the
+    flight recorder and tools/flight_report.py renders them."""
+    import importlib.util
+    import os
+
+    b = make_batcher(model_and_params, hbm_ledger_bytes=1 << 40)
+    try:
+        futs = [
+            b.submit(p, max_new_tokens=40, temperature=0.0) for p in PROMPTS
+        ]
+        assert wait_lanes(b, 2)
+        arm_shrink(b, after=1)
+        [f.result(timeout=120) for f in futs]
+        entries = b.flight.snapshot()
+        kinds = {e["type"] for e in entries}
+        assert {"preempt", "preempt_resume", "pressure_budget"} <= kinds
+        dump = b.flight.dump()
+        dump["slo"] = b.slo_summary()
+        dump["pressure"] = b._pressure.summary()
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "flight_report", os.path.join(root, "tools", "flight_report.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        text = mod.render(dump)
+        assert "decode-lane preemption" in text
+        assert "pressure ledger" in text
+        assert "recompute-resume" in text
+    finally:
+        b.close()
+
+
+def test_chaos_smoke_has_pressure_leg():
+    """The CI chaos smoke carries the ledger-shrink leg and asserts the
+    pressure exposition series."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = open(os.path.join(root, "tools", "chaos_smoke.py")).read()
+    assert '"pressure"' in src or "'pressure'" in src
+    assert "seldon_engine_preemptions" in src
+    assert "seldon_engine_pressure_used_bytes" in src

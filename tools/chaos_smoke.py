@@ -176,6 +176,70 @@ def main() -> int:
             check("peer ejection counter counts the faults",
                   REGISTRY.counter_total(
                       "seldon_engine_peer_ejections", {}) >= 1)
+
+            # -- pressure leg: SELDON_FAULTS pressure grammar shrinks
+            # the HBM ledger mid-run -> decode-lane preemption ->
+            # byte-identical recompute-resume, then the exposition must
+            # carry the seldon_engine_pressure_* / _preemptions series
+            long_kw = {"max_new_tokens": 40, "temperature": 0.0}
+            long_prompts = prompts[:3]
+            long_refs = [
+                unified.batcher.generate(list(p), **long_kw)
+                for p in long_prompts
+            ]
+            # ~1.3 lanes of end-of-generation footprint: two live lanes
+            # must preempt, one always fits (no livelock)
+            kvb = unified.batcher._kv_key_bytes
+            shrink_to = int(1.3 * 64 * kvb)
+            os.environ["SELDON_FAULTS"] = json.dumps({
+                "pressure": {"shrink_to_bytes": shrink_to,
+                             "after_polls": 4,
+                             "restore_after_polls": 24},
+            })
+            try:
+                prs = GenerateServer(
+                    slots=2, hbm_ledger_bytes=1 << 40, **common
+                )
+                prs.load()
+            finally:
+                del os.environ["SELDON_FAULTS"]
+            prs_h = EngineHarness(prs, name="chaos-pressure").start()
+            try:
+                futs = [
+                    prs.batcher.submit(list(p), **long_kw)
+                    for p in long_prompts
+                ]
+                outs = [f.result(timeout=60) for f in futs]
+                st = prs.batcher.stats
+                check("pressure shrink preempted a lane",
+                      st["preemptions"] >= 1,
+                      f"preemptions={st['preemptions']}")
+                check("preempted requests resumed byte-identical",
+                      outs == long_refs and
+                      st["preempt_resumes"] >= 1,
+                      f"resumes={st['preempt_resumes']}")
+                # one engine-served request flushes the gen_* metrics
+                # into the registry so the series land in /metrics
+                # (greedy() asks for 6 new tokens — compare like for like)
+                short_ref = unified.batcher.generate(
+                    list(long_prompts[0]), max_new_tokens=6,
+                    temperature=0.0)
+                got = greedy(prs_h.http_port, long_prompts[0])
+                check("pressure engine path byte-identical",
+                      got["tokens"][0] == short_ref)
+                expo = REGISTRY.expose()
+                for series in ("seldon_engine_preemptions",
+                               "seldon_engine_preemption_resumes",
+                               "seldon_engine_pressure_used_bytes",
+                               "seldon_engine_pressure_budget_bytes",
+                               "seldon_engine_pressure_active"):
+                    check(f"exposition has {series}", series in expo)
+                check("preemption counter counts the reclaim",
+                      REGISTRY.counter_total(
+                          "seldon_engine_preemptions", {}) >= 1)
+            finally:
+                prs_h.stop()
+                prs.close()
         finally:
             uni_h.stop()
             dec_h.stop()

@@ -143,12 +143,90 @@ def _fault_lines(
     return lines
 
 
+def _pressure_lines(
+    preempts: List[Dict[str, Any]],
+    resumes: List[Dict[str, Any]],
+    reclaims: List[Dict[str, Any]],
+    budgets: List[Dict[str, Any]],
+    pressure: Dict[str, Any],
+) -> List[str]:
+    """HBM-pressure records, shown inline with the scheduling story:
+    ledger re-budgets, reclaim-ladder rungs, decode-lane preemptions and
+    their recompute-resumes — the trail of an overload window."""
+    lines: List[str] = []
+    if budgets:
+        lines.append(
+            f"pressure budget: {len(budgets)} re-budget(s) — now "
+            f"{budgets[-1].get('budget_bytes', 0) / 1e6:.2f} MB"
+            + (" (restored)" if budgets[-1].get("restored") else "")
+        )
+    evicts = [r for r in reclaims if r.get("action") == "evict_prefix"]
+    spec_off = [r for r in reclaims if r.get("action") == "cancel_speculation"]
+    if evicts:
+        lines.append(
+            f"pressure reclaim: {sum(r.get('evicted', 0) for r in evicts)} "
+            f"prefix slab(s) evicted across {len(evicts)} ladder pass(es)"
+        )
+    if spec_off:
+        lines.append(
+            "pressure reclaim: speculation cancelled (draft cache freed) "
+            f"{len(spec_off)}x"
+        )
+    if preempts:
+        lanes = [p for p in preempts if p.get("kind") != "chunked"]
+        chunked = [p for p in preempts if p.get("kind") == "chunked"]
+        recompute = sum(p.get("emitted", 0) for p in lanes)
+        lines.append(
+            f"decode-lane preemption: {len(lanes)} lane(s) checkpointed "
+            f"to host ({recompute} generated tokens to recompute), "
+            f"{len(chunked)} chunked admission(s) requeued; "
+            f"{len(resumes)} recompute-resume(s) landed"
+        )
+        # only checkpoint-carrying preemptions produce preempt_resume
+        # records (a zero-emitted or chunked victim requeues whole and
+        # re-enters through the plain admit path) — comparing against
+        # ALL preempts would cry wolf on a healthy run
+        checkpointed = [p for p in preempts if p.get("emitted", 0) > 0]
+        if len(resumes) < len(checkpointed):
+            lines.append(
+                "DIAGNOSIS: preempted requests are still waiting to "
+                "resume — the ledger has not cleared its low watermark; "
+                "if this persists, the budget is too small for even one "
+                "lane of this depth (raise hbm_ledger_bytes)"
+            )
+        else:
+            lines.append(
+                "DIAGNOSIS: every preemption resumed — output stays "
+                "byte-identical (recompute-resume continues the exact "
+                "sampling stream); the cost was the recomputed prefill "
+                "plus the wait, visible as TTFT/TPOT inflation in the "
+                "SLO block above"
+            )
+    if pressure:
+        used = pressure.get("used_bytes", 0)
+        budget = pressure.get("budget_bytes", 0)
+        state = "ACTIVE" if pressure.get("active") else "clear"
+        comp = pressure.get("components") or {}
+        comp_txt = ", ".join(
+            f"{k} {v / 1e6:.2f}" for k, v in sorted(comp.items()) if v
+        ) or "idle"
+        lines.append(
+            f"pressure ledger: {used / 1e6:.2f} of {budget / 1e6:.2f} MB "
+            f"({state}; MB by component: {comp_txt})"
+        )
+    return lines
+
+
 def diagnose(dump: Dict[str, Any]) -> List[str]:
     """Report lines for one unit's flight-recorder dump."""
     lines: List[str] = []
     entries = dump.get("entries") or []
     polls = [e for e in entries if e.get("type") == "poll"]
     sheds = [e for e in entries if e.get("type") == "shed"]
+    preempts = [e for e in entries if e.get("type") == "preempt"]
+    resumes = [e for e in entries if e.get("type") == "preempt_resume"]
+    reclaims = [e for e in entries if e.get("type") == "pressure_reclaim"]
+    budgets = [e for e in entries if e.get("type") == "pressure_budget"]
     swaps = [e for e in entries if e.get("type") == "weight_swap"]
     kv_exports = [e for e in entries if e.get("type") == "kv_export"]
     kv_inserts = [e for e in entries if e.get("type") == "remote_insert"]
@@ -208,6 +286,9 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
         # export stream
         lines.extend(_kv_lines(kv_exports, kv_inserts))
         lines.extend(_fault_lines(restarts, ejects, readmits, degraded))
+        lines.extend(_pressure_lines(
+            preempts, resumes, reclaims, budgets, dump.get("pressure") or {}
+        ))
         return lines
 
     # -- batch composition --------------------------------------------------
@@ -261,6 +342,11 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
 
     # -- fault tolerance (supervision, peer failover, degradation) -----------
     lines.extend(_fault_lines(restarts, ejects, readmits, degraded))
+
+    # -- HBM pressure (ledger, reclaim ladder, preemption) -------------------
+    lines.extend(_pressure_lines(
+        preempts, resumes, reclaims, budgets, dump.get("pressure") or {}
+    ))
 
     # -- prefix cache ---------------------------------------------------------
     hits = sum(p.get("prefix_hits", 0) for p in polls)

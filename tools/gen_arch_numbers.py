@@ -231,6 +231,19 @@ def rows_from(mt, fronts):
                f"{rc.get('peer_ejections', 0)} ejection(s)"
                if rc.get("all_exercised") else ""),
         ))
+    gp = mt.get("llm_1b_pressure") or {}
+    if gp:
+        rows.append((
+            "generate(), HBM pressure (preempt + resume)",
+            f"{fmt(gp.get('preemptions'))} preemption(s), TTFT "
+            f"{gp.get('ttft_inflation_x', '—')}x baseline under a "
+            f"{fmt(gp.get('shrink_to_bytes'))}-byte ledger",
+            "mid-run ledger shrink; recompute-requeue"
+            + ("; greedy + seeded-sampling bytes identical"
+               if gp.get("greedy_identical") and gp.get("sampled_identical")
+               else "")
+            + ("; no hangs" if gp.get("no_hang") else ""),
+        ))
     g1l = mt.get("llm_1b_long") or {}
     if g1l:
         mbu = f", MBU {g1l['mbu_pct']}%" if g1l.get("mbu_pct") is not None else ""
