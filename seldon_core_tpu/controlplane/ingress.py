@@ -310,6 +310,41 @@ class Gateway:
             except LookupError as e:
                 return Response(error_body(404, str(e)), 404)
             except Exception as e:  # noqa: BLE001 - gateway must answer
+                status = getattr(e, "status", None)
+                if status == 503 and (
+                    api_path.endswith("/predictions") or api_path == "/predict"
+                ):
+                    # engine-internal retry: a 503-class refusal (dead,
+                    # restarting, or DRAINING generate batcher) is
+                    # member-local — retry once on another routable
+                    # member. Generation is seed-deterministic, and a
+                    # client resume token riding the payload re-enters
+                    # exactly where the dead member stopped, so the
+                    # retried response is byte-identical to an
+                    # uninterrupted run.
+                    alt = None
+                    for _ in range(3):
+                        cand, _sh = gw.select(
+                            key, req.headers.get(HEADER_PREDICTOR)
+                        )
+                        if cand is not None and cand is not primary:
+                            alt = cand
+                            break
+                    if alt is not None:
+                        try:
+                            return Response(
+                                await gw._forward(alt, api_path, payload)
+                            )
+                        except Exception as e2:  # noqa: BLE001 - second member
+                            e = e2
+                            status = getattr(e2, "status", None)
+                if status == 503:
+                    after = getattr(e, "retry_after_s", None)
+                    return Response(
+                        error_body(503, str(e)), 503,
+                        headers={"Retry-After": str(max(1, int(after + 0.5)))
+                                 if after else "1"},
+                    )
                 return Response(error_body(502, str(e)), 502)
             return Response(out)
 

@@ -450,10 +450,15 @@ class DeploymentController:
                         for pred, handles in self._explainer_endpoints(dep).items()
                     },
                 )
-            for name in mine - desired_names:
+            for name in sorted(mine - desired_names):
                 handle, _ = self.components.pop(name)
                 if self.placement is not None:
                     self.placement.release(name)
+                # zero-loss replacement/scale-down: checkpoint the
+                # member's in-flight generations and hand them to a
+                # surviving (or new-generation) peer BEFORE teardown —
+                # rolling maintenance drops zero requests
+                await self._drain_generate_member(handle)
                 await handle.stop()
         else:
             # roll back: tear down the failed new generation, keep old
@@ -501,6 +506,65 @@ class DeploymentController:
             )
         self._wire_shadow_mirrors(dep)
         return status
+
+    @staticmethod
+    def _generate_unit(handle, attr: str):
+        """The in-process generate unit behind ``handle`` exposing
+        ``attr`` (``drain_to`` / ``resume_checkpoint``), or None — only
+        the default in-process engine runtime carries live unit objects.
+        Delegates the graph walk to ``EngineApp.units_with`` so unit
+        discovery lives in one module."""
+        app = getattr(handle, "app", None)
+        if app is None or not hasattr(app, "units_with"):
+            return None
+        return next((u for _n, u in app.units_with(attr)), None)
+
+    async def _drain_generate_member(self, handle) -> None:
+        """Before stopping a generate member (hot-swap replacement or
+        decode-pool scale-down), checkpoint its live lanes + queued
+        requests and migrate them to a surviving routable member of the
+        same predictor (loopback — the handles share this process).
+        Honors ``seldon.io/drain-seconds`` as the handoff budget.
+        Best-effort: a member with nothing to migrate costs one empty
+        drain; a failed handoff fails those requests typed exactly as a
+        plain teardown would have, never worse."""
+        from .runtime import _drain_seconds
+
+        src = self._generate_unit(handle, "drain_to")
+        if src is None or getattr(src, "batcher", None) is None:
+            return
+        peer = None
+        for _name, (h, _) in self.components.items():
+            if (
+                h is not handle
+                and h.spec.deployment == handle.spec.deployment
+                and h.spec.predictor == handle.spec.predictor
+                and h.spec.routable
+            ):
+                peer = self._generate_unit(h, "resume_checkpoint")
+                if peer is not None:
+                    break
+        if peer is None:
+            return
+        drain_s = _drain_seconds(handle.spec)
+        loop = asyncio.get_running_loop()
+        try:
+            summary = await asyncio.wait_for(
+                loop.run_in_executor(
+                    None, lambda: src.drain_to(peer, timeout_s=drain_s)
+                ),
+                timeout=drain_s + 5.0,
+            )
+            if summary.get("drained"):
+                logger.info(
+                    "%s: drained %d in-flight generation(s) to a peer "
+                    "before teardown", handle.spec.name,
+                    summary["drained"],
+                )
+        except Exception:  # noqa: BLE001 - drain is best-effort
+            logger.exception(
+                "%s: drain before teardown failed", handle.spec.name
+            )
 
     def _wire_shadow_mirrors(self, dep: SeldonDeployment) -> None:
         """Shadow-mode rollouts mirror at the ENGINE: every live

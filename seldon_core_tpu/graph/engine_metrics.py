@@ -111,6 +111,16 @@ class MetricsRegistry:
         "gen_pressure_refused": "seldon_engine_pressure_refused",
         "gen_pressure_prefix_evictions":
             "seldon_engine_pressure_prefix_evictions",
+        # live migration: graceful drains, checkpoints exported and
+        # handed to a peer, resumes admitted from wire checkpoints /
+        # resume tokens, and hot-swap straggler preemptions — the
+        # observable half of the zero-loss drain contract in
+        # docs/operate.md "Failure modes & recovery"
+        "gen_drains": "seldon_engine_drains_total",
+        "gen_checkpoint_exports": "seldon_engine_checkpoint_exports",
+        "gen_migrations": "seldon_engine_migrations_total",
+        "gen_migrated_resumes": "seldon_engine_migrations_resumed",
+        "gen_swap_preemptions": "seldon_engine_swap_preemptions",
     }
 
     # first-class health gauge: 1 = the generate scheduler is serving,

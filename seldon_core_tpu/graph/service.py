@@ -148,6 +148,32 @@ class EngineApp:
         with self._inflight_lock:
             self.inflight += n
 
+    def units_with(self, attr: str):
+        """Yield ``(unit_name, user_object)`` for every in-process unit
+        exposing ``attr`` — the one place that knows how to walk the
+        executor for unit capabilities (the /drain route and the
+        reconciler's live-migration hook both consume it)."""
+        try:
+            for rt in self.executor._walk(self.executor.root):
+                target = getattr(rt.client, "user_object", None)
+                if target is not None and hasattr(target, attr):
+                    yield rt.name, target
+        except Exception:  # noqa: BLE001 - half-built graph during teardown
+            return
+
+    def _flush_unit_metrics(self, unit) -> None:
+        """Fold one in-process unit's ``metrics()`` deltas into the
+        registry outside the response path — for events (drain,
+        migration import) after which the unit may never serve the
+        request that would normally carry them."""
+        fn = getattr(unit, "metrics", None)
+        if fn is None:
+            return
+        try:
+            self.metrics.record_custom(fn(), {"deployment": self.spec.name})
+        except Exception:  # noqa: BLE001 - telemetry must not fail the op
+            logger.exception("unit metrics flush failed")
+
     def _count_stream_cache_hit(self, chunk) -> None:
         """Roll a streaming response's final-event ``cache_hit_tokens``
         into the same deployment-level counter the unary path feeds."""
@@ -651,9 +677,116 @@ class EngineApp:
                 )
             return Response({"units": units})
 
+        async def drain(req: Request) -> Response:
+            # live-lane migration (units exposing the generate drain
+            # surface). Two modes:
+            #   {"to": "host:port" | null} — SOURCE: checkpoint every
+            #     in-flight generation and hand it to the peer engine
+            #     (the member flips to the "draining" health state and
+            #     refuses new work typed 503);
+            #   {"checkpoints": [<base64 SGC1>, ...]} — IMPORT: resume
+            #     each checkpoint locally and answer with the final
+            #     token lists once every resumed generation completes.
+            body = req.json() or {}
+            loop = asyncio.get_running_loop()
+            if "checkpoints" in body:
+                unit = next(
+                    (u for _n, u in self.units_with("resume_checkpoint")),
+                    None,
+                )
+                if unit is None:
+                    return Response(
+                        error_body(501, "no unit supports migration"), 501
+                    )
+                timeout_s = float(body.get("timeout_s", 600.0))
+                # parse EVERY frame and pre-check its weight_version
+                # before admitting ANY: a corrupt or version-stale
+                # checkpoint mid-batch must refuse the whole handoff up
+                # front, not after earlier siblings already counted as
+                # migrated resumes
+                from ..serving.disagg import WeightVersionMismatch
+                from ..serving.migration import parse_token
+
+                try:
+                    cks = [
+                        parse_token(t) if isinstance(t, str) else t
+                        for t in body["checkpoints"]
+                    ]
+                    serving_wv = getattr(
+                        getattr(unit, "batcher", None),
+                        "weight_version", None,
+                    )
+                    for ck in cks:
+                        wv = ck.get("weight_version")
+                        if (
+                            serving_wv is not None
+                            and wv is not None
+                            and wv != serving_wv
+                        ):
+                            raise WeightVersionMismatch(
+                                f"checkpoint weight_version {wv!r} vs "
+                                f"serving {serving_wv!r}"
+                            )
+                except Exception as e:  # noqa: BLE001 - typed refusal
+                    status = getattr(e, "status", None) or 400
+                    return Response(error_body(status, str(e)), status)
+                futures = []
+                try:
+                    for ck in cks:
+                        futures.append(unit.resume_checkpoint(ck))
+                except Exception as e:  # noqa: BLE001 - typed refusal
+                    for f in futures:
+                        f.cancel()
+                    status = getattr(e, "status", None) or 400
+                    return Response(error_body(status, str(e)), status)
+
+                def collect():
+                    return [f.result(timeout=timeout_s) for f in futures]
+
+                try:
+                    results = await loop.run_in_executor(None, collect)
+                except Exception as e:  # noqa: BLE001 - resumed gen failed
+                    for f in futures:
+                        f.cancel()
+                    status = getattr(e, "status", None) or 502
+                    return Response(error_body(status, str(e)), status)
+                self._flush_unit_metrics(unit)
+                return Response(
+                    {"results": results, "accepted": len(futures)}
+                )
+            units: Dict[str, Any] = {}
+            for name, target in self.units_with("drain_to"):
+                fn = target.drain_to
+                peer = body.get("to")
+                if not peer:
+                    return Response(
+                        error_body(400, "need 'to' (peer engine "
+                                   "host:port) or 'checkpoints'"), 400
+                    )
+                timeout_s = float(body.get("timeout_s", 60.0))
+                try:
+                    units[name] = await loop.run_in_executor(
+                        None, lambda f=fn: f(peer, timeout_s)
+                    )
+                except Exception as e:  # noqa: BLE001 - drain failed
+                    status = getattr(e, "status", None) or 502
+                    return Response(
+                        error_body(status, f"{name}: {e}"), status
+                    )
+                # a drained member refuses all further requests, so the
+                # usual per-response Meta.metrics flush can never carry
+                # its drain counters — export them now
+                self._flush_unit_metrics(target)
+            if not units:
+                return Response(
+                    error_body(501, "no unit supports migration"), 501
+                )
+            return Response({"units": units})
+
         app.add_route("/pause", pause)
         app.add_route("/unpause", unpause)
         app.add_route("/weights/swap", weights_swap)
+        app.add_route("/drain", drain)
         app.add_route("/inflight", inflight)
         app.add_route("/openapi.json", openapi)
         app.add_route("/api/v0.1/generate", generate_stream)

@@ -2339,6 +2339,224 @@ def bench_pressure(
     }
 
 
+def bench_migration(
+    root: str,
+    n_requests: int = 4,
+    prompt_len: int = 6,
+    max_new_tokens: int = 24,
+    slots: int = 4,
+    steps_per_poll: int = 1,
+    config: Optional[Dict[str, Any]] = None,
+    deadline_s: float = 120.0,
+    label: str = "llm-migration",
+) -> Dict[str, Any]:
+    """Zero-loss generate serving: the rolling-drain proof plus the
+    member-kill resume-token proof (serving/migration.py).
+
+    Rolling drain: two members serve a mixed greedy + seeded-sampling
+    batch (including one live stream); draining the loaded member
+    mid-decode hands every in-flight lane's SGC1 checkpoint (and queued
+    requests) to the peer. The acceptance bits: every request completes
+    byte-identical to an undisturbed single-member run — unary AND
+    streaming — with zero failures to clients, no stream span re-sent,
+    and the drain/checkpoint/migration counters matching the
+    flight-recorder records.
+
+    Member kill: a stream on a ``resume_tokens`` member dies mid-stream
+    (induced loop death, restart budget 0 latches dead); the last span's
+    resume token continues on the peer with at most ONE retry —
+    byte-identical total output, no span re-sent."""
+    from .servers.generateserver import GenerateServer
+
+    cfg = dict(config or {})
+    cfg.setdefault("max_seq", 64)
+    model_dir = write_model_dir(root, "llm", cfg)
+    vocab = cfg.get("vocab_size", 256)
+    budget = max(8, min(max_new_tokens, cfg["max_seq"] - prompt_len - 1))
+    common = dict(
+        model_uri=model_dir, steps_per_poll=steps_per_poll,
+        warmup_prompt_lens=[prompt_len], warmup_max_new_tokens=budget,
+    )
+    rs = np.random.RandomState(23)
+    prompts = [rs.randint(1, vocab, prompt_len).tolist()
+               for _ in range(n_requests)]
+    greedy_kw = dict(max_new_tokens=budget, temperature=0.0,
+                     eos_id=None, seed=0)
+
+    def seeded_kw(i):
+        return dict(max_new_tokens=budget, temperature=0.8,
+                    eos_id=None, seed=40 + i)
+
+    ref = GenerateServer(slots=slots, **common)
+    ref.load()
+    g_refs = [ref.batcher.generate(list(p), **greedy_kw) for p in prompts]
+    s_refs = [ref.batcher.generate(list(p), **seeded_kw(i))
+              for i, p in enumerate(prompts)]
+    stream_ref = ref.batcher.generate(list(prompts[0]), **seeded_kw(99))
+    ref.close()
+
+    t_start = time.perf_counter()
+    failures = 0
+    tokens_done = 0
+    slowest_s = 0.0
+
+    # -- rolling drain ---------------------------------------------------
+    src = GenerateServer(slots=slots, **common)
+    src.load()
+    dst = GenerateServer(slots=slots, **common)
+    dst.load()
+    drain_summary: Dict[str, Any] = {}
+    try:
+        spans: List[List[int]] = []
+        stream_final: Dict[str, Any] = {}
+        stream_done = threading.Event()
+        handle = src.stream({
+            "prompt_tokens": list(prompts[0]), **seeded_kw(99),
+        })
+
+        def consume():
+            try:
+                for ch in handle.chunks:
+                    if ch.get("done"):
+                        stream_final["final"] = ch
+                        break
+                    spans.append(list(ch["tokens"]))
+            except Exception as e:  # noqa: BLE001 - a 5xx is a failure
+                stream_final["error"] = repr(e)
+            finally:
+                stream_done.set()
+
+        threading.Thread(target=consume, daemon=True).start()
+        futs = [src.batcher.submit(list(p), **greedy_kw) for p in prompts]
+        futs += [src.batcher.submit(list(p), **seeded_kw(i))
+                 for i, p in enumerate(prompts)]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and len(src.batcher._active) < 2:
+            time.sleep(0.001)
+        t0 = time.perf_counter()
+        drain_summary = src.drain_to(dst)
+        outs = []
+        for f in futs:
+            try:
+                outs.append(f.result(timeout=deadline_s))
+            except Exception:  # noqa: BLE001 - counted as a client 5xx
+                outs.append(None)
+                failures += 1
+        slowest_s = max(slowest_s, time.perf_counter() - t0)
+        stream_done.wait(deadline_s)
+        want = g_refs + s_refs
+        drain_identical = all(
+            o is not None and o == w for o, w in zip(outs, want)
+        )
+        flat = [t for s in spans for t in s]
+        stream_ok = (
+            "error" not in stream_final
+            and stream_final.get("final", {}).get("tokens") == stream_ref
+            and flat == stream_ref[prompt_len:]
+        )
+        if not stream_ok:
+            failures += 1
+        tokens_done += sum(budget for o in outs if o) + len(flat)
+        # counters must match the flight-recorder records (the
+        # observability half of the acceptance criteria)
+        recs = src.batcher.flight.snapshot()
+        n_drain_recs = sum(1 for r in recs if r.get("type") == "drain")
+        n_export_recs = sum(
+            1 for r in recs if r.get("type") == "checkpoint_export"
+        )
+        counters_match = (
+            src.batcher.stats["drains"] == n_drain_recs
+            and src.batcher.stats["checkpoint_exports"] == n_export_recs
+            and dst.batcher.stats["migrated_resumes"]
+            == src.batcher.stats["migrations"]
+        )
+        drained_total = drain_summary.get("drained", 0)
+    finally:
+        src.close()
+        dst.close()
+
+    # -- member kill + resume-token retry --------------------------------
+    killed = GenerateServer(slots=slots, resume_tokens=1,
+                            restart_budget=0, **common)
+    killed.load()
+    peer = GenerateServer(slots=slots, resume_tokens=1, **common)
+    peer.load()
+    kill_identical = False
+    retries = 0
+    try:
+        t0 = time.perf_counter()
+        handle = killed.stream({
+            "prompt_tokens": list(prompts[0]), **seeded_kw(99),
+        })
+        it = iter(handle.chunks)
+        first = next(it)
+        delivered = list(first["tokens"])
+        token = first.get("resume_token")
+
+        def die(_n):
+            raise RuntimeError("bench: injected member kill")
+
+        killed.batcher.fault_hook = die
+        try:
+            for ch in it:
+                if ch.get("done"):
+                    break
+                delivered.extend(ch["tokens"])
+                token = ch.get("resume_token", token)
+        except Exception:  # noqa: BLE001 - typed death expected
+            pass
+        if token is not None:
+            retries = 1  # ONE engine-internal retry with the token
+            h2 = peer.stream({"resume_token": token})
+            resumed: List[int] = []
+            final = None
+            for ch in h2.chunks:
+                if ch.get("done"):
+                    final = ch
+                    break
+                resumed.extend(ch["tokens"])
+            kill_identical = (
+                final is not None
+                and final["tokens"] == stream_ref
+                and delivered + resumed == stream_ref[prompt_len:]
+            )
+            tokens_done += len(resumed)
+        if not kill_identical:
+            failures += 1
+        slowest_s = max(slowest_s, time.perf_counter() - t0)
+    finally:
+        killed.close()
+        peer.close()
+
+    elapsed = time.perf_counter() - t_start
+    return {
+        "model": label,
+        "scenario": (
+            "graceful drain mid-decode (mixed greedy+seeded batch + "
+            "live stream) to a peer, then a member kill resumed from "
+            "the stream's SGC1 resume token; byte-identity, zero "
+            "client failures, no span re-sent"
+        ),
+        "prompt_len": prompt_len,
+        "max_new_tokens": budget,
+        "requests_total": 2 * n_requests + 2,
+        # the acceptance bits
+        "greedy_identical": drain_identical,
+        "stream_no_resend": stream_ok,
+        "drained": drained_total,
+        "checkpoints_migrated": drain_summary.get("handed", 0),
+        "zero_failures": failures == 0,
+        "counters_match_flight": counters_match,
+        "kill_resume_identical": kill_identical,
+        "kill_retries": retries,
+        "no_hang": slowest_s <= deadline_s,
+        "slowest_request_s": round(slowest_s, 3),
+        "tokens_per_s": round(tokens_done / max(elapsed, 1e-9), 2),
+        "p50_ms": None,
+        "p99_ms": None,
+    }
+
+
 def _ablate_generate(
     root: str,
     base_kw: Dict[str, Any],
@@ -2538,6 +2756,20 @@ def run_model_tier(
             results["llm_1b_pressure"] = bench_pressure(
                 root, n_requests=6, prompt_len=6, max_new_tokens=16,
                 slots=2, steps_per_poll=4,
+                config={
+                    "vocab_size": 256, "d_model": 32, "n_layers": 2,
+                    "n_heads": 2, "n_kv_heads": 2, "d_ff": 64, "max_seq": 64,
+                },
+            )
+            # zero-loss serving proof: graceful drain of a loaded member
+            # mid-decode (mixed greedy+seeded batch + live stream) hands
+            # every lane's SGC1 checkpoint to a peer byte-identically
+            # with zero client failures and no stream span re-sent, and
+            # a killed member's stream resumes from its resume token
+            # with one retry (chip scales the same harness)
+            results["llm_1b_migration"] = bench_migration(
+                root, n_requests=3, prompt_len=6, max_new_tokens=16,
+                slots=2, steps_per_poll=1,
                 config={
                     "vocab_size": 256, "d_model": 32, "n_layers": 2,
                     "n_heads": 2, "n_kv_heads": 2, "d_ff": 64, "max_seq": 64,
@@ -2889,6 +3121,17 @@ def run_model_tier(
                 root, label="llm-1.26b-pressure",
                 n_requests=8, prompt_len=128, max_new_tokens=64,
                 slots=4, steps_per_poll=16,
+                config={**big_cfg, "max_seq": 256},
+            )
+            # migration at flagship scale: the recompute-resume a drain
+            # hands the peer is paid at real model size (a 1.26B prefill
+            # + teacher-forced replay is the true migration price);
+            # byte-identity, zero failures, and no-span-resend still
+            # required
+            results["llm_1b_migration"] = bench_migration(
+                root, label="llm-1.26b-migration",
+                n_requests=4, prompt_len=128, max_new_tokens=32,
+                slots=4, steps_per_poll=8,
                 config={**big_cfg, "max_seq": 256},
             )
             # long-context serving, small decoder: the fast-step regime

@@ -104,6 +104,25 @@ Model URI layout: same ``jax_config.json`` as jaxserver with
                      (default 0.90): crossing it latches pressure
     pressure_low     low watermark (default 0.75): reclaim runs until
                      usage drops here, then admissions resume
+    resume_tokens    live migration: attach an opaque SGC1 resume token
+                     (serving/migration.py) to every streamed span (and
+                     the unary response) so a member death mid-
+                     generation is survivable — resubmit the token on
+                     any peer serving the same weight_version and the
+                     generation continues byte-identical with no span
+                     re-sent (0 = off; incompatible with speculation —
+                     the token's RNG re-derivation assumes plain
+                     decode). See docs/generate.md "Live migration &
+                     resumable streams"
+    swap_drain_ms    hot-swap straggler bound: after this long draining
+                     a staged weight swap, preempt-checkpoint the
+                     remaining in-flight lanes so one long generation
+                     cannot stall the flip (0 = wait forever)
+    swap_resume_policy
+                     what happens to swap-preempted stragglers:
+                     ``resume`` (default) re-queues them to finish on
+                     the NEW weights; ``fail`` refuses them typed
+                     (WeightVersionMismatch, 409-class)
 
 Request (jsonData)::
 
@@ -148,6 +167,7 @@ class GenerateServer(SeldonComponent):
     _role = "unified"
     _kv_server = None
     _kv_client = None
+    _resume_tokens = False
     batcher = None
 
     def __init__(
@@ -180,6 +200,9 @@ class GenerateServer(SeldonComponent):
         hbm_ledger_bytes: int = 0,
         pressure_high: float = 0.90,
         pressure_low: float = 0.75,
+        resume_tokens: int = 0,
+        swap_drain_ms: int = 0,
+        swap_resume_policy: str = "resume",
         warmup_prompt_lens: Optional[Sequence[int]] = None,
         warmup_max_new_tokens: int = 0,
         **kwargs,
@@ -200,6 +223,20 @@ class GenerateServer(SeldonComponent):
         self._hbm_ledger_bytes = int(hbm_ledger_bytes)
         self._pressure_high = float(pressure_high)
         self._pressure_low = float(pressure_low)
+        # typed-params env delivers booleans as strings
+        self._resume_tokens = (
+            resume_tokens.lower() == "true"
+            if isinstance(resume_tokens, str) and not resume_tokens.isdigit()
+            else bool(int(resume_tokens))
+        )
+        self._swap_drain_ms = int(swap_drain_ms)
+        self._swap_resume_policy = str(swap_resume_policy or "resume")
+        if self._resume_tokens and int(speculate_tokens) > 0:
+            raise ValueError(
+                "resume_tokens is not supported with speculative decoding "
+                "(the token's RNG re-derivation assumes the plain decode "
+                "split chain)"
+            )
         self._kv_server = None   # PrefillTransportServer (prefill role)
         self._kv_client = None   # FailoverKVClient over the peer list (decode)
         self._faults = None      # FaultInjector (chaos harness), set at load
@@ -347,6 +384,8 @@ class GenerateServer(SeldonComponent):
             hbm_ledger_bytes=self._hbm_ledger_bytes,
             pressure_high=self._pressure_high,
             pressure_low=self._pressure_low,
+            swap_drain_ms=self._swap_drain_ms,
+            swap_resume_policy=self._swap_resume_policy,
         )
         # chaos harness (off without SELDON_FAULTS): the scheduler
         # section wires induced poll death onto the batcher's fault
@@ -592,6 +631,171 @@ class GenerateServer(SeldonComponent):
         return b.submit(toks, deadline_s=deadline_s, on_tokens=on_tokens,
                         **kw)
 
+    # -- live-lane migration (graceful drain + resume tokens) --------------
+
+    @caller_thread
+    def resume_checkpoint(self, ck, on_tokens=None):
+        """Admit one generate checkpoint — an SGC1 dict, a base64 resume
+        token, or raw SGC1 bytes — and continue the generation exactly
+        where it stopped (byte-identical, spans never re-sent). The
+        decode-side entry point of a drain handoff and of a client's
+        crash-resume retry; the engine's ``POST /drain`` import mode
+        lands here per checkpoint."""
+        from ..serving.migration import decode_checkpoint, parse_token
+
+        if self.batcher is None:
+            self.load()
+        if isinstance(ck, str):
+            ck = parse_token(ck)
+        elif isinstance(ck, (bytes, bytearray)):
+            ck = decode_checkpoint(bytes(ck))
+        return self.batcher.submit_checkpoint(ck, on_tokens=on_tokens)
+
+    def _settle_migrated(self, req, peer_future) -> None:
+        """Done-callback chaining a migrated request's peer future back
+        into the ORIGINAL future the local client thread is waiting on:
+        the connection that carried the request never sees the drain."""
+        if req.future.done():
+            return
+        try:
+            req.future.set_result(peer_future.result())
+        except Exception as e:  # noqa: BLE001 - relay the typed failure
+            req.future.set_exception(e)
+
+    @caller_thread
+    def drain_to(self, peer, timeout_s: float = 60.0) -> Dict[str, Any]:
+        """Graceful drain: checkpoint every in-flight generation at a
+        poll boundary (``ContinuousBatcher.drain`` — the member flips to
+        the ``"draining"`` health state and refuses new work typed) and
+        hand the checkpoints plus queued requests to ``peer``:
+
+        * a live server object exposing ``resume_checkpoint`` —
+          loopback: per-request futures chain back into the original
+          waiters and streamed spans keep flowing through the original
+          ``on_tokens`` consumer, so clients observe nothing;
+        * a ``"host:port"`` string — the peer ENGINE's ``POST /drain``
+          route over TCP (``serving.migration.post_drain``): the final
+          token lists come back positionally, stream consumers get the
+          post-checkpoint tail as one span (never a re-send).
+
+        Every request completes byte-identical to an uninterrupted run
+        (greedy and seeded sampling — the SGC1 checkpoint carries the
+        exact post-split RNG lane key). Returns a summary dict; failed
+        handoffs fail their original futures typed rather than hanging
+        them."""
+        from ..serving import migration
+
+        if self.batcher is None:
+            self.load()
+        b = self.batcher
+        drained = b.drain(timeout_s=timeout_s)
+        cks = [migration.checkpoint_of(req, b.weight_version)
+               for req in drained]
+        with b._export_lock:
+            b.stats["checkpoint_exports"] += len(cks)
+        if b.flight is not None and b.flight.enabled:
+            for ck in cks:
+                b.flight.record({
+                    "type": "checkpoint_export",
+                    "tokens": len(ck["prompt"]),
+                    "emitted": len(ck["emitted"]),
+                    "weight_version": b.weight_version,
+                })
+        handed = failed = 0
+        if hasattr(peer, "resume_checkpoint"):
+            for req, ck in zip(drained, cks):
+                try:
+                    pf = peer.resume_checkpoint(ck, on_tokens=req.on_tokens)
+                except Exception as e:  # noqa: BLE001 - typed refusal
+                    failed += 1
+                    if not req.future.done():
+                        req.future.set_exception(e)
+                    continue
+                handed += 1
+                pf.add_done_callback(
+                    lambda f, req=req: self._settle_migrated(req, f)
+                )
+        else:
+            try:
+                results = migration.post_drain(
+                    str(peer), cks, timeout_s=timeout_s
+                )
+            except Exception as e:  # noqa: BLE001 - typed refusal
+                for req in drained:
+                    failed += 1
+                    if not req.future.done():
+                        req.future.set_exception(e)
+                results = None
+            if results is not None:
+                for req, ck, res in zip(drained, cks, results):
+                    handed += 1
+                    if req.on_tokens is not None:
+                        # the post-checkpoint tail as one span: spans at
+                        # or before stream_pos were already delivered
+                        tail = list(res)[
+                            len(ck["prompt"]) + ck["stream_pos"]:
+                        ]
+                        if tail:
+                            try:
+                                req.on_tokens(tail)
+                            except Exception:  # noqa: BLE001 - consumer bug
+                                logger.exception("on_tokens relay failed")
+                    if not req.future.done():
+                        req.future.set_result(list(res))
+        with b._export_lock:
+            b.stats["migrations"] += handed
+        if b.flight is not None and b.flight.enabled and handed:
+            b.flight.record({
+                "type": "migrated_resume",
+                "peer": getattr(peer, "model_uri", None) or str(peer),
+                "handed": handed,
+            })
+        logger.info(
+            "drain_to: %d checkpoint(s) exported, %d handed to the "
+            "peer, %d failed typed", len(cks), handed, failed,
+        )
+        return {
+            "drained": len(drained),
+            "checkpoints": len(cks),
+            "handed": handed,
+            "failed": failed,
+        }
+
+    def _make_resume_token(self, req, prompt, delivered, kw,
+                           text_mode=False) -> str:
+        """Opaque resume token for a live generation: the SGC1 payload
+        over prompt + delivered-so-far, keyless (the resume side
+        re-derives the lane key from seed + emitted count) so refreshing
+        it per span costs zero device syncs. ``text_mode`` rides the
+        checkpoint so a resumed strData stream keeps decoding ``text``
+        fields."""
+        import time as _time
+
+        from ..serving.migration import checkpoint_token
+
+        gr = getattr(req, "gen_request", None) or req
+        now = _time.monotonic()
+        return checkpoint_token({
+            "v": 1,
+            "prompt": [int(t) for t in prompt],
+            "emitted": [int(t) for t in delivered],
+            "rng_key": None,
+            "text_mode": bool(text_mode),
+            "max_new_tokens": int(kw.get("max_new_tokens", 32)),
+            "temperature": float(kw.get("temperature", 0.0)),
+            "eos_id": kw.get("eos_id"),
+            "seed": int(kw.get("seed", 0)),
+            "weight_version": self.batcher.weight_version,
+            "wait_s": round(max(0.0, now - gr.submit_t), 6)
+            if getattr(gr, "submit_t", 0.0) else 0.0,
+            "submit_wall_us": int(getattr(gr, "submit_wall_us", 0) or 0),
+            "deadline_s": (
+                max(0.0, gr.deadline_t - now)
+                if getattr(gr, "deadline_t", None) is not None else None
+            ),
+            "stream_pos": len(delivered),
+        })
+
     @caller_thread
     def _collect_results(self, futures, token_lists, kw, deadline_s,
                          expires_at, retry_prefix_gone=False):
@@ -719,7 +923,6 @@ class GenerateServer(SeldonComponent):
                 raise ValueError(
                     "generate expects jsonData {prompt_tokens|prompt, ...} or strData"
                 )
-        token_lists, text_mode, kw = self._parse_prompts(body)
         # remaining deadline budget rides the request meta (stamped per
         # hop by the graph executor): the batcher sheds the submit when
         # its admit queue cannot meet it (ShedError -> engine 429)
@@ -731,6 +934,32 @@ class GenerateServer(SeldonComponent):
         expires_at = (
             _time.monotonic() + deadline_s if deadline_s is not None else None
         )
+        if body.get("resume_token"):
+            # crash-resume retry: the opaque SGC1 token continues the
+            # generation exactly where the dead member stopped —
+            # byte-identical, wait telemetry cumulative
+            from ..serving.migration import parse_token
+
+            ck = parse_token(str(body["resume_token"]))
+            fut = self.batcher.submit_checkpoint(ck)
+            gr = getattr(fut, "gen_request", None)
+            prompt = list(gr.tokens) if gr is not None else []
+            results = self._collect_results(
+                [fut], [prompt], {}, deadline_s, expires_at
+            )
+            out: Dict[str, Any] = {"tokens": results}
+            if ck.get("text_mode"):
+                out["text"] = [self._decode(results[0][len(prompt):])]
+            if self._resume_tokens and gr is not None:
+                out["resume_tokens"] = [self._make_resume_token(
+                    fut, prompt, results[0][len(prompt):],
+                    {"max_new_tokens": gr.max_new_tokens,
+                     "temperature": gr.temperature,
+                     "eos_id": gr.eos_id, "seed": gr.seed},
+                    text_mode=bool(ck.get("text_mode")),
+                )]
+            return out
+        token_lists, text_mode, kw = self._parse_prompts(body)
         if self._role == "decode":
             # disaggregated path: prefill happens at the peer pool, the
             # slab crosses the KV transport, decode runs here
@@ -738,7 +967,7 @@ class GenerateServer(SeldonComponent):
                 token_lists, kw, deadline_s, expires_at
             )
             return self._build_response(
-                futures, results, token_lists, text_mode
+                futures, results, token_lists, text_mode, kw=kw
             )
         futures = []
         try:
@@ -758,13 +987,22 @@ class GenerateServer(SeldonComponent):
         results = self._collect_results(
             futures, token_lists, kw, deadline_s, expires_at
         )
-        return self._build_response(futures, results, token_lists, text_mode)
+        return self._build_response(
+            futures, results, token_lists, text_mode, kw=kw
+        )
 
-    def _build_response(self, futures, results, token_lists, text_mode):
+    def _build_response(self, futures, results, token_lists, text_mode,
+                        kw=None):
         out: Dict[str, Any] = {"tokens": results}
         if text_mode:
             out["text"] = [
                 self._decode(r[len(p):]) for r, p in zip(results, token_lists)
+            ]
+        if self._resume_tokens and kw is not None:
+            out["resume_tokens"] = [
+                self._make_resume_token(f, p, r[len(p):], kw,
+                                        text_mode=text_mode)
+                for f, r, p in zip(futures, results, token_lists)
             ]
         if self.batcher._prefix_index is not None:
             # per-request prompt tokens served from the prefix cache, in
@@ -791,37 +1029,65 @@ class GenerateServer(SeldonComponent):
 
         if self.batcher is None:
             self.load()
-        token_lists, text_mode, kw = self._parse_prompts(body)
-        if len(token_lists) != 1:
-            raise ValueError("stream takes ONE prompt")
-        toks = token_lists[0]
-        q: "_queue.Queue" = _queue.Queue()
         if self._role == "prefill":
             raise RuntimeError(
                 "prefill-role pool members serve the KV transport only"
             )
-        if self._role == "decode":
-            # streamed disaggregated generate: the slab handoff happens
-            # before the first byte goes out, then tokens stream as spans
-            # land exactly like the unary path. Always the FULL slab
-            # (covered=0): the unary path's PrefixGone retry cannot be
-            # replayed once response bytes exist, so streaming trades the
-            # transfer dedup for a handoff that can never lose its donor
-            # mid-stream
-            fut = self._remote_submit(toks, kw, None, covered=0,
-                                      on_tokens=q.put)
+        q: "_queue.Queue" = _queue.Queue()
+        if body.get("resume_token"):
+            # crash-resume of an interrupted stream: continue from the
+            # token's checkpoint — only NEW spans are yielded (crediting
+            # resumes after the checkpoint), so no span is ever re-sent
+            from ..serving.migration import parse_token
+
+            ck = parse_token(str(body["resume_token"]))
+            text_mode = bool(ck.get("text_mode"))
+            toks = [int(t) for t in ck["prompt"]]
+            kw = dict(
+                max_new_tokens=int(ck.get("max_new_tokens", 32)),
+                temperature=float(ck.get("temperature", 0.0)),
+                eos_id=ck.get("eos_id"),
+                seed=int(ck.get("seed", 0)),
+            )
+            resume_base = [int(t) for t in ck.get("emitted") or []]
+            fut = self.batcher.submit_checkpoint(ck, on_tokens=q.put)
         else:
-            fut = self.batcher.submit(toks, on_tokens=q.put, **kw)
+            token_lists, text_mode, kw = self._parse_prompts(body)
+            if len(token_lists) != 1:
+                raise ValueError("stream takes ONE prompt")
+            toks = token_lists[0]
+            resume_base = []
+            if self._role == "decode":
+                # streamed disaggregated generate: the slab handoff
+                # happens before the first byte goes out, then tokens
+                # stream as spans land exactly like the unary path.
+                # Always the FULL slab (covered=0): the unary path's
+                # PrefixGone retry cannot be replayed once response
+                # bytes exist, so streaming trades the transfer dedup
+                # for a handoff that can never lose its donor mid-stream
+                fut = self._remote_submit(toks, kw, None, covered=0,
+                                          on_tokens=q.put)
+            else:
+                fut = self.batcher.submit(toks, on_tokens=q.put, **kw)
         fut.add_done_callback(lambda _f: q.put(None))
 
         def chunks():
+            # delivered-so-far accumulator: the per-span resume token is
+            # the SGC1 checkpoint over prompt + delivered (keyless — the
+            # resume side re-derives the lane key), refreshed per span
+            delivered = list(resume_base)
             while True:
                 item = q.get()
                 if item is None:
                     break
+                delivered.extend(int(t) for t in item)
                 chunk: Dict[str, Any] = {"tokens": item}
                 if text_mode:
                     chunk["text"] = self._decode(item)
+                if self._resume_tokens:
+                    chunk["resume_token"] = self._make_resume_token(
+                        fut, toks, delivered, kw, text_mode=text_mode
+                    )
                 yield chunk
             result = fut.result(timeout=600.0)
             final: Dict[str, Any] = {"done": True, "tokens": result}
@@ -1012,6 +1278,24 @@ class GenerateServer(SeldonComponent):
         if s.get("degraded_local_prefill"):
             out.append(delta("gen_degraded_local_prefill",
                              s["degraded_local_prefill"]))
+        # live migration: graceful drains, checkpoints exported/handed
+        # to a peer, resumes admitted from wire checkpoints or resume
+        # tokens, and hot-swap straggler preemptions — engine_metrics
+        # maps these to seldon_engine_drains_total /
+        # seldon_engine_migrations_total and friends
+        if s.get("drains"):
+            out.append(delta("gen_drains", s["drains"]))
+        if s.get("checkpoint_exports"):
+            out.append(delta("gen_checkpoint_exports",
+                             s["checkpoint_exports"]))
+        if s.get("migrations"):
+            out.append(delta("gen_migrations", s["migrations"]))
+        if s.get("migrated_resumes"):
+            out.append(delta("gen_migrated_resumes",
+                             s["migrated_resumes"]))
+        if s.get("swap_preemptions"):
+            out.append(delta("gen_swap_preemptions",
+                             s["swap_preemptions"]))
         # HBM pressure: preemption/resume/shed counters plus the ledger
         # gauges — engine_metrics maps them to the first-class
         # seldon_engine_pressure_* / seldon_engine_preemptions series so

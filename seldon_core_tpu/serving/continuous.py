@@ -216,6 +216,22 @@ class _SwapJob:
     # double-buffered param bytes — the pressure ledger's "swap"
     # component while the drain holds both versions resident
     nbytes: int = 0
+    # when the swap was staged (monotonic): the straggler bound
+    # (swap_drain_ms) is measured from here, so one long generation
+    # cannot stall the flip indefinitely
+    staged_t: float = 0.0
+
+
+@dataclasses.dataclass
+class _DrainJob:
+    """A requested graceful drain: the scheduler checkpoints every live
+    lane at the next poll boundary (reusing the preemption machinery),
+    collects chunked admissions, the resume queue, and queued-not-
+    admitted requests, and resolves the future with the full list of
+    :class:`GenRequest` — each carrying its host-side checkpoint in
+    ``resume`` — for the caller to hand to a peer."""
+
+    future: Future = dataclasses.field(default_factory=Future)
 
 
 @dataclasses.dataclass
@@ -278,6 +294,8 @@ class ContinuousBatcher:
         hbm_ledger_bytes: int = 0,
         pressure_high: float = 0.90,
         pressure_low: float = 0.75,
+        swap_drain_ms: int = 0,
+        swap_resume_policy: str = "resume",
     ):
         import jax
         import jax.numpy as jnp
@@ -516,6 +534,36 @@ class ContinuousBatcher:
         self._swap_lock = threading.Lock()
         self._pending_swap: Optional[_SwapJob] = None
         self._swap_seq = 0
+        # hot-swap straggler bound: after this long draining, in-flight
+        # lanes are preempt-checkpointed so one long generation cannot
+        # stall a weight flip indefinitely (0 = wait forever, the
+        # pre-existing behavior). Policy for the checkpointed
+        # stragglers: "resume" re-queues them to continue on the NEW
+        # weights (their prefix replays under the new version — a
+        # deliberate, documented identity trade); "fail" refuses them
+        # typed (WeightVersionMismatch, 409-class) so the client
+        # re-submits under the new version knowingly.
+        self.swap_drain_ms = max(0, int(swap_drain_ms))
+        if swap_resume_policy not in ("resume", "fail"):
+            raise ValueError(
+                f"swap_resume_policy must be resume|fail, got "
+                f"{swap_resume_policy!r}"
+            )
+        self.swap_resume_policy = swap_resume_policy
+        # -- graceful drain / live-lane migration -------------------------
+        # drain() stages a _DrainJob; the scheduler checkpoints every
+        # live lane at a poll boundary and hands the host-side
+        # checkpoints back for migration to a peer (serving/migration.py)
+        self._pending_drain: Optional[_DrainJob] = None
+        self._drain_lock = threading.Lock()
+        self.stats.update({
+            # drains completed, checkpoints exported to a peer,
+            # checkpoints successfully migrated (peer accepted), resumes
+            # admitted FROM a wire checkpoint/resume token, and lanes
+            # preempt-checkpointed by the hot-swap straggler bound
+            "drains": 0, "checkpoint_exports": 0, "migrations": 0,
+            "migrated_resumes": 0, "swap_preemptions": 0,
+        })
 
         # -- device state ----------------------------------------------------
         # The persistent KV cache lives UNSTACKED: per-layer [S, KV, T, Dh]
@@ -1292,8 +1340,21 @@ class ContinuousBatcher:
         )
 
     def _check_alive(self) -> None:
-        if self._stop.is_set():
+        # the health latch is checked alongside _stop: _crash_recover
+        # writes health="dead" a few instructions before it sets _stop,
+        # and an entrypoint landing in that window must still refuse
+        # (drain() in particular must never overwrite the dead latch)
+        if self._stop.is_set() or self.health in ("dead", "closed"):
             raise self._dead_error()
+        if self.health == "draining":
+            # a draining member refuses new work typed (503 +
+            # Retry-After) so the gateway/engine routes the retry at a
+            # peer; in-flight work is being checkpointed and handed
+            # over, not dropped
+            raise BatcherDead(
+                "batcher is draining for migration; retry another member",
+                retry_after_s=1.0,
+            )
 
     def _check_budget(self, prompt_len: int, max_new_tokens) -> None:
         """Reject ``prompt_len + max_new_tokens > max_seq`` at the
@@ -1652,6 +1713,170 @@ class ContinuousBatcher:
         self.start()
         return req.future
 
+    # -- live-lane migration (graceful drain + wire-checkpoint resume) -----
+
+    @caller_thread
+    def drain(self, timeout_s: float = 30.0) -> List[GenRequest]:
+        """Graceful drain: checkpoint every live lane at the next poll
+        boundary (the same preemption machinery PR 9 built — emitted
+        tokens + post-split RNG lane key + sampling params, NOT the
+        K/V), stop admissions (``health = "draining"``, new submits
+        refuse typed 503), and return EVERY request this batcher still
+        owes an answer for: checkpointed lanes (``req.resume`` set),
+        mid-chunked-prefill admissions (requeued whole), the preemption
+        resume queue, and queued-not-admitted requests. The caller
+        (``GenerateServer.drain_to``) hands them to a peer via the SGC1
+        codec; their futures stay pending until the peer answers —
+        rolling maintenance drops zero requests.
+
+        A dead/closed member has nothing drainable (its queued futures
+        were already failed typed by the supervisor's drain), so the
+        entry check's :class:`BatcherDead` propagates. A drain that
+        outruns ``timeout_s`` is CANCELLED, not stranded: the scheduler
+        observes the cancellation, keeps (or re-queues) the work, and
+        restores ``health = "serving"`` so the member resumes normal
+        service instead of latching draining forever."""
+        self._check_alive()
+        with self._drain_lock:
+            if self._pending_drain is not None:
+                raise RuntimeError("a drain is already in progress")
+            # re-check under the lock: the supervisor writes the dead
+            # latch without it, and overwriting "dead" with "draining"
+            # would misreport a terminally dead member as mid-drain
+            if self._stop.is_set() or self.health in ("dead", "closed"):
+                raise self._dead_error()
+            # refuse new admissions NOW (caller threads see it before
+            # the scheduler reaches the poll boundary) — a request
+            # admitted after this line would miss the checkpoint sweep
+            self.health = "draining"
+            job = _DrainJob()
+            self._pending_drain = job
+        self.start()
+        from concurrent.futures import TimeoutError as _FuturesTimeout
+
+        try:
+            return job.future.result(timeout=timeout_s)
+        except _FuturesTimeout:
+            if not job.future.cancel():
+                # the scheduler is resolving the drain RIGHT NOW (the
+                # future is running/done): take the result after a
+                # short grace instead of abandoning checkpointed work
+                return job.future.result(timeout=5.0)
+            # cancelled before the scheduler started it: the next poll
+            # clears the latch and resumes admissions (_do_drain's
+            # set_running_or_notify_cancel branch)
+            raise RuntimeError(
+                f"drain did not complete within {timeout_s}s; cancelled "
+                "— admissions resume on the next poll"
+            )
+
+    @caller_thread
+    def submit_checkpoint(self, ck: Dict[str, Any], on_tokens=None) -> Future:
+        """Admit a wire checkpoint (an SGC1 dict — a drained peer's
+        lane, or a client resume token) and continue the generation
+        exactly where it stopped: the scheduler resumes it through
+        :meth:`_admit_resume` (prompt K/V recompute + teacher-forced
+        replay of the emitted tokens), so greedy AND seeded-sampling
+        output is byte-identical to an uninterrupted run and crediting
+        continues after the checkpoint (already-delivered stream spans
+        are never re-sent).
+
+        Typed refusals, all BEFORE any lane state exists: a checkpoint
+        from another ``weight_version`` raises
+        :class:`~.disagg.WeightVersionMismatch` (its emitted prefix is
+        not reproducible under these weights); over-long prompts and
+        budget overruns raise the same 413-class errors ``submit``
+        does. The checkpoint's cumulative wait anchor re-bases
+        ``submit_t`` so queue-wait telemetry spans both members."""
+        from .disagg import WeightVersionMismatch
+
+        self._check_alive()
+        wv = ck.get("weight_version")
+        if wv is not None and wv != self.weight_version:
+            raise WeightVersionMismatch(
+                f"checkpoint was taken under weight_version {wv!r} but "
+                f"this member serves {self.weight_version!r} — its "
+                "emitted prefix is not reproducible here"
+            )
+        tokens = [int(t) for t in ck.get("prompt") or []]
+        if not tokens:
+            raise ValueError("checkpoint carries no prompt tokens")
+        if len(tokens) >= self.max_seq:
+            raise PromptTooLong(
+                f"checkpoint prompt of {len(tokens)} exceeds max_seq "
+                f"{self.max_seq}"
+            )
+        mnt = int(ck.get("max_new_tokens", 32))
+        self._check_budget(len(tokens), mnt)
+        emitted = [int(t) for t in ck.get("emitted") or []]
+        if len(emitted) > mnt:
+            raise ValueError(
+                f"checkpoint emitted {len(emitted)} tokens past its "
+                f"max_new_tokens {mnt}"
+            )
+        req = GenRequest(
+            tokens=tokens,
+            max_new_tokens=mnt,
+            temperature=float(ck.get("temperature", 0.0)),
+            eos_id=ck.get("eos_id"),
+            seed=int(ck.get("seed", 0)),
+            on_tokens=on_tokens,
+        )
+        now = time.monotonic()
+        # cumulative queue-wait anchor: the time the request already
+        # waited on the source member rides the checkpoint, so the
+        # queue-wait histogram sees source wait + local wait instead of
+        # restarting the clock at migration
+        wait_s = max(0.0, float(ck.get("wait_s") or 0.0))
+        req.submit_t = now - wait_s
+        req.submit_wall_us = (
+            int(ck.get("submit_wall_us") or 0) or wall_us(req.submit_t)
+        )
+        dl = ck.get("deadline_s")
+        if dl is not None:
+            req.deadline_t = now + max(0.0, float(dl))
+        if emitted and (
+            len(emitted) >= mnt
+            or (req.eos_id is not None and emitted[-1] == req.eos_id)
+        ):
+            # the checkpoint is already COMPLETE (a final-state resume
+            # token): nothing is left to decode, so answer host-side
+            # without occupying a lane — re-admitting it would append
+            # one overshoot token before the done check could fire
+            req.future.gen_request = req
+            req.future.set_result(tokens + emitted)
+            with self._export_lock:
+                self.stats["migrated_resumes"] += 1
+            return req.future
+        if emitted:
+            key = ck.get("rng_key")
+            if key is None:
+                # crash tokens ship keyless (reading the lane key per
+                # span would cost a host sync per span): re-derive it
+                # from the deterministic split chain
+                from .migration import derive_lane_key
+
+                key = derive_lane_key(req.seed, len(emitted))
+            req.resume = {
+                "emitted": emitted, "key": [int(k) for k in key],
+            }
+        with self._export_lock:
+            self.stats["migrated_resumes"] += 1
+        if self.flight is not None and self.flight.enabled:
+            self.flight.record({
+                "type": "migrated_resume",
+                "tokens": len(tokens),
+                "emitted": len(emitted),
+                "weight_version": self.weight_version,
+            })
+        req.future.gen_request = req
+        self._queue.put(req)
+        if self._stop.is_set():
+            self._drain_queue(self._dead_error())
+            return req.future
+        self.start()
+        return req.future
+
     @caller_thread
     def request_weight_swap(self, params, version=None) -> Future:
         """Stage a live weight hot-swap; returns a Future resolving to
@@ -1729,6 +1954,7 @@ class ContinuousBatcher:
                     for leaf in jax.tree_util.tree_leaves(params)
                     if hasattr(leaf, "nbytes")
                 ),
+                staged_t=time.monotonic(),
             )
             self._pending_swap = job
         # the loop must be alive to execute the swap, traffic or not
@@ -1800,6 +2026,139 @@ class ContinuousBatcher:
         )
         if not swap.future.done():
             swap.future.set_result(swap.version)
+
+    @scheduler_only
+    def _swap_preempt_stragglers(self, pending) -> None:
+        """Hot-swap straggler bound: the drain has run past
+        ``swap_drain_ms``, so preempt-checkpoint every in-flight lane
+        (and chunked admission) instead of holding the flip hostage to
+        one long generation. Policy ``"resume"`` requeues them — they
+        resume AFTER the flip, on the NEW weights (an explicit identity
+        trade the knob documents); ``"fail"`` refuses them typed
+        (WeightVersionMismatch, 409-class) so the client re-submits
+        under the new version knowingly."""
+        self._drain_pending(pending)
+        victims: List[GenRequest] = []
+        for slot in sorted(self._chunked):
+            victims.append(self._chunked.pop(slot).request)
+        for slot in sorted(self._active):
+            _s, req = self._checkpoint_lane(slot)
+            victims.append(req)
+        if not victims:
+            return
+        self.stats["swap_preemptions"] += len(victims)
+        if self.flight is not None and self.flight.enabled:
+            self.flight.record({
+                "type": "swap_straggler_preempt",
+                "lanes": len(victims),
+                "policy": self.swap_resume_policy,
+                "swap_drain_ms": self.swap_drain_ms,
+            })
+        logger.warning(
+            "weight swap straggler bound hit after %dms: %d in-flight "
+            "lane(s) preempt-checkpointed (policy=%s)",
+            self.swap_drain_ms, len(victims), self.swap_resume_policy,
+        )
+        if self.swap_resume_policy == "fail":
+            from .disagg import WeightVersionMismatch
+
+            for req in victims:
+                if req.resume is None:
+                    # zero tokens emitted (chunked admission / fresh
+                    # lane): there is no old-weights prefix to betray —
+                    # a plain re-admit under the new weights reproduces
+                    # its stream from the seed alone, so failing it
+                    # would be a needless 409
+                    self._resume_queue.append(req)
+                elif not req.future.done():
+                    req.future.set_exception(WeightVersionMismatch(
+                        "generation preempted by a weight swap after "
+                        f"swap_drain_ms={self.swap_drain_ms} and "
+                        "swap_resume_policy=fail forbids resuming its "
+                        "emitted prefix under the new weights; re-submit"
+                    ))
+        else:
+            for req in victims:
+                self._resume_queue.append(req)
+
+    @scheduler_only
+    def _do_drain(self, job: _DrainJob, pending) -> None:
+        """Execute a staged graceful drain at this poll boundary:
+        flush the pipeline (checkpoints must see exact host state),
+        checkpoint every live lane, collect chunked admissions whole,
+        then sweep the resume queue and the admit queue. Admissions are
+        already refused (``health == "draining"`` flipped on the caller
+        thread), so the collected list is complete. A job whose caller
+        timed out and cancelled is aborted BEFORE any lane is touched —
+        the latch clears and the member resumes serving with its work
+        intact."""
+        if not job.future.set_running_or_notify_cancel():
+            # the drain() caller gave up (timeout): nothing was
+            # checkpointed yet, so just un-latch and keep serving
+            with self._drain_lock:
+                if self._pending_drain is job:
+                    self._pending_drain = None
+            self.health = "serving"
+            logger.warning(
+                "graceful drain cancelled by its caller before the poll "
+                "boundary; admissions resumed"
+            )
+            return
+        # re-assert the latch: a supervised restart between staging and
+        # this poll rewrote health back to "serving" — the member must
+        # refuse new work from here on, or post-drain admissions would
+        # be stranded when the caller tears it down
+        self.health = "draining"
+        try:
+            self._drain_pending(pending)
+            drained: List[GenRequest] = []
+            n_lanes = n_ck = 0
+            for slot in sorted(self._chunked):
+                drained.append(self._chunked.pop(slot).request)
+            n_chunked = len(drained)
+            for slot in sorted(self._active):
+                _s, req = self._checkpoint_lane(slot)
+                n_lanes += 1
+                if req.resume is not None:
+                    n_ck += 1
+                drained.append(req)
+            while self._resume_queue:
+                drained.append(self._resume_queue.popleft())
+            while True:
+                try:
+                    drained.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            drained = [r for r in drained if not r.future.cancelled()]
+            self.stats["drains"] += 1
+            if self.flight is not None and self.flight.enabled:
+                self.flight.record({
+                    "type": "drain",
+                    "lanes": n_lanes,
+                    "checkpoints": n_ck,
+                    "chunked": n_chunked,
+                    "handed": len(drained),
+                })
+            logger.info(
+                "graceful drain: %d lane(s) checkpointed (%d with "
+                "emitted tokens), %d chunked, %d total requests handed "
+                "to migration", n_lanes, n_ck, n_chunked, len(drained),
+            )
+            with self._drain_lock:
+                self._pending_drain = None
+            if not job.future.done():
+                job.future.set_result(drained)
+        except Exception as e:  # noqa: BLE001 - the drain caller must wake
+            with self._drain_lock:
+                self._pending_drain = None
+            if not job.future.done():
+                job.future.set_exception(e)
+
+    def _fail_pending_drain(self, err: Exception) -> None:
+        with self._drain_lock:
+            job, self._pending_drain = self._pending_drain, None
+        if job is not None and not job.future.done():
+            job.future.set_exception(err)
 
     @scheduler_only
     def _alloc_device_state(self) -> None:
@@ -2106,6 +2465,7 @@ class ContinuousBatcher:
             self._thread.join(timeout=10.0)
         self._drain_queue(self._dead_error())
         self._fail_pending_swap(self._dead_error())
+        self._fail_pending_drain(self._dead_error())
 
     def _fail_pending_swap(self, err: Exception) -> None:
         with self._swap_lock:
@@ -2777,24 +3137,34 @@ class ContinuousBatcher:
         self._resume_queue.append(req)
 
     @scheduler_only
-    def _preempt_lane(self, slot: int) -> None:
-        """Preempt one decode lane: checkpoint to host (generated tokens
-        + the lane's post-split RNG key + the sampling params already on
-        the request — NOT its K/V), free the slot and its cache columns
-        at this poll boundary, and requeue for recompute-resume. The
-        caller has drained the pipeline, so ``emitted`` and the device
-        state agree exactly; the one tiny host read here (an [2] uint32
-        key) is the whole checkpoint cost."""
+    def _checkpoint_lane(self, slot: int) -> Tuple[_Slot, GenRequest]:
+        """Checkpoint one decode lane to host and free it: generated
+        tokens + the lane's post-split RNG key + the sampling params
+        already on the request — NOT its K/V. The slot and its cache
+        columns free at this poll boundary. The caller has drained the
+        pipeline, so ``emitted`` and the device state agree exactly;
+        the one tiny host read here (an [2] uint32 key) is the whole
+        checkpoint cost. Shared by pressure preemption
+        (:meth:`_preempt_lane`), the hot-swap straggler bound, and
+        graceful drain (:meth:`_do_drain`)."""
         s = self._active.pop(slot)
         req = s.request
         # the lane's CURRENT key — sampling resumes mid-stream from it,
         # which is what makes seeded-sampling output byte-identical
-        # preempt-on vs off
-        key = np.asarray(self._keys[slot]).astype(np.uint32).tolist()  # seldon-lint: disable=host-sync-hot-path (preemption checkpoint: one 8-byte key read at a rare reclaim point, pipeline already drained)
+        # checkpoint-on vs off
+        key = np.asarray(self._keys[slot]).astype(np.uint32).tolist()  # seldon-lint: disable=host-sync-hot-path (preemption/drain checkpoint: one 8-byte key read at a rare reclaim point, pipeline already drained)
         self._pos_host.pop(slot, None)
         self._masks_dirty = True
         if s.emitted:
             req.resume = {"emitted": list(s.emitted), "key": key}
+        return s, req
+
+    @scheduler_only
+    def _preempt_lane(self, slot: int) -> None:
+        """Preempt one decode lane (pressure ladder rung 3): checkpoint
+        to host via :meth:`_checkpoint_lane` and requeue for
+        recompute-resume."""
+        s, req = self._checkpoint_lane(slot)
         self.stats["preemptions"] += 1
         if self.flight is not None and self.flight.enabled:
             self.flight.record({
@@ -3391,6 +3761,10 @@ class ContinuousBatcher:
             self._fail_inflight(pending, err)
             pending = ()  # later iterations have nothing new in flight
             self._fail_pending_swap(err)
+            # a drain staged when the loop died cannot complete: fail it
+            # typed (the supervisor's health writes below replace the
+            # "draining" latch, so a successful restart resumes service)
+            self._fail_pending_drain(err)
             if self.flight is not None and self.flight.enabled:
                 self.flight.record({
                     "type": "batcher_restart",
@@ -3487,17 +3861,35 @@ class ContinuousBatcher:
                 # re-validates `self._pending_swap is not swap` under the
                 # lock before flipping. Keeps the no-rollout hot loop free
                 # of a per-poll mutex.
+                # -- graceful drain: checkpoint everything at this poll
+                # boundary and hand it to the caller for migration.
+                # Admissions are already refused (health flipped to
+                # "draining" on the caller thread), so after this the
+                # loop simply idles until close().
+                dj = self._pending_drain
+                if dj is not None:
+                    self._do_drain(dj, pending)
+                    continue
                 swap = self._pending_swap
                 if swap is not None:
                     if swap.drain_lanes is None:
                         swap.drain_lanes = (
                             len(self._active) + len(self._chunked)
                         )
+                    if self._active or self._chunked or pending:
+                        swap.waited_polls += 1
+                        if (
+                            self.swap_drain_ms > 0
+                            and swap.staged_t
+                            and time.monotonic() - swap.staged_t
+                            >= self.swap_drain_ms / 1e3
+                        ):
+                            # straggler bound: stop waiting on long
+                            # generations — checkpoint them and flip
+                            self._swap_preempt_stragglers(pending)
                     if not self._active and not self._chunked and not pending:
                         self._do_swap(swap)
                         swap = None
-                    else:
-                        swap.waited_polls += 1
                 # admit as many queued requests as there are free slots —
                 # same-bucket admissions are grouped so m lanes share one
                 # batched prefill forward (pow2 chunks bound executables)

@@ -1,0 +1,261 @@
+"""Live-lane migration: the SGC1 generate-checkpoint codec + transports.
+
+PR 9 proved a decode lane's whole resumable state is a few hundred
+host-side bytes — the emitted tokens, the post-split RNG lane key, and
+the sampling params; the K/V rebuilds byte-identically via recompute
+plus teacher-forced replay (``ContinuousBatcher._admit_resume``). This
+module makes that checkpoint a first-class, **wire-portable** object so
+no generation ever restarts from token zero:
+
+* **graceful drain** — ``ContinuousBatcher.drain()`` checkpoints every
+  live lane at a poll boundary and ``GenerateServer.drain_to`` hands
+  the checkpoints (plus queued requests) to a peer, which resumes them
+  via the PR 9 recompute-resume path — rolling maintenance drops zero
+  requests;
+* **crash survival** — streams (and unary responses) optionally carry
+  an opaque **resume token** (the SGC1 payload, base64) refreshed per
+  emitted span; after a member death the token resumes the generation
+  on any peer serving the same ``weight_version``, byte-identical, with
+  already-delivered spans never re-sent.
+
+Wire format (version ``SGC1``, sibling of PR 6's SKV1 — same CRC-framed
+refusal discipline, same typed error classes)::
+
+    b"SGC1" | u32 payload_len | u32 crc32(payload) | payload JSON
+
+One frame: the checkpoint is a few hundred bytes, so the layer-major
+streaming SKV1 needs for multi-MB slabs would be pure overhead here.
+The CRC matters for the same reason SKV1's header CRC does: a flipped
+bit in a still-valid-JSON checkpoint would seed a lane with silently
+wrong output, not a crash. Corruption raises
+:class:`~.disagg.ChecksumError`; a short buffer raises
+:class:`~.disagg.TruncatedStream`; a checkpoint prefilled under another
+weight version refuses with :class:`~.disagg.WeightVersionMismatch` —
+all typed, all BEFORE any lane state exists (the SKV1 contract).
+
+Checkpoint fields: prompt tokens, emitted tokens, RNG lane key (exact
+when exported by a drain — ``None`` in crash tokens, where the resume
+side re-derives it from ``seed`` + emitted count, see
+:func:`derive_lane_key`), sampling params, ``weight_version``, the
+remaining deadline budget, the cumulative queue-wait anchor
+(``wait_s``/``submit_wall_us`` — so a migrated lane's
+``seldon_engine_generate_queue_wait_seconds`` sample stays cumulative),
+and the stream credit position (spans at or before it are never
+re-sent).
+
+Transports reuse the PR 6/7 conventions: loopback hands the checkpoint
+dict to a live peer object (still round-tripping the full codec through
+memory, so framing bugs can't hide), TCP ships base64 SGC1 frames to a
+peer ENGINE's ``POST /drain`` route (``graph/service.py``), with the
+peer's typed refusals surviving the wire as their HTTP statuses.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import struct
+import time
+import zlib
+from typing import Any, Dict, List, Optional
+
+from .disagg import (
+    ChecksumError,
+    DisaggError,
+    TruncatedStream,
+    WeightVersionMismatch,
+)
+
+logger = logging.getLogger(__name__)
+
+MAGIC = b"SGC1"
+CHECKPOINT_VERSION = 1
+
+
+class MigrationError(DisaggError):
+    """Base for migration failures; carries the 502 wire status through
+    the same typed-refusal path the KV-slab codec uses."""
+
+
+class ResumeTokenError(MigrationError):
+    """A client-supplied resume token failed to parse (bad base64,
+    corrupt frame, wrong magic/version). Client input, not a peer or
+    wire fault — carries a **400** so the engine answers a client
+    error instead of a retryable 502 (resubmitting the same broken
+    token can never succeed)."""
+
+    status = 400
+
+
+def checkpoint_of(req, weight_version) -> Dict[str, Any]:
+    """Build the wire checkpoint of one drained/checkpointed
+    :class:`~.continuous.GenRequest`. The request's ``resume`` dict (set
+    by the scheduler's checkpoint at a poll boundary) carries the exact
+    emitted tokens + post-split RNG lane key; a request drained while
+    still queued checkpoints with no emitted tokens — a plain re-admit
+    on the peer reproduces the identical stream from the seed alone."""
+    now = time.monotonic()
+    resume = req.resume or {}
+    emitted = [int(t) for t in resume.get("emitted") or []]
+    key = resume.get("key")
+    return {
+        "v": CHECKPOINT_VERSION,
+        "prompt": [int(t) for t in req.tokens],
+        "emitted": emitted,
+        "rng_key": [int(k) for k in key] if key is not None else None,
+        "max_new_tokens": int(req.max_new_tokens),
+        "temperature": float(req.temperature),
+        "eos_id": req.eos_id,
+        "seed": int(req.seed),
+        "weight_version": weight_version,
+        # cumulative queue-wait anchor: the peer re-bases submit_t so
+        # the request's queue-wait histogram sample covers BOTH members
+        "wait_s": round(max(0.0, now - req.submit_t), 6)
+        if req.submit_t else 0.0,
+        "submit_wall_us": int(req.submit_wall_us or 0),
+        "deadline_s": (
+            max(0.0, req.deadline_t - now)
+            if req.deadline_t is not None else None
+        ),
+        # stream credit position: spans up to here were already
+        # delivered to the client and must never be re-sent
+        "stream_pos": len(emitted),
+    }
+
+
+def encode_checkpoint(ck: Dict[str, Any]) -> bytes:
+    """One SGC1 frame: magic | length | CRC | JSON payload."""
+    payload = json.dumps(ck, separators=(",", ":")).encode()
+    return MAGIC + struct.pack(
+        "<II", len(payload), zlib.crc32(payload)
+    ) + payload
+
+
+def decode_checkpoint(data: bytes) -> Dict[str, Any]:
+    """Decode + validate one SGC1 frame. Typed refusals BEFORE any lane
+    state can exist: bad magic / version → :class:`MigrationError`,
+    short buffer → :class:`~.disagg.TruncatedStream`, CRC mismatch →
+    :class:`~.disagg.ChecksumError`."""
+    if len(data) < 12:
+        raise TruncatedStream(
+            f"checkpoint frame is {len(data)} bytes, need >= 12"
+        )
+    if data[:4] != MAGIC:
+        raise MigrationError(
+            f"bad checkpoint magic {data[:4]!r} (want {MAGIC!r})"
+        )
+    n, crc = struct.unpack("<II", data[4:12])
+    payload = data[12:12 + n]
+    if len(payload) < n:
+        raise TruncatedStream(
+            f"checkpoint payload is {len(payload)} of {n} bytes"
+        )
+    if zlib.crc32(payload) != crc:
+        raise ChecksumError("checkpoint frame failed its checksum")
+    ck = json.loads(payload)
+    if ck.get("v") != CHECKPOINT_VERSION:
+        raise MigrationError(
+            f"unsupported checkpoint version {ck.get('v')!r}"
+        )
+    if not ck.get("prompt"):
+        raise MigrationError("checkpoint carries no prompt tokens")
+    return ck
+
+
+def checkpoint_token(ck: Dict[str, Any]) -> str:
+    """Opaque resume token: the SGC1 frame, base64url. CRC-protected —
+    a client-side bit flip refuses typed instead of resuming wrong."""
+    return base64.urlsafe_b64encode(encode_checkpoint(ck)).decode()
+
+
+def parse_token(token: str) -> Dict[str, Any]:
+    """Parse a client resume token. ANY parse failure — bad base64, a
+    flipped bit (CRC), truncation, wrong magic/version — re-raises as
+    :class:`ResumeTokenError` (400-class): the token is client input,
+    and the 502-class wire errors would read as a retryable server
+    fault for a request that can never succeed unchanged."""
+    try:
+        raw = base64.urlsafe_b64decode(token.encode())
+        return decode_checkpoint(raw)
+    except ResumeTokenError:
+        raise
+    except DisaggError as e:
+        raise ResumeTokenError(f"bad resume token: {e}") from e
+    except Exception as e:  # noqa: BLE001 - malformed client input
+        raise ResumeTokenError(f"resume token is not base64: {e}") from e
+
+
+def derive_lane_key(seed: int, emitted: int) -> List[int]:
+    """Re-derive the post-split RNG lane key for a lane that has emitted
+    ``emitted`` tokens, from the request seed alone.
+
+    The scheduler's RNG chain is deterministic: every admission path
+    (whole-prompt, batched, prefix-splice, chunked) derives
+    ``key0 = split(PRNGKey(seed))[0]`` when it samples the first token,
+    and each fused decode step advances ``key_{i+1} = split(key_i)[0]``
+    — so after N emitted tokens the lane key has been split N-1 times
+    past the prefill. Crash tokens ship without a key (reading it per
+    span would cost a host sync per span on the hot path) and the
+    resume side rebuilds it here — a handful of tiny host jax calls at
+    a rare resume point. NOT valid under speculative decoding (spec
+    rounds consume extra per-lane splits); the server refuses the
+    ``resume_tokens`` knob with a draft configured."""
+    import jax
+
+    key = jax.random.PRNGKey(int(seed))
+    for _ in range(max(1, int(emitted))):
+        key, _sub = jax.random.split(key)
+    import numpy as np
+
+    return np.asarray(key).astype(np.uint32).tolist()
+
+
+def post_drain(
+    addr: str,
+    checkpoints: List[Dict[str, Any]],
+    timeout_s: float = 60.0,
+) -> List[Any]:
+    """TCP half of the drain handoff: POST the SGC1 frames (base64) to
+    a peer ENGINE's ``/drain`` route and return the final token lists,
+    positionally. The peer's typed refusals come back as HTTP statuses
+    and are re-raised typed here (409 → WeightVersionMismatch, 503 →
+    peer unready) so the caller's failure handling matches loopback."""
+    import http.client
+
+    host, _, port = addr.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"drain peer must be host:port, got {addr!r}")
+    body = json.dumps({
+        "checkpoints": [checkpoint_token(ck) for ck in checkpoints],
+    }).encode()
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout_s)
+    try:
+        conn.request("POST", "/drain", body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        payload = resp.read()
+        if resp.status == 409:
+            raise WeightVersionMismatch(
+                f"drain peer {addr} refused the checkpoints: "
+                f"{payload[:200]!r}"
+            )
+        if resp.status != 200:
+            raise MigrationError(
+                f"drain peer {addr} answered {resp.status}: "
+                f"{payload[:200]!r}"
+            )
+        out = json.loads(payload)
+        results = out.get("results")
+        if not isinstance(results, list) or len(results) != len(checkpoints):
+            raise MigrationError(
+                f"drain peer {addr} returned {len(results or [])} results "
+                f"for {len(checkpoints)} checkpoints"
+            )
+        return results
+    except OSError as e:
+        raise MigrationError(
+            f"drain handoff to {addr} failed: {e}"
+        ) from e
+    finally:
+        conn.close()

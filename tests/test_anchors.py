@@ -197,6 +197,28 @@ def test_bench_chaos_scenario_anchor():
     assert "llm_1b_chaos" in gen_src
 
 
+def test_bench_migration_scenario_anchor():
+    """The ``llm_1b_migration`` bench scenario is an acceptance artifact
+    (byte-identity of a mixed greedy+seeded batch across a mid-decode
+    graceful drain — unary and streaming, zero client failures, no
+    stream span re-sent, counters matching the flight-recorder records
+    — plus the member-kill resume-token proof are read from its entry):
+    it must stay wired through BOTH model tiers, and the numbers-table
+    generator must know its key."""
+    import seldon_core_tpu.modelbench as modelbench
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    mb_src = open(modelbench.__file__).read()
+    assert mb_src.count('results["llm_1b_migration"]') >= 2  # tiny + chip
+    assert hasattr(modelbench, "bench_migration")
+    # the entry asserts the acceptance bits like prior scenarios
+    assert '"stream_no_resend": stream_ok' in mb_src
+    assert '"kill_resume_identical": kill_identical' in mb_src
+    assert '"counters_match_flight": counters_match' in mb_src
+    gen_src = open(os.path.join(root, "tools", "gen_arch_numbers.py")).read()
+    assert "llm_1b_migration" in gen_src
+
+
 def test_bench_pressure_scenario_anchor():
     """The ``llm_1b_pressure`` bench scenario is an acceptance artifact
     (byte-identity of greedy AND seeded-sampling outputs across a

@@ -109,6 +109,19 @@ def test_model_tier_tiny_end_to_end():
     assert pr["preemption_exercised"] is True
     assert pr["preempt_resumes"] >= 1
     assert pr["ttft_bounded"] is True
+    # live migration: draining a loaded member mid-decode must complete
+    # every request byte-identically with zero client failures and no
+    # stream span re-sent, the drain/migration counters must match the
+    # flight-recorder records, and a killed member's stream must resume
+    # from its token with exactly one retry
+    mg = results["llm_1b_migration"]
+    assert mg["greedy_identical"] is True
+    assert mg["stream_no_resend"] is True
+    assert mg["zero_failures"] is True
+    assert mg["counters_match_flight"] is True
+    assert mg["kill_resume_identical"] is True
+    assert mg["kill_retries"] <= 1
+    assert mg["no_hang"] is True
     # CPU has no published peak -> MFU is None there; on TPU it's a number
     mfu = results["resnet50_rest"]["mfu_pct"]
     assert mfu is None or 0 < mfu < 100

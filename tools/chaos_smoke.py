@@ -240,6 +240,162 @@ def main() -> int:
             finally:
                 prs_h.stop()
                 prs.close()
+
+            # -- migration leg: graceful drain over TCP (POST /drain),
+            # then a decode member killed MID-STREAM with the client
+            # resuming on the peer from the span's SGC1 resume token —
+            # byte-identical total output, no span re-sent, and the
+            # seldon_engine_drains/migrations series in the exposition
+            pf3 = GenerateServer(role="prefill", **common)
+            pf3.load()
+            l3 = PrefillTransportServer(pf3, port=0)
+            mig_kw = dict(common, steps_per_poll=1)
+            dA = GenerateServer(  # the member that will be killed
+                slots=2, role="decode", peer=f"127.0.0.1:{l3.port}",
+                resume_tokens=1, restart_budget=0, **mig_kw,
+            )
+            dA.load()
+            dB = GenerateServer(  # the kill's resume target
+                slots=2, role="decode", peer=f"127.0.0.1:{l3.port}",
+                resume_tokens=1, **mig_kw,
+            )
+            dB.load()
+            dC = GenerateServer(  # the drain's handoff target
+                slots=2, role="decode", peer=f"127.0.0.1:{l3.port}",
+                resume_tokens=1, **mig_kw,
+            )
+            dC.load()
+            a_h = EngineHarness(dA, name="mig-kill").start()
+            b_h = EngineHarness(dB, name="mig-resume").start()
+            c_h = EngineHarness(dC, name="mig-drain-dst").start()
+            mig_prompt = [3, 1, 4, 1]
+            mig_gen = dict(max_new_tokens=56, temperature=0.8, seed=5)
+            mig_ref = unified.batcher.generate(
+                list(mig_prompt), eos_id=None, **mig_gen,
+            )
+
+            def sse_events(resp, stop_after=None, on_first=None):
+                """Parse `data: {...}` events off a live SSE response;
+                optionally fire a callback after the first span."""
+                events = []
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    if not line.startswith(b"data: "):
+                        continue
+                    ev = json.loads(line[6:])
+                    events.append(ev)
+                    if on_first is not None and len(events) == 1:
+                        on_first()
+                        on_first = None
+                    if ev.get("done") or (
+                        stop_after is not None and len(events) >= stop_after
+                    ):
+                        break
+                return events
+
+            try:
+                # (1) graceful drain over TCP: a stream in flight on dB,
+                # POST /drain hands its checkpoint to dC's engine
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", b_h.http_port, timeout=60)
+                conn.request("POST", "/api/v0.1/generate", json.dumps({
+                    "jsonData": {"prompt_tokens": mig_prompt, **mig_gen},
+                }).encode(), headers)
+                stream_resp = conn.getresponse()
+                first_ev = sse_events(stream_resp, stop_after=1)[0]
+                drained_spans = list(first_ev["tokens"])
+                dconn = http.client.HTTPConnection(
+                    "127.0.0.1", b_h.http_port, timeout=60)
+                dconn.request("POST", "/drain", json.dumps({
+                    "to": f"127.0.0.1:{c_h.http_port}",
+                }).encode(), headers)
+                dresp = dconn.getresponse()
+                dout = json.loads(dresp.read())
+                dconn.close()
+                check("TCP drain route answers 200", dresp.status == 200,
+                      str(dout)[:120])
+                # the ORIGINAL stream keeps delivering through the drain
+                tail = sse_events(stream_resp)
+                conn.close()
+                for ev in tail:
+                    if not ev.get("done"):
+                        drained_spans.extend(ev["tokens"])
+                final = next((e for e in tail if e.get("done")), {})
+                check("drained stream completes byte-identical",
+                      final.get("tokens") == mig_ref)
+                check("drained stream re-sends no span",
+                      drained_spans == mig_ref[len(mig_prompt):])
+                check("draining member refuses new work",
+                      dB.batcher.health == "draining")
+
+                # (2) member kill mid-stream: dA dies after the first
+                # span; the resume token continues on dB's peer engine
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", a_h.http_port, timeout=60)
+                conn.request("POST", "/api/v0.1/generate", json.dumps({
+                    "jsonData": {"prompt_tokens": mig_prompt, **mig_gen},
+                }).encode(), headers)
+                resp = conn.getresponse()
+
+                def kill():
+                    def die(_n):
+                        raise RuntimeError("chaos: injected member kill")
+                    dA.batcher.fault_hook = die
+
+                events = []
+                try:
+                    events = sse_events(resp, on_first=kill)
+                except Exception:  # noqa: BLE001 - severed mid-stream
+                    pass
+                conn.close()
+                delivered, token = [], None
+                for ev in events:
+                    if ev.get("done"):
+                        break
+                    delivered.extend(ev["tokens"])
+                    token = ev.get("resume_token", token)
+                check("killed stream delivered spans with resume tokens",
+                      bool(delivered) and token is not None)
+                check("member latched dead after kill",
+                      dA.batcher.health == "dead")
+                rconn = http.client.HTTPConnection(
+                    "127.0.0.1", c_h.http_port, timeout=60)
+                rconn.request("POST", "/api/v0.1/generate", json.dumps({
+                    "jsonData": {"resume_token": token},
+                }).encode(), headers)
+                r_events = sse_events(rconn.getresponse())
+                rconn.close()
+                resumed = []
+                r_final = {}
+                for ev in r_events:
+                    if ev.get("done"):
+                        r_final = ev
+                        break
+                    resumed.extend(ev["tokens"])
+                check("kill resumed byte-identical on the peer",
+                      r_final.get("tokens") == mig_ref)
+                check("kill resume re-sends no span",
+                      delivered + resumed == mig_ref[len(mig_prompt):],
+                      f"{len(delivered)}+{len(resumed)} vs "
+                      f"{len(mig_ref) - len(mig_prompt)}")
+
+                expo = REGISTRY.expose()
+                for series in ("seldon_engine_drains_total",
+                               "seldon_engine_migrations_total",
+                               "seldon_engine_migrations_resumed",
+                               "seldon_engine_checkpoint_exports"):
+                    check(f"exposition has {series}", series in expo)
+                check("drain counter counts the drain",
+                      REGISTRY.counter_total(
+                          "seldon_engine_drains_total", {}) >= 1)
+            finally:
+                for hh in (a_h, b_h, c_h):
+                    hh.stop()
+                l3.close()
+                for c in (pf3, dA, dB, dC):
+                    c.close()
         finally:
             uni_h.stop()
             dec_h.stop()

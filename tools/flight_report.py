@@ -217,6 +217,55 @@ def _pressure_lines(
     return lines
 
 
+def _migration_lines(
+    drains: List[Dict[str, Any]],
+    exports: List[Dict[str, Any]],
+    migrated: List[Dict[str, Any]],
+    swap_preempts: List[Dict[str, Any]],
+) -> List[str]:
+    """Live-migration records, shown inline with the scheduling story:
+    graceful drains (lanes checkpointed at a poll boundary), SGC1
+    checkpoint exports, and migrated resumes — on the SOURCE a
+    ``migrated_resume`` record carries ``handed`` (checkpoints the peer
+    accepted); on the PEER each resumed checkpoint records one."""
+    lines: List[str] = []
+    for d in drains:
+        lines.append(
+            f"graceful drain: {d.get('lanes', 0)} lane(s) checkpointed "
+            f"({d.get('checkpoints', 0)} with emitted tokens), "
+            f"{d.get('chunked', 0)} chunked admission(s), "
+            f"{d.get('handed', 0)} request(s) handed to migration"
+        )
+    resumed = sum(r.get("handed", 1) for r in migrated)
+    if exports:
+        lines.append(
+            f"checkpoint export: {len(exports)} SGC1 checkpoint(s) "
+            f"({sum(e.get('emitted', 0) for e in exports)} emitted "
+            f"tokens carried); {resumed} resumed at/confirmed by a peer"
+        )
+        if len(exports) > resumed:
+            lines.append(
+                f"DIAGNOSIS: {len(exports) - resumed} exported "
+                "checkpoint(s) have no peer resume — the drain stranded "
+                "work (peer refused the weight_version, or the handoff "
+                "failed); those requests failed typed instead of "
+                "migrating"
+            )
+    elif migrated:
+        lines.append(
+            f"migrated resumes: {resumed} checkpoint(s) resumed here "
+            "(crediting continues after each checkpoint — no span "
+            "re-sent)"
+        )
+    for sp in swap_preempts:
+        lines.append(
+            f"weight-swap straggler bound: {sp.get('lanes', 0)} lane(s) "
+            f"preempt-checkpointed after swap_drain_ms="
+            f"{sp.get('swap_drain_ms')} (policy {sp.get('policy')!r})"
+        )
+    return lines
+
+
 def diagnose(dump: Dict[str, Any]) -> List[str]:
     """Report lines for one unit's flight-recorder dump."""
     lines: List[str] = []
@@ -228,6 +277,14 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
     reclaims = [e for e in entries if e.get("type") == "pressure_reclaim"]
     budgets = [e for e in entries if e.get("type") == "pressure_budget"]
     swaps = [e for e in entries if e.get("type") == "weight_swap"]
+    drains = [e for e in entries if e.get("type") == "drain"]
+    ck_exports = [
+        e for e in entries if e.get("type") == "checkpoint_export"
+    ]
+    migrated = [e for e in entries if e.get("type") == "migrated_resume"]
+    swap_preempts = [
+        e for e in entries if e.get("type") == "swap_straggler_preempt"
+    ]
     kv_exports = [e for e in entries if e.get("type") == "kv_export"]
     kv_inserts = [e for e in entries if e.get("type") == "remote_insert"]
     restarts = [e for e in entries if e.get("type") == "batcher_restart"]
@@ -282,6 +339,9 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
         if sheds:
             lines.append(f"{len(sheds)} shed events recorded")
         lines.extend(_swap_lines(swaps))
+        lines.extend(_migration_lines(
+            drains, ck_exports, migrated, swap_preempts
+        ))
         # a prefill-role pool member never polls: its whole story is the
         # export stream
         lines.extend(_kv_lines(kv_exports, kv_inserts))
@@ -336,6 +396,11 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
 
     # -- live weight swaps ----------------------------------------------------
     lines.extend(_swap_lines(swaps))
+
+    # -- live migration (graceful drain, checkpoint handoff, resumes) --------
+    lines.extend(_migration_lines(
+        drains, ck_exports, migrated, swap_preempts
+    ))
 
     # -- disaggregated serving (KV-slab handoff) ------------------------------
     lines.extend(_kv_lines(kv_exports, kv_inserts))

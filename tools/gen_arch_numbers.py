@@ -244,6 +244,22 @@ def rows_from(mt, fronts):
                else "")
             + ("; no hangs" if gp.get("no_hang") else ""),
         ))
+    gm = mt.get("llm_1b_migration") or {}
+    if gm:
+        rows.append((
+            "generate(), live migration (drain + resume tokens)",
+            f"{fmt(gm.get('drained'))} request(s) drained mid-decode, "
+            f"{fmt(gm.get('checkpoints_migrated'))} checkpoint(s) "
+            "migrated",
+            "graceful drain + member-kill resume token"
+            + ("; bytes identical, zero client failures"
+               if gm.get("greedy_identical") and gm.get("zero_failures")
+               else "")
+            + ("; no stream span re-sent"
+               if gm.get("stream_no_resend") else "")
+            + (f"; kill resumed with {gm.get('kill_retries', 0)} retry"
+               if gm.get("kill_resume_identical") else ""),
+        ))
     g1l = mt.get("llm_1b_long") or {}
     if g1l:
         mbu = f", MBU {g1l['mbu_pct']}%" if g1l.get("mbu_pct") is not None else ""
