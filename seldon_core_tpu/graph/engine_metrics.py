@@ -76,6 +76,16 @@ class MetricsRegistry:
         "gen_prefill_tokens": ("seldon_engine_generate_step_tokens", "prefill"),
     }
 
+    # fused multi-step decode: device steps run inside stop-aware fused
+    # bursts and the dispatches that carried them — the realized burst
+    # length is steps/dispatches, and rate(seldon_engine_fused_steps)
+    # flat while rate(..._dispatches) climbs means K is collapsing
+    # (flight_report diagnoses the same signal per poll)
+    _FUSED = {
+        "gen_fused_steps": "seldon_engine_fused_steps",
+        "gen_fused_dispatches": "seldon_engine_fused_dispatches",
+    }
+
     # disaggregated serving: KV-slab handoff counters land in first-class
     # seldon_engine_kv_transfer_* series with a direction label (export =
     # prefill pool shipping slabs out, import = decode pool splicing them
@@ -174,6 +184,9 @@ class MetricsRegistry:
                 recovery = self._RECOVERY.get(key)
                 if recovery is not None:
                     self.counter_inc(recovery, tags, val)
+                fused = self._FUSED.get(key)
+                if fused is not None:
+                    self.counter_inc(fused, tags, val)
             elif mtype == "GAUGE":
                 self.gauge_set(f"seldon_custom_{key}", val, tags)
                 rg = self._RECOVERY_GAUGES.get(key)

@@ -779,6 +779,8 @@ def bench_generate(
     greedy_probe: int = 0,
     dispatch_floor: bool = False,
     recorder_probe: bool = False,
+    fused_steps_per_dispatch: int = 0,
+    fused_probe: bool = False,
 ) -> Dict[str, Any]:
     """DecoderLM generate() through engine REST + continuous batcher.
 
@@ -794,7 +796,13 @@ def bench_generate(
     generations through a knobs-OFF twin server are byte-identical to the
     knobs-on server's (scheduling must never change temperature-0
     output). ``dispatch_floor`` adds the dispatch-bound tokens/s ceiling
-    (see measure_dispatch_floor_us).
+    (see measure_dispatch_floor_us). ``fused_steps_per_dispatch`` turns
+    on fused multi-step decode (one dispatch runs up to K steps with
+    on-device stop detection); with ``fused_probe`` the entry carries
+    ``fused_decode`` — same-session fused-on vs fused-off windows with
+    greedy AND seeded byte-identity, plus both modes'
+    ``pct_of_dispatch_floor`` against the SAME step-at-a-time bound
+    when ``dispatch_floor`` is also set.
 
     The entry always carries the SLO phase breakdown (``slo``: queue-wait
     / TTFT / TPOT percentiles over the measured window, from the
@@ -825,13 +833,16 @@ def bench_generate(
         warmup_max_new_tokens=max_new_tokens,
     )
     component = GenerateServer(
-        depth_groups=depth_groups, prefill_chunk=prefill_chunk, **server_kw
+        depth_groups=depth_groups, prefill_chunk=prefill_chunk,
+        fused_steps_per_dispatch=fused_steps_per_dispatch, **server_kw
     )
     component.load()
     greedy_identical = None
     probe_prompts = []
     probe_out = []
-    if greedy_probe > 0 and (depth_groups or prefill_chunk):
+    if greedy_probe > 0 and (
+        depth_groups or prefill_chunk or fused_steps_per_dispatch
+    ):
         # byte-identity probe inputs: staggered prompt lengths around the
         # tier's shape so depth groups and chunk boundaries are exercised
         rs = np.random.RandomState(3)
@@ -883,6 +894,7 @@ def bench_generate(
     windows: List[Tuple[Dict[str, Any], Dict[str, Any]]] = []
     k_burst = component.batcher._k
     recorder_stats: Optional[Dict[str, Any]] = None
+    fused_stats: Optional[Dict[str, Any]] = None
     try:
         for _ in range(max(1, runs)):
             bstats0: Dict[str, Any] = {}
@@ -937,6 +949,56 @@ def bench_generate(
                 "greedy_identical": ref_on == ref_off,
                 "seconds_per_mode": round(probe_s, 2),
             }
+        if fused_probe and fused_steps_per_dispatch:
+            # fused multi-step decode probe: ON vs OFF windows on the
+            # SAME loaded server (same session, same warmed executables —
+            # warm() builds both paths' variants, so the runtime toggle
+            # never compiles), with greedy AND seeded byte-identity
+            # across the toggle carried IN THE SAME ENTRY: moving the
+            # inner loop onto the device must never change outputs
+            b = component.batcher
+            probe_greedy = {"prompt_tokens": [prompt],
+                            "max_new_tokens": max_new_tokens,
+                            "temperature": 0.0}
+            probe_seeded = {"prompt_tokens": [prompt],
+                            "max_new_tokens": max_new_tokens,
+                            "temperature": 0.8, "seed": 1234}
+            probe_s = max(1.0, seconds / 2.0)
+            on_g = component.predict(dict(probe_greedy), [])["tokens"][0]
+            on_s = component.predict(dict(probe_seeded), [])["tokens"][0]
+            w_fused_on = closed_loop(
+                make_call, probe_s, concurrency, warmup_calls=1
+            )
+            saved_fused_k = b._fused_k
+            # let any straggler from the ON window drain before flipping
+            # the knob: the scheduler snapshots _fused_k once per poll
+            # (no torn plan either way), but a fused-dispatched tail
+            # crediting inside the OFF window would skew its tokens/s
+            idle_by = time.monotonic() + 30
+            while b._active and time.monotonic() < idle_by:
+                time.sleep(0.05)
+            b._fused_k = 0
+            try:
+                off_g = component.predict(dict(probe_greedy), [])["tokens"][0]
+                off_s = component.predict(dict(probe_seeded), [])["tokens"][0]
+                w_fused_off = closed_loop(
+                    make_call, probe_s, concurrency, warmup_calls=1
+                )
+            finally:
+                b._fused_k = saved_fused_k
+            fused_stats = {
+                "fused_steps_per_dispatch": fused_steps_per_dispatch,
+                "fused_on_tokens_per_s": w_fused_on["rows_per_s"],
+                "fused_off_tokens_per_s": w_fused_off["rows_per_s"],
+                "speedup_x": round(
+                    w_fused_on["rows_per_s"]
+                    / max(w_fused_off["rows_per_s"], 1e-9),
+                    3,
+                ),
+                "greedy_identical": on_g == off_g,
+                "sampled_identical": on_s == off_s,
+                "seconds_per_mode": round(probe_s, 2),
+            }
     finally:
         harness.stop()
         if component.batcher is not None:
@@ -981,6 +1043,7 @@ def bench_generate(
             "max_new_tokens": max_new_tokens,
             "slots": slots,
             "steps_per_poll": steps_per_poll,
+            "fused_steps_per_dispatch": fused_steps_per_dispatch,
             "attn_bucket": attn_bucket,
             "depth_groups": depth_groups,
             "prefill_chunk": prefill_chunk,
@@ -1027,6 +1090,20 @@ def bench_generate(
             "median round trip of a minimal device call x slots x "
             "steps_per_poll tokens per burst"
         )
+    if fused_stats is not None:
+        if dispatch_floor:
+            # both modes against the SAME step-at-a-time dispatch bound
+            # (slots x steps_per_poll_effective / floor): "the floor was
+            # killed" reads as pct_on rising past pct_off — above 100
+            # means one fused dispatch now carries more tokens than a
+            # whole old-style burst ever could
+            fused_stats["pct_of_dispatch_floor_on"] = round(
+                100.0 * fused_stats["fused_on_tokens_per_s"] / bound, 2
+            )
+            fused_stats["pct_of_dispatch_floor_off"] = round(
+                100.0 * fused_stats["fused_off_tokens_per_s"] / bound, 2
+            )
+        stats["fused_decode"] = fused_stats
     if hbm_gb_s and not speculate_tokens:
         # MBU at the decode batch the bench actually ran (slots lanes share
         # one param read per fused step). Speculative runs publish MBU
@@ -2578,7 +2655,8 @@ def _ablate_generate(
 
     best = bench_generate(root, runs=runs, **base_kw)
     keys = (
-        "slots", "steps_per_poll", "attn_bucket", "depth_groups",
+        "slots", "steps_per_poll", "fused_steps_per_dispatch",
+        "attn_bucket", "depth_groups",
         "prefill_chunk", "tokens_per_s", "mbu_pct", "p50_ms", "p99_ms",
         "occupancy",
     )
@@ -2616,6 +2694,9 @@ def _ablate_generate(
                     "attn_bucket": winner["attn_bucket"],
                     "depth_groups": winner["depth_groups"],
                     "prefill_chunk": winner["prefill_chunk"],
+                    "fused_steps_per_dispatch": winner.get(
+                        "fused_steps_per_dispatch", 0
+                    ),
                 },
             )
             if (
@@ -2684,13 +2765,24 @@ def run_model_tier(
                 flush_timeout_ms=2.0, component=tiny_bert,
                 device_service=True,
             )
+            # steps_per_poll 1 + fused 16 over 16-token budgets: the tiny
+            # tier's fused probe is the CI-checked "fused on is no slower
+            # than off" assertion, so the shape must be one where the
+            # dispatch floor genuinely binds (a 1-step host cadence, a
+            # budget long enough that adaptive K stays >> 1). At 8-token
+            # budgets with constant admission churn K collapses toward
+            # the poll burst and the fused win drowns in CPU jitter —
+            # exactly what flight_report's K-collapse DIAGNOSIS flags.
             results["llm_generate"] = bench_generate(
                 root,
                 seconds=seconds,
                 concurrency=2,
                 prompt_len=4,
-                max_new_tokens=8,
+                max_new_tokens=16,
                 slots=2,
+                steps_per_poll=1,
+                fused_steps_per_dispatch=16,
+                fused_probe=True,
                 config={
                     "vocab_size": 256, "d_model": 64, "n_layers": 2, "n_heads": 2,
                     "n_kv_heads": 2, "d_ff": 128, "max_seq": 64,
@@ -2875,6 +2967,11 @@ def run_model_tier(
             # per-burst host round trip is plausibly the binding cost
             # (VERDICT r5 #2/#6: "weak" vs "at the floor" must be
             # adjudicable from artifacts)
+            # fused 64 (4x the poll burst): the 0.2B tier is the
+            # dispatch-bound regime PR 3's roofline identified, so it is
+            # where the fused probe's pct_of_dispatch_floor on-vs-off
+            # delta is the headline — byte-identity (greedy + seeded)
+            # rides the same entry
             results["llm_generate"] = bench_generate(
                 root,
                 seconds=seconds,
@@ -2882,6 +2979,8 @@ def run_model_tier(
                 max_new_tokens=64,
                 cache_seq=256,
                 runs=2,
+                fused_steps_per_dispatch=64,
+                fused_probe=True,
                 config={
                     "vocab_size": 32000, "d_model": 1024, "n_layers": 12,
                     "n_heads": 16, "n_kv_heads": 16, "d_ff": 2816,
@@ -2930,16 +3029,18 @@ def run_model_tier(
             import gc
 
             grid_axes = [
-                # (slots, spp, attn_bucket, max_new, concurrency)
-                (8, 16, 128, 64, 16),    # slots axis
-                (32, 16, 128, 64, 64),
-                (16, 8, 128, 64, 32),    # steps_per_poll axis
-                (16, 32, 128, 64, 32),
-                (16, 16, 64, 64, 32),    # attention-bucket axis
-                (16, 16, 128, 256, 32),  # generation-length axis
+                # (slots, spp, attn_bucket, max_new, concurrency, fused)
+                (8, 16, 128, 64, 16, 0),    # slots axis
+                (32, 16, 128, 64, 64, 0),
+                (16, 8, 128, 64, 32, 0),    # steps_per_poll axis
+                (16, 32, 128, 64, 32, 0),
+                (16, 16, 64, 64, 32, 0),    # attention-bucket axis
+                (16, 16, 128, 256, 32, 0),  # generation-length axis
+                (16, 16, 128, 64, 32, 64),  # fused-decode axis
+                (16, 16, 128, 64, 32, 32),
             ]
             grid = []
-            for g_slots, g_spp, g_ab, g_mnt, g_conc in grid_axes:
+            for g_slots, g_spp, g_ab, g_mnt, g_conc, g_fused in grid_axes:
                 gc.collect()  # slots=32 caches only fit once priors free
                 try:
                     g = bench_generate(
@@ -2947,14 +3048,18 @@ def run_model_tier(
                         concurrency=g_conc, prompt_len=128,
                         max_new_tokens=g_mnt, slots=g_slots,
                         steps_per_poll=g_spp, attn_bucket=g_ab,
+                        fused_steps_per_dispatch=g_fused,
                         # right-sized cache per point (prompt + budget +
-                        # spp overhang, next 128-multiple)
-                        cache_seq=-(-(128 + g_mnt + 2 * g_spp) // 128) * 128,
+                        # burst overhang, next 128-multiple)
+                        cache_seq=-(
+                            -(128 + g_mnt + 2 * max(g_spp, g_fused)) // 128
+                        ) * 128,
                         config=big_cfg, peak=peak, hbm_gb_s=hbm,
                     )
                     grid.append({
                         k: g[k] for k in (
-                            "slots", "steps_per_poll", "attn_bucket",
+                            "slots", "steps_per_poll",
+                            "fused_steps_per_dispatch", "attn_bucket",
                             "max_new_tokens", "tokens_per_s", "mbu_pct",
                             "p50_ms", "p99_ms", "occupancy",
                         )
@@ -2962,6 +3067,7 @@ def run_model_tier(
                 except Exception as e:  # noqa: BLE001 - grid point OOM etc.
                     grid.append({
                         "slots": g_slots, "steps_per_poll": g_spp,
+                        "fused_steps_per_dispatch": g_fused,
                         "attn_bucket": g_ab, "max_new_tokens": g_mnt,
                         "error": str(e)[:160],
                     })
@@ -2982,9 +3088,17 @@ def run_model_tier(
                     prompt_len=128, max_new_tokens=winner["max_new_tokens"],
                     slots=winner["slots"],
                     steps_per_poll=winner["steps_per_poll"],
+                    fused_steps_per_dispatch=winner.get(
+                        "fused_steps_per_dispatch", 0
+                    ),
                     attn_bucket=winner["attn_bucket"],
                     cache_seq=-(-(128 + winner["max_new_tokens"]
-                                  + 2 * winner["steps_per_poll"]) // 128) * 128,
+                                  + 2 * max(
+                                      winner["steps_per_poll"],
+                                      winner.get(
+                                          "fused_steps_per_dispatch", 0
+                                      ),
+                                  )) // 128) * 128,
                     runs=2,
                     config=big_cfg, peak=peak, hbm_gb_s=hbm,
                 )
@@ -3050,6 +3164,10 @@ def run_model_tier(
                     {"slots": 12, "concurrency": 48},
                     {"slots": 16, "concurrency": 64, "prefill_chunk": 512},
                     {"depth_groups": 2, "prefill_chunk": 512},
+                    # fused multi-step decode axis (greedy-probed: the
+                    # on-device stop/done path must stay byte-identical)
+                    {"fused_steps_per_dispatch": 64, "greedy_probe": 2},
+                    {"fused_steps_per_dispatch": 64, "depth_groups": 2},
                 ],
             )
             # shared-prefix serving at flagship scale: 32 prompts over 4
@@ -3164,6 +3282,10 @@ def run_model_tier(
                     {"prefill_chunk": 896},
                     {"slots": 16, "concurrency": 48},
                     {"slots": 16, "concurrency": 48, "prefill_chunk": 512},
+                    # fused-decode axis: the 0.2B family is dispatch-bound
+                    # even at long context, so the fused sweep belongs in
+                    # this grid too (greedy-probed)
+                    {"fused_steps_per_dispatch": 64, "greedy_probe": 2},
                 ],
             )
     return results

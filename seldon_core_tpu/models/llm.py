@@ -472,7 +472,8 @@ class DecoderLM(ServedModel):
         pos = pos.astype(jnp.int32)
         return self._decode(params, cache, tokens, pos, pos, attn_len=attn_len)
 
-    def decode_step_ragged_list(self, params, ks, vs, tokens, pos, attn_len=None):
+    def decode_step_ragged_list(self, params, ks, vs, tokens, pos, attn_len=None,
+                                write_pos=None):
         """Ragged decode step over an UNSTACKED cache: ``ks``/``vs`` are
         per-layer lists of [B, KV, T, Dh] arrays. Returns
         ``(logits [B, V], new_ks, new_vs)``.
@@ -484,11 +485,19 @@ class DecoderLM(ServedModel):
         carried through the caller's step loop, the only cache write is the
         one-position scatter, in place. The continuous batcher
         (serving/continuous.py) keeps its persistent cache in this layout.
+
+        ``write_pos`` ([B] int32, optional): per-row K/V WRITE position
+        when it must differ from the attention position — the fused
+        stop-aware burst parks finished lanes' writes out of bounds
+        (index >= T, dropped by JAX scatter semantics) so a done lane's
+        cache is frozen while live lanes keep decoding. Defaults to
+        ``pos`` (write where you attend — the ordinary decode step).
         """
         import jax
         import jax.numpy as jnp
 
         pos = pos.astype(jnp.int32)
+        wp = pos if write_pos is None else write_pos.astype(jnp.int32)
         x = self._embed_tokens(params, tokens)  # [B,1,D]
         blocks = params["blocks"]
         nks: list = []
@@ -496,7 +505,7 @@ class DecoderLM(ServedModel):
         for l in range(len(ks)):
             layer_p = jax.tree_util.tree_map(lambda a, l=l: a[l], blocks)
             x, nk, nv = self._decode_layer(
-                layer_p, x, pos, ks[l], vs[l], pos, attn_len
+                layer_p, x, pos, ks[l], vs[l], wp, attn_len
             )
             nks.append(nk)
             nvs.append(nv)

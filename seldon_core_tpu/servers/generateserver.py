@@ -11,7 +11,21 @@ Model URI layout: same ``jax_config.json`` as jaxserver with
     slots            decode lanes (default 8)
     max_seq          cache length override
     shard_cache_seq  shard the KV cache length over the mesh's `seq` axis
-    steps_per_poll   decode steps fused into one device burst (default 8)
+    steps_per_poll   decode steps fused into one device burst (default 8;
+                     pow2-floored — the value actually dispatched is
+                     surfaced as ``steps_per_poll_effective`` in server
+                     stats)
+    fused_steps_per_dispatch
+                     fused multi-step decode: one dispatch runs up to
+                     this many decode steps ENTIRELY on device —
+                     per-step KV append, greedy + seeded-categorical
+                     sampling, stop-token detection, and per-lane done
+                     masks that freeze finished lanes (0 = off, the
+                     step-at-a-time burst path). K adapts per poll
+                     (shrinks toward the nearest lane's stop budget and
+                     to ``steps_per_poll`` under HBM pressure or a
+                     staged swap/drain) and byte-identity on vs off is
+                     the contract — see docs/generate.md "Fused decode"
     pipeline_depth   bursts in flight before the host reads the oldest
                      (default 3; 1 = synchronous)
     speculate_tokens speculative decoding: draft this many tokens per
@@ -178,6 +192,7 @@ class GenerateServer(SeldonComponent):
         max_seq: Optional[int] = None,
         shard_cache_seq: bool = False,
         steps_per_poll: int = 8,
+        fused_steps_per_dispatch: int = 0,
         pipeline_depth: int = 3,
         attn_bucket: int = 128,
         speculate_tokens: int = 0,
@@ -252,6 +267,7 @@ class GenerateServer(SeldonComponent):
             shard_cache_seq, str
         ) else shard_cache_seq.lower() == "true"
         self._steps_per_poll = int(steps_per_poll)
+        self._fused_steps_per_dispatch = int(fused_steps_per_dispatch)
         self._pipeline_depth = int(pipeline_depth)
         self._attn_bucket = int(attn_bucket)
         self._speculate_tokens = int(speculate_tokens)
@@ -367,6 +383,7 @@ class GenerateServer(SeldonComponent):
             mesh=self._mesh,
             shard_cache_seq=self._shard_cache_seq,
             steps_per_poll=self._steps_per_poll,
+            fused_steps_per_dispatch=self._fused_steps_per_dispatch,
             pipeline_depth=self._pipeline_depth,
             attn_bucket=self._attn_bucket,
             draft_model=draft_model,
@@ -1241,6 +1258,15 @@ class GenerateServer(SeldonComponent):
         ]
         if s.get("prefill_chunks"):
             out.append(delta("gen_prefill_chunks", s["prefill_chunks"]))
+        if s.get("fused_dispatches"):
+            # fused multi-step decode: device steps per dispatched fused
+            # burst — engine_metrics maps these to the first-class
+            # seldon_engine_fused_{steps,dispatches} series; their ratio
+            # is the realized K (the dispatch-floor win)
+            out.extend([
+                delta("gen_fused_steps", s["fused_steps"]),
+                delta("gen_fused_dispatches", s["fused_dispatches"]),
+            ])
         if s.get("group_bursts"):
             out.extend([
                 delta("gen_group_bursts", s["group_bursts"]),

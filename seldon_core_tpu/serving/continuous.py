@@ -279,6 +279,7 @@ class ContinuousBatcher:
         steps_per_poll: int = 8,
         pipeline_depth: int = 3,
         attn_bucket: int = 128,
+        fused_steps_per_dispatch: int = 0,
         draft_model=None,
         draft_params=None,
         speculate_tokens: int = 4,
@@ -308,11 +309,33 @@ class ContinuousBatcher:
         self.steps_per_poll = int(steps_per_poll)
         # burst length actually dispatched: pow2 floor of steps_per_poll —
         # computed ONCE so warm() and the loop can never disagree on which
-        # burst executable exists
+        # burst executable exists. The floor is surfaced (not silent): it
+        # rides server stats as ``steps_per_poll_effective`` and logs once
+        # here, so an operator who configured 12 can see they got 8.
         k = max(1, self.steps_per_poll)
         while k & (k - 1):
             k &= k - 1
         self._k = k
+        if k != self.steps_per_poll:
+            logger.info(
+                "steps_per_poll=%d rounded down to the pow2 burst length "
+                "%d (see steps_per_poll_effective in server stats)",
+                self.steps_per_poll, k,
+            )
+        # fused multi-step decode: one dispatch runs up to this many
+        # decode steps with ON-DEVICE stop-token detection and per-lane
+        # done masks (0 = off — the step-at-a-time burst path, exactly
+        # the pre-fused code). pow2-floored like steps_per_poll so one
+        # executable exists per (K, attn bucket[, group size]).
+        self.fused_steps_per_dispatch = max(0, int(fused_steps_per_dispatch))
+        fk = self.fused_steps_per_dispatch
+        while fk & (fk - 1):
+            fk &= fk - 1
+        self._fused_k = fk
+        # True while the device-resident per-lane stop/budget registers
+        # match the host's view; membership changes and mode flips clear
+        # it so the next fused dispatch re-uploads (never per burst)
+        self._fused_sync = False
         # how many bursts may be in flight before the host reads the oldest
         # one's tokens; 1 = fully synchronous (dispatch, read, dispatch ...)
         self.pipeline_depth = max(1, int(pipeline_depth))
@@ -464,6 +487,15 @@ class ContinuousBatcher:
             "preemptions": 0, "preempt_resumes": 0,
             "pressure_sheds": 0, "pressure_refused": 0,
             "pressure_prefix_evictions": 0,
+            # fused multi-step decode: device steps run inside stop-aware
+            # fused bursts, and the dispatches that carried them — the
+            # dispatch-floor win IS fused_steps / fused_dispatches
+            # climbing while the host poll rate stays flat
+            "fused_steps": 0, "fused_dispatches": 0,
+            # operator note, not a counter: the pow2-floored burst length
+            # actually dispatched (== steps_per_poll unless it was
+            # silently-no-longer rounded down)
+            "steps_per_poll_effective": k,
         }
         # export_prefill runs on caller threads (the prefill transport's
         # handlers), concurrently with each other; its stat updates take
@@ -654,17 +686,27 @@ class ContinuousBatcher:
 
         # -- executables -----------------------------------------------------
 
-        def fused_step(params, ks, vs, cur_tok, pos, active, temps, keys, attn_len):
-            logits, ks, vs = model.decode_step_ragged_list(
-                params, ks, vs, cur_tok[:, None], pos, attn_len=attn_len
-            )
+        def sample_next(keys, logits, temps):
+            """The ONE per-lane greedy/seeded next-token sampler: split
+            each lane's key, draw categorical at temps>0 else argmax.
+            Every batched decode path (step-at-a-time burst, fused
+            masked step, batched prefill firsts) calls THIS — the
+            byte-identity contract across those paths rests on them
+            sharing the sampling math, so any change lands everywhere
+            by construction."""
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             split = jax.vmap(jax.random.split)(keys)  # [S, 2, key]
             keys, subs = split[:, 0], split[:, 1]
             sampled = jax.vmap(
                 lambda k, lg, t: jax.random.categorical(k, lg / jnp.maximum(t, 1e-6))
             )(subs, logits, temps).astype(jnp.int32)
-            nxt = jnp.where(temps > 0, sampled, greedy)
+            return keys, jnp.where(temps > 0, sampled, greedy)
+
+        def fused_step(params, ks, vs, cur_tok, pos, active, temps, keys, attn_len):
+            logits, ks, vs = model.decode_step_ragged_list(
+                params, ks, vs, cur_tok[:, None], pos, attn_len=attn_len
+            )
+            keys, nxt = sample_next(keys, logits, temps)
             nxt = jnp.where(active, nxt, 0)
             pos = jnp.where(active, pos + 1, pos)
             return nxt, pos, ks, vs, keys
@@ -713,13 +755,7 @@ class ContinuousBatcher:
                 params, prompts, prompts.shape[1], last_index=last_index
             )
             keys = jax.vmap(jax.random.PRNGKey)(seeds)
-            split = jax.vmap(jax.random.split)(keys)
-            keys, subs = split[:, 0], split[:, 1]
-            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            sampled = jax.vmap(
-                lambda k, lg, t: jax.random.categorical(k, lg / jnp.maximum(t, 1e-6))
-            )(subs, logits, temps).astype(jnp.int32)
-            firsts = jnp.where(temps > 0, sampled, greedy)
+            keys, firsts = sample_next(keys, logits, temps)
             return firsts, slab, keys
 
         def insert_many(cache, slab, slot_ix, firsts, first_pos, lane_keys,
@@ -768,6 +804,138 @@ class ContinuousBatcher:
             # firsts ride home with the burst's one sync)
             toks = jnp.concatenate([cur_tok[None, :], toks], axis=0)
             return toks, cur_tok_out, pos, {"k": ks, "v": vs}, keys
+
+        # -- stop-aware fused multi-step decode ------------------------------
+        def fused_masked_step(params, ks, vs, cur_tok, pos, alive, temps,
+                              keys, attn_len, park):
+            """One decode step under a per-lane ``alive`` mask: finished
+            lanes' K/V writes park OUT OF BOUNDS at ``park`` (dropped by
+            JAX scatter semantics — the lane's cache freezes) and their
+            token/position carry unchanged, so a lane that hit its stop
+            keeps its stop token in ``cur_tok`` for the next burst's
+            done0 check. For alive lanes the matmuls, mask bound, key
+            split, and sampling are exactly ``fused_step``'s — the
+            byte-identity contract vs the step-at-a-time path rests on
+            that. Keys split for EVERY lane each step (as fused_step
+            does): a frozen lane's key is dead state its next occupant's
+            insert overwrites."""
+            wpos = jnp.where(alive, pos, park)
+            logits, ks, vs = model.decode_step_ragged_list(
+                params, ks, vs, cur_tok[:, None], pos, attn_len=attn_len,
+                write_pos=wpos,
+            )
+            keys, nxt = sample_next(keys, logits, temps)
+            cur_tok = jnp.where(alive, nxt, cur_tok)
+            pos = jnp.where(alive, pos + 1, pos)
+            return cur_tok, pos, ks, vs, keys
+
+        def fused_scan_body(params, act, temps, stops, attn_len, park):
+            """The ONE fused scan body both burst variants run — a fix to
+            the done condition or the budget decrement lands in the
+            whole-batch AND the gathered depth-group executable by
+            construction, so the grouped-vs-whole-batch byte-identity
+            contract cannot drift one-sided."""
+            def body(carry, _):
+                ks, vs, cur, p, kk, budget, done = carry
+                alive = act & ~done
+                cur, p, ks, vs, kk = fused_masked_step(
+                    params, ks, vs, cur, p, alive, temps, kk, attn_len, park
+                )
+                budget = budget - alive.astype(jnp.int32)
+                done = done | (alive & ((cur == stops) | (budget <= 0)))
+                return (ks, vs, cur, p, kk, budget, done), (
+                    jnp.where(alive, cur, 0), alive,
+                )
+            return body
+
+        def fused_stop_burst(params, cache, cur_tok, pos, active, temps,
+                             keys, stops, budgets, k, attn_len):
+            """k decode steps with ON-DEVICE stop-token detection and
+            per-lane done masks: a lane freezes the moment it emits its
+            stop token or exhausts its remaining budget — its writes park
+            OOB, its registers stop advancing — while the other lanes
+            keep decoding. One dispatch can therefore run far past the
+            step-at-a-time burst length without decoding garbage past a
+            stop. Returns ``([k+1, S]`` tokens with row 0 = the start
+            tokens, per-lane emitted ``counts``, a ``done`` bitmap, and
+            the updated lane registers) — the host syncs once per poll
+            and reads nothing else. ``stops`` is -1 for lanes without an
+            eos (tokens are >= 0, so it never matches); ``budgets`` is
+            each lane's remaining allowance AFTER its current token
+            (decremented on device, re-uploaded only on membership
+            changes)."""
+            park = cache["k"][0].shape[2]  # static: index >= T is dropped
+            body = fused_scan_body(params, active, temps, stops, attn_len,
+                                   park)
+            # a lane can arrive already-done: its stop token was emitted
+            # in an earlier burst the host has not read yet (pipeline
+            # lag), or its budget was fully covered — either way it runs
+            # zero steps here instead of overshoot-decoding
+            done0 = ~active | (budgets <= 0) | (cur_tok == stops)
+            (ks, vs, cur, pos, keys, budgets, done), (toks, alive_rows) = (
+                lax.scan(
+                    body,
+                    (cache["k"], cache["v"], cur_tok, pos, keys, budgets,
+                     done0),
+                    None, length=k,
+                )
+            )
+            counts = alive_rows.astype(jnp.int32).sum(axis=0)
+            toks = jnp.concatenate([cur_tok[None, :], toks], axis=0)
+            return (toks, counts, done, cur, pos, {"k": ks, "v": vs}, keys,
+                    budgets)
+
+        def fused_group_stop_burst(params, cache, cur_tok, pos, temps, keys,
+                                   stops, budgets, lane_ix, n_real, k,
+                                   attn_len):
+            """Stop-aware fused burst over a GATHERED depth group: the
+            group_burst gather/scatter discipline (pads parked at
+            ``attn_len``, no pad state leaking back into other groups'
+            lanes) composed with fused_stop_burst's done masks — one
+            executable per (group-size bucket, attn bucket, K) triple,
+            all precompiled by warm()."""
+            act = jnp.arange(lane_ix.shape[0], dtype=jnp.int32) < n_real
+            g_tok = cur_tok[lane_ix]
+            g_pos = jnp.where(act, pos[lane_ix], attn_len)
+            g_temps = temps[lane_ix]
+            g_keys = keys[lane_ix]
+            g_stop = jnp.where(act, stops[lane_ix], -1)
+            g_budget = budgets[lane_ix]
+            g_ks = [layer[lane_ix, :, :attn_len, :] for layer in cache["k"]]
+            g_vs = [layer[lane_ix, :, :attn_len, :] for layer in cache["v"]]
+            # pads park their writes at attn_len (group_burst's
+            # discipline); full-depth sliced views need no mask bound
+            body = fused_scan_body(params, act, g_temps, g_stop, None,
+                                   attn_len)
+            done0 = ~act | (g_budget <= 0) | (g_tok == g_stop)
+            ((g_ks, g_vs, tok_out, g_pos, g_keys, g_budget, done),
+             (toks, alive_rows)) = lax.scan(
+                body, (g_ks, g_vs, g_tok, g_pos, g_keys, g_budget, done0),
+                None, length=k,
+            )
+            counts = alive_rows.astype(jnp.int32).sum(axis=0)
+            toks = jnp.concatenate([g_tok[None, :], toks], axis=0)
+            new = {
+                "k": [
+                    layer.at[lane_ix, :, :attn_len, :].set(g)
+                    for layer, g in zip(cache["k"], g_ks)
+                ],
+                "v": [
+                    layer.at[lane_ix, :, :attn_len, :].set(g)
+                    for layer, g in zip(cache["v"], g_vs)
+                ],
+            }
+            cur_tok = cur_tok.at[lane_ix].set(
+                jnp.where(act, tok_out, cur_tok[lane_ix])
+            )
+            pos = pos.at[lane_ix].set(jnp.where(act, g_pos, pos[lane_ix]))
+            keys = keys.at[lane_ix].set(
+                jnp.where(act[:, None], g_keys, keys[lane_ix])
+            )
+            budgets = budgets.at[lane_ix].set(
+                jnp.where(act, g_budget, budgets[lane_ix])
+            )
+            return toks, counts, done, cur_tok, pos, new, keys, budgets
 
         # -- prefix-cache executables ---------------------------------------
         def prefix_prefill(params, slab, suffix, start_pos, last_index, seed, temp):
@@ -972,6 +1140,13 @@ class ContinuousBatcher:
         )
         self._group_burst_fn = jax.jit(
             group_burst, donate_argnums=(1,), static_argnums=(8, 9)
+        )
+        self._fused_burst_fn = jax.jit(
+            fused_stop_burst, donate_argnums=(1,), static_argnums=(9, 10)
+        )
+        self._fused_group_fn = jax.jit(
+            fused_group_stop_burst, donate_argnums=(1,),
+            static_argnums=(10, 11),
         )
         self._chunk_fn = jax.jit(
             chunk_prefill_step, donate_argnums=(1,), static_argnums=(7, 8)
@@ -2184,6 +2359,13 @@ class ContinuousBatcher:
         self._cur_tok = jnp.zeros((self.slots,), jnp.int32)
         self._pos = jnp.zeros((self.slots,), jnp.int32)
         self._keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(self.slots))
+        # per-lane stop tokens (-1 = no eos, never matches) and remaining
+        # token budgets for the stop-aware fused burst; the device
+        # decrements its own budget copy per step, the host re-uploads
+        # only on membership changes (_fused_sync)
+        self._stops_dev = jnp.full((self.slots,), -1, jnp.int32)
+        self._budget_dev = jnp.zeros((self.slots,), jnp.int32)
+        self._fused_sync = False
 
     @scheduler_only
     def _rebuild(self) -> None:
@@ -2272,7 +2454,13 @@ class ContinuousBatcher:
         if not buckets:
             buckets = [self.prefill_buckets[0]]
         k = self._k
-        adv = k * (self.speculate_tokens + 1 if self._spec_burst_fn else 1)
+        # per-poll worst-case advance: spec rounds emit up to gamma+1
+        # tokens each; a fused dispatch advances up to fused_steps (its
+        # adaptive K never exceeds that)
+        adv = max(
+            k * (self.speculate_tokens + 1 if self._spec_burst_fn else 1),
+            self._fused_k,
+        )
         # attention buckets a run at these prompt lengths can touch: from
         # the shallowest first-burst prefix to the deepest end-of-budget.
         # eos-bearing lanes outlive their budget until the host OBSERVES
@@ -2431,12 +2619,7 @@ class ContinuousBatcher:
                     # grouped sub-burst variants: every pow2 group-size
                     # bucket at this attention bucket (mixed-depth polls
                     # pick any of them; compile-before-listen holds)
-                    gb = 1
-                    gbs = [self.slots]
-                    while gb < self.slots:
-                        gbs.append(gb)
-                        gb <<= 1
-                    for gb in sorted(set(gbs)):
+                    for gb in self._warm_group_sizes():
                         lane_ix = jnp.arange(gb, dtype=jnp.int32)
                         toks, self._cur_tok, self._pos, self._cache, self._keys = (
                             self._group_burst_fn(
@@ -2446,12 +2629,63 @@ class ContinuousBatcher:
                             )
                         )
                         toks.block_until_ready()  # seldon-lint: disable=host-sync-hot-path (warm precompile: intentional sync while the loop is idle)
+        if self._fused_k > 0 and self._spec_burst_fn is None:
+            # stop-aware fused variants: every (K, attn bucket, group
+            # size) the adaptive-K plan can reach — K is a pow2 in
+            # [min(steps_per_poll, fused), fused] (see _fused_plan), so
+            # the shrink can never ask for an executable this loop did
+            # not build. The one-line census below is the CI-visible
+            # retrace-hazard guard: a variant-count jump between runs
+            # means a config change grew the compile surface.
+            fks: List[int] = []
+            fk = self._fused_k
+            lo_k = min(self._k, self._fused_k)
+            while fk >= lo_k:
+                fks.append(fk)
+                fk //= 2
+            fks = sorted(fks)
+            gbs = self._warm_group_sizes() if self.depth_groups > 1 else []
+            stops0 = jnp.full((self.slots,), -1, jnp.int32)
+            budget0 = jnp.zeros((self.slots,), jnp.int32)
+            compiled = 0
+            for attn_len in attn_lens:
+                for fk in fks:
+                    (
+                        toks, _counts, _done, self._cur_tok, self._pos,
+                        self._cache, self._keys, budget0,
+                    ) = self._fused_burst_fn(
+                        self.params, self._cache, self._cur_tok, self._pos,
+                        active, temps, self._keys, stops0, budget0, fk,
+                        attn_len,
+                    )
+                    toks.block_until_ready()  # seldon-lint: disable=host-sync-hot-path (warm precompile: intentional sync while the loop is idle)
+                    compiled += 1
+                    for gb in gbs:
+                        lane_ix = jnp.arange(gb, dtype=jnp.int32)
+                        (
+                            toks, _counts, _done, self._cur_tok, self._pos,
+                            self._cache, self._keys, budget0,
+                        ) = self._fused_group_fn(
+                            self.params, self._cache, self._cur_tok,
+                            self._pos, temps, self._keys, stops0, budget0,
+                            lane_ix, 0, fk, attn_len,
+                        )
+                        toks.block_until_ready()  # seldon-lint: disable=host-sync-hot-path (warm precompile: intentional sync while the loop is idle)
+                        compiled += 1
+            logger.info(
+                "warm: fused decode compile census: %d variant(s) "
+                "(k=%s x attn=%s x group_sizes=%s)",
+                compiled, fks, attn_lens, gbs or [self.slots],
+            )
         # warm left garbage in cur_tok/pos; reset the host-visible lane
         # state so the first admissions start from a clean slate (the
         # device cache needs no scrub — see residue invariant above)
         self._cur_tok = jnp.zeros((self.slots,), jnp.int32)
         self._pos = jnp.zeros((self.slots,), jnp.int32)
         self._keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(self.slots))
+        self._stops_dev = jnp.full((self.slots,), -1, jnp.int32)
+        self._budget_dev = jnp.zeros((self.slots,), jnp.int32)
+        self._fused_sync = False
 
     @caller_thread
     def close(self) -> None:
@@ -2592,6 +2826,80 @@ class ContinuousBatcher:
         while g < n:
             g <<= 1
         return min(g, self.slots)
+
+    def _warm_group_sizes(self) -> List[int]:
+        """Every pow2 group-size bucket a mixed-depth poll can dispatch,
+        plus the whole batch — the ONE enumeration both warm()'s grouped
+        sub-burst loop and the fused compile census iterate, so the two
+        can never precompile different variant sets."""
+        gb = 1
+        gbs = [self.slots]
+        while gb < self.slots:
+            gbs.append(gb)
+            gb <<= 1
+        return sorted(set(gbs))
+
+    @scheduler_only
+    def _fused_plan(self, k_max=None):
+        """Adaptive K for the stop-aware fused burst: ``(k, reason)``.
+
+        Start from ``fused_steps_per_dispatch`` and shrink — never below
+        the configured ``steps_per_poll`` burst (``self._k``), so the
+        shrink can't reintroduce the tiny-burst-per-completion pathology
+        the fixed-k design was built to avoid:
+
+        * **stop_budget** — to the nearest lane's remaining token budget
+          (pow2-floored): steps past the closest stop are wasted device
+          work the done mask would only discard;
+        * **pressure** — to ``steps_per_poll`` while the HBM ledger is
+          latched: the reclaim ladder (and its preemption checkpoints)
+          only runs between dispatches, so boundaries must come at the
+          pre-fused cadence;
+        * **poll_boundary** — to ``steps_per_poll`` while a weight swap
+          or graceful drain is staged: both act at poll boundaries, and
+          a K-step burst would stall the flip/checkpoint by K steps.
+
+        The result is always a pow2 <= fused_steps_per_dispatch, so one
+        precompiled executable exists per (K, attn bucket[, group size])
+        and the shrink can never trigger an inline XLA compile.
+
+        ``k_max``: the caller's snapshot of ``self._fused_k`` — the loop
+        passes the same value that decided ``use_fused`` this poll, so a
+        concurrent toggle (the modelbench fused probe flips the knob on
+        a live server) can never tear between the mode decision and the
+        plan and yield an unwarmed K."""
+        if k_max is None:
+            k_max = self._fused_k
+        k, reason = k_max, None
+        floor = min(self._k, k_max)
+        rem = [
+            r for r in (
+                s.request.max_new_tokens - s.dispatched
+                - (1 if s.first_pending else 0)
+                for s in self._active.values()
+            ) if r > 0
+        ]
+        if rem:
+            tight = 1
+            nearest = min(rem)
+            while tight * 2 <= nearest:
+                tight *= 2
+            tight = max(tight, floor)
+            if tight < k:
+                k, reason = tight, "stop_budget"
+        if (
+            self._pressure.budget_bytes > 0 and self._pressure.active
+            and floor < k
+        ):
+            k, reason = floor, "pressure"
+        # unlocked reads, same discipline as the loop's swap sighting: a
+        # one-poll-late shrink is harmless
+        if (
+            (self._pending_swap is not None or self._pending_drain is not None)
+            and floor < k
+        ):
+            k, reason = floor, "poll_boundary"
+        return max(1, min(k, k_max)), reason
 
     @scheduler_only
     def _draft_admit(self, slot: int, req: GenRequest) -> None:
@@ -2968,6 +3276,8 @@ class ContinuousBatcher:
             mode, payload = pending.popleft()
             if mode == "spec":
                 self._process_spec_burst(*payload)
+            elif mode == "fused":
+                self._process_fused_burst(*payload)
             else:
                 self._process_burst(*payload)
 
@@ -3664,6 +3974,39 @@ class ContinuousBatcher:
         self._check_done()
 
     @scheduler_only
+    def _process_fused_burst(self, toks_dev, counts_dev, done_dev, snapshot,
+                             k) -> None:
+        """Credit one stop-aware fused burst. Per lane, exactly
+        ``counts[col]`` tokens were emitted before its on-device done
+        mask froze it (stop token / budget), so — unlike
+        :meth:`_process_burst` — no overshoot rows exist to drop; the
+        host just credits the counted span (row 0 still carries the
+        deferred prefill first token). ``done_dev`` is the device's own
+        verdict; crediting re-derives it from the tokens (``_credit``
+        checks eos/budget per token), so the two can never disagree
+        without the identity tests catching it. Like
+        :meth:`_process_spec_burst`, tightens the host position bound
+        from the worst-case k advance to the lane's actual alive steps —
+        a lane frozen early must not inflate the pressure ledger or the
+        attention-bucket need until the host observes it."""
+        host_toks = np.asarray(toks_dev)  # the burst's one host sync
+        counts = np.asarray(counts_dev)
+        for slot, (s, start, col) in snapshot.items():
+            if self._active.get(slot) is s and slot in self._pos_host:
+                self._pos_host[slot] -= k - int(counts[col])
+            if s.credit_done:
+                continue
+            span = host_toks[start: 1 + int(counts[col]), col]
+            if not len(span):
+                continue
+            if self._credit(s, span):
+                if self._active.get(slot) is s:
+                    self._finish(slot)
+                else:
+                    self._resolve(s)  # lane was pre-freed at dispatch time
+        self._check_done()
+
+    @scheduler_only
     def _process_spec_burst(self, start_tok_dev, toks_dev, counts_dev, snapshot, k) -> None:
         """Spec-mode crediting: per round, a lane emitted counts[r, slot]
         tokens (accepted drafts + the target's correction). Also tightens
@@ -3717,7 +4060,7 @@ class ContinuousBatcher:
             if not s.request.future.done():
                 s.request.future.set_exception(err)
         for _mode, payload in pending:
-            snap = payload[3] if _mode == "spec" else payload[1]
+            snap = payload[3] if _mode in ("spec", "fused") else payload[1]
             for entry in snap.values():
                 s = entry[0]
                 if not s.request.future.done():
@@ -4074,16 +4417,51 @@ class ContinuousBatcher:
                         # the q/p softmax + sampling machinery
                         self._any_stoch = bool((temps > 0).any())
                         self._masks_dirty = False
+                        self._fused_sync = False
                     active_dev = self._active_dev
                     temps_dev = self._temps_dev
-                    # one fused burst of k steps = ONE device call + ONE host
-                    # sync. k is FIXED (one compiled variant): lanes that hit
-                    # max_new_tokens or eos mid-burst simply have their
-                    # overshoot tokens dropped by _process_burst — clamping k
-                    # to the tightest remaining budget (the previous design)
-                    # made staggered requests force tiny bursts on every
-                    # lane, paying the sync RTT per token near each completion
-                    k = self._k
+                    # burst length. Step-at-a-time path: k is FIXED (one
+                    # compiled variant) — lanes that hit max_new_tokens or
+                    # eos mid-burst simply have their overshoot tokens
+                    # dropped by _process_burst; clamping k to the tightest
+                    # remaining budget (the pre-fused design) made staggered
+                    # requests force tiny bursts on every lane, paying the
+                    # sync RTT per token near each completion. Fused path:
+                    # K is ADAPTIVE (never below self._k — see _fused_plan)
+                    # and on-device done masks freeze lanes that stop
+                    # mid-burst, so one dispatch safely covers many polls'
+                    # worth of steps. Speculation keeps its own fused
+                    # draft/verify rounds: the fused path degrades to it —
+                    # and while the pressure ladder SUPPRESSES speculation,
+                    # to the plain step-at-a-time burst (the path PR 9
+                    # warmed and proved identical), never to cold fused
+                    # executables.
+                    fused_k = self._fused_k  # one snapshot per poll
+                    use_fused = fused_k > 0 and self._spec_burst_fn is None
+                    fused_reason = None
+                    if use_fused:
+                        k, fused_reason = self._fused_plan(fused_k)
+                        if not self._fused_sync:
+                            # per-lane stop tokens + remaining budgets:
+                            # uploaded only when membership (or the
+                            # dispatch mode) changed — the device
+                            # decrements its own budget copy per step, so
+                            # the steady-state fused loop uploads nothing
+                            stops = np.full((self.slots,), -1, np.int32)
+                            budget = np.zeros((self.slots,), np.int32)
+                            for i, s in self._active.items():
+                                if s.request.eos_id is not None:
+                                    stops[i] = int(s.request.eos_id)
+                                budget[i] = (
+                                    s.request.max_new_tokens - s.dispatched
+                                    - (1 if s.first_pending else 0)
+                                )
+                            self._stops_dev = jnp.asarray(stops)
+                            self._budget_dev = jnp.asarray(budget)
+                            self._fused_sync = True
+                    else:
+                        k = self._k
+                        self._fused_sync = False
                     # per-burst worst-case position advance (spec rounds can
                     # emit up to gamma+1 tokens each)
                     adv = k * (
@@ -4145,9 +4523,14 @@ class ContinuousBatcher:
                             # depth-group plan + cost-model verdict: the
                             # gap between distinct need-buckets and the
                             # dispatched group count IS how many splits
-                            # the cost model merged away this poll
+                            # the cost model merged away this poll. ONE
+                            # composition record per fused poll (mode
+                            # "fused", the adaptive K and why it shrank)
+                            # — never per fused step, so the recorder's
+                            # host cost stays per-poll as shipped.
                             poll_plan = {
-                                "mode": "decode", "k": k,
+                                "mode": "fused" if use_fused else "decode",
+                                "k": k,
                                 "groups": [
                                     {"lanes": len(lanes), "bucket": b}
                                     for lanes, b in groups
@@ -4155,6 +4538,10 @@ class ContinuousBatcher:
                                 "distinct_buckets": len(set(need.values())),
                                 "merged": len(set(need.values())) - len(groups),
                             }
+                            if use_fused:
+                                poll_plan["k_max"] = fused_k
+                                if fused_reason is not None:
+                                    poll_plan["shrunk_by"] = fused_reason
                         # per-lane bookkeeping happens per SUB-burst: a
                         # lane's tokens are credited against the column it
                         # occupied in the burst that decoded it
@@ -4167,6 +4554,7 @@ class ContinuousBatcher:
                                 s.first_pending = False
                                 s.dispatched += k + (1 if first else 0)
                                 self._pos_host[slot] += adv
+                            counts = done_bits = None
                             if len(groups) == 1:
                                 # single depth group: the exact pre-grouping
                                 # whole-batch path — no gather, columns are
@@ -4177,15 +4565,30 @@ class ContinuousBatcher:
                                         slot,
                                     )
                                 rows = self.slots
-                                with device_trace("gen.decode_burst"):
-                                    toks, self._cur_tok, self._pos, self._cache, self._keys = (
-                                        self._burst_fn(
+                                if use_fused:
+                                    with device_trace("gen.decode_burst"):
+                                        (
+                                            toks, counts, done_bits,
+                                            self._cur_tok, self._pos,
+                                            self._cache, self._keys,
+                                            self._budget_dev,
+                                        ) = self._fused_burst_fn(
                                             self.params, self._cache,
                                             self._cur_tok, self._pos,
-                                            active_dev, temps_dev, self._keys,
-                                            k, g_bucket,
+                                            active_dev, temps_dev,
+                                            self._keys, self._stops_dev,
+                                            self._budget_dev, k, g_bucket,
                                         )
-                                    )
+                                else:
+                                    with device_trace("gen.decode_burst"):
+                                        toks, self._cur_tok, self._pos, self._cache, self._keys = (
+                                            self._burst_fn(
+                                                self.params, self._cache,
+                                                self._cur_tok, self._pos,
+                                                active_dev, temps_dev, self._keys,
+                                                k, g_bucket,
+                                            )
+                                        )
                             else:
                                 gb = self._group_size_bucket(len(lanes))
                                 pads = [
@@ -4196,15 +4599,31 @@ class ContinuousBatcher:
                                     lanes + pads, jnp.int32
                                 )
                                 rows = gb
-                                with device_trace("gen.decode_burst"):
-                                    toks, self._cur_tok, self._pos, self._cache, self._keys = (
-                                        self._group_burst_fn(
+                                if use_fused:
+                                    with device_trace("gen.decode_burst"):
+                                        (
+                                            toks, counts, done_bits,
+                                            self._cur_tok, self._pos,
+                                            self._cache, self._keys,
+                                            self._budget_dev,
+                                        ) = self._fused_group_fn(
                                             self.params, self._cache,
                                             self._cur_tok, self._pos,
-                                            temps_dev, self._keys, lane_ix,
+                                            temps_dev, self._keys,
+                                            self._stops_dev,
+                                            self._budget_dev, lane_ix,
                                             len(lanes), k, g_bucket,
                                         )
-                                    )
+                                else:
+                                    with device_trace("gen.decode_burst"):
+                                        toks, self._cur_tok, self._pos, self._cache, self._keys = (
+                                            self._group_burst_fn(
+                                                self.params, self._cache,
+                                                self._cur_tok, self._pos,
+                                                temps_dev, self._keys, lane_ix,
+                                                len(lanes), k, g_bucket,
+                                            )
+                                        )
                                 self.stats["group_bursts"] += 1
                                 self.stats["group_lanes"] += len(lanes)
                                 self.stats["group_pad_lanes"] += gb - len(lanes)
@@ -4215,6 +4634,9 @@ class ContinuousBatcher:
                                 self._param_bytes
                                 + rows * g_bucket * self._kv_key_bytes
                             )
+                            if use_fused:
+                                self.stats["fused_dispatches"] += 1
+                                self.stats["fused_steps"] += k
                             if self.trace_groups is not None:
                                 self.trace_groups.append({
                                     "lanes": tuple(lanes),
@@ -4225,11 +4647,22 @@ class ContinuousBatcher:
                             # start the device->host token copy NOW; by the
                             # time the host reads this burst (pipeline_depth
                             # dispatches later) the transfer has landed
-                            try:
-                                toks.copy_to_host_async()
-                            except AttributeError:  # non-jax (test doubles)
-                                pass
-                            pending.append(("plain", (toks, snapshot)))
+                            if use_fused:
+                                for t in (toks, counts, done_bits):
+                                    try:
+                                        t.copy_to_host_async()
+                                    except AttributeError:
+                                        pass
+                                pending.append((
+                                    "fused",
+                                    (toks, counts, done_bits, snapshot, k),
+                                ))
+                            else:
+                                try:
+                                    toks.copy_to_host_async()
+                                except AttributeError:  # non-jax (test doubles)
+                                    pass
+                                pending.append(("plain", (toks, snapshot)))
                         # PREDICTIVE FREE: a lane whose eos-less budget is
                         # now fully covered by dispatched bursts is done —
                         # the host needn't observe the tokens to know it.
@@ -4289,10 +4722,13 @@ class ContinuousBatcher:
                     if not (len(pending) >= self.pipeline_depth or not self._active):
                         # last-initiated transfer of the oldest burst: counts
                         # for spec (start_tok/toks/counts copy in order),
-                        # toks for plain — if IT landed, np.asarray of the
+                        # the done bitmap for fused (toks/counts/done), toks
+                        # for plain — if IT landed, np.asarray of the
                         # earlier arrays won't block either
                         head_mode, head_payload = pending[0]
-                        head = head_payload[2 if head_mode == "spec" else 0]
+                        head = head_payload[
+                            2 if head_mode in ("spec", "fused") else 0
+                        ]
                         try:
                             if not head.is_ready():
                                 break
@@ -4301,6 +4737,8 @@ class ContinuousBatcher:
                     mode, payload = pending.popleft()
                     if mode == "spec":
                         self._process_spec_burst(*payload)
+                    elif mode == "fused":
+                        self._process_fused_burst(*payload)
                     else:
                         self._process_burst(*payload)
         except Exception:  # noqa: BLE001 - every loop death is supervised

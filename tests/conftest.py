@@ -27,6 +27,32 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# XLA compilation cache for the whole tier-1 run: the suite's wall clock
+# is dominated by XLA re-compiling IDENTICAL tiny-model executables —
+# every ContinuousBatcher instance closes over fresh param references,
+# so jit's in-memory cache (keyed on the function object) never hits
+# across instances, while the persistent cache keys on the HLO
+# fingerprint and does. One process-lifetime directory (override with
+# SELDON_TEST_JAX_CACHE to share across runs); same HLO -> same binary,
+# so cached executables are bit-identical to cold compiles and the
+# byte-identity contracts are unaffected.
+import atexit as _atexit
+import shutil as _shutil
+import tempfile as _tempfile
+
+_jax_cache = os.environ.get("SELDON_TEST_JAX_CACHE")
+if not _jax_cache:
+    # process-lifetime scratch dir: removed at exit so repeated runs on
+    # long-lived runners don't accumulate compiled binaries in /tmp
+    _jax_cache = _tempfile.mkdtemp(prefix="seldon-jax-cache-")
+    _atexit.register(_shutil.rmtree, _jax_cache, ignore_errors=True)
+jax.config.update("jax_compilation_cache_dir", _jax_cache)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+try:
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+except Exception:  # noqa: BLE001 - knob absent on older jax
+    pass
+
 import asyncio
 import json as _json
 

@@ -56,6 +56,19 @@ def test_model_tier_tiny_end_to_end():
     # dispatch-floor roofline fields ride the generate tier
     assert results["llm_generate"]["dispatch_floor_us"] > 0
     assert results["llm_generate"]["dispatch_bound_tokens_per_s"] > 0
+    # fused multi-step decode: byte-identity (greedy AND seeded) across
+    # the fused-on/off toggle in the SAME entry, both modes' dispatch-
+    # floor percentages against the SAME step-at-a-time bound, and
+    # fused on no slower than off (0.9 factor absorbs CPU window jitter
+    # — at a 2-step poll vs a 16-step fused dispatch the real effect is
+    # a speedup, and the chip tier publishes the honest numbers)
+    fd = results["llm_generate"]["fused_decode"]
+    assert fd["greedy_identical"] is True
+    assert fd["sampled_identical"] is True
+    assert fd["fused_on_tokens_per_s"] > 0
+    assert fd["pct_of_dispatch_floor_on"] > 0
+    assert fd["pct_of_dispatch_floor_off"] > 0
+    assert fd["speedup_x"] >= 0.9
     assert results["resnet50_device"]["rows_per_s"] > 0
     assert "none" in results["resnet50_device"]["transport"]
     # progressive delivery: the identical-weights canary ramp must be

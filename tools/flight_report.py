@@ -362,7 +362,9 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
 
     # -- depth-group plan + cost-model verdicts ------------------------------
     planned = [p for p in polls if "plan" in p]
-    decode = [p for p in planned if p["plan"].get("mode") == "decode"]
+    # fused polls carry the same groups/distinct_buckets/merged fields —
+    # the cost-model verdict must not go dark when fused decode is on
+    decode = [p for p in planned if p["plan"].get("mode") in ("decode", "fused")]
     if decode:
         split = [p for p in decode if len(p["plan"].get("groups", [])) > 1]
         merged_polls = [p for p in decode if p["plan"].get("merged", 0) > 0]
@@ -382,6 +384,46 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
     spec = [p for p in planned if p["plan"].get("mode") == "spec"]
     if spec:
         lines.append(f"speculative decode: {len(spec)} spec-burst polls")
+
+    # -- fused multi-step decode ---------------------------------------------
+    fused = [p for p in planned if p["plan"].get("mode") == "fused"]
+    if fused:
+        ks = [p["plan"].get("k", 1) for p in fused]
+        k_max = max(p["plan"].get("k_max", 1) for p in fused)
+        reasons: Dict[str, int] = {}
+        for p in fused:
+            r = p["plan"].get("shrunk_by")
+            if r:
+                reasons[r] = reasons.get(r, 0) + 1
+        reason_txt = (
+            "; shrunk by " + ", ".join(
+                f"{n}x {r}" for r, n in sorted(reasons.items())
+            )
+            if reasons else ""
+        )
+        lines.append(
+            f"fused decode: {len(fused)} fused polls, realized K avg "
+            f"{sum(ks) / len(ks):.1f} / min {min(ks)} "
+            f"(configured {k_max}){reason_txt}"
+        )
+        # collapse = realized K pinned at its observed floor, well below
+        # the configured max. _fused_plan never shrinks below
+        # min(steps_per_poll, k_max), so "k <= 1" would be dead code for
+        # any steps_per_poll > 1 — compare against the floor the run
+        # actually hit instead.
+        floor = min(ks)
+        collapsed = [k for k in ks if k <= floor]
+        if floor < k_max and len(collapsed) >= max(4, len(ks) // 2):
+            lines.append(
+                f"DIAGNOSIS: K collapsed to {floor} (configured {k_max}) "
+                f"on {_pct(len(collapsed), len(ks)):.0f}% of fused polls "
+                f"— each dispatch carries only {floor} step(s), giving "
+                "back most of the fused dispatch-floor win; look at the "
+                "shrink reasons above (persistent `pressure` means the "
+                "HBM ledger is latched — see "
+                "seldon_engine_pressure_active; persistent `stop_budget` "
+                "means short budgets dominate traffic)"
+            )
 
     # -- chunked prefill interleave ------------------------------------------
     chunk_polls = [p for p in polls if p.get("prefill_chunks")]

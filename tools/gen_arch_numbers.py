@@ -140,6 +140,20 @@ def rows_from(mt, fronts):
             f"; {g['pct_of_dispatch_floor']}% of the dispatch floor"
             if g.get("pct_of_dispatch_floor") is not None else ""
         )
+        fd = g.get("fused_decode") or {}
+        if fd.get("pct_of_dispatch_floor_on") is not None:
+            # fused multi-step decode: both modes against the SAME
+            # step-at-a-time dispatch bound, so the on-vs-off delta IS
+            # the floor being killed
+            floor = (
+                f"; dispatch floor {fd['pct_of_dispatch_floor_on']}% fused"
+                f"-on vs {fd['pct_of_dispatch_floor_off']}% off"
+                f" (K={fd.get('fused_steps_per_dispatch', '—')}"
+                + (", bytes identical"
+                   if fd.get("greedy_identical") and fd.get("sampled_identical")
+                   else "")
+                + ")"
+            )
         rows.append((
             "generate(), 0.2B decoder",
             f"{fmt(g.get('tokens_per_s'))} tok/s{mbu}",
