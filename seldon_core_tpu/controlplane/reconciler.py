@@ -20,11 +20,14 @@ import logging
 from typing import Dict, List, Optional, Tuple
 
 from ..graph.spec import (
+    ANNOTATION_KV_TIER_BYTES,
     GraphSpecError,
     PREPACKAGED_SERVERS,
     PredictorSpec,
     default_predictor,
+    inject_kv_tier_param,
     parse_disagg_annotations,
+    parse_kv_tier_annotation,
     validate_deployment,
 )
 from ..storage import Storage
@@ -228,8 +231,23 @@ class DeploymentController:
                 if espec is not None:
                     specs.append(espec)
                 continue
+            # kv-tier annotation: the byte budget lands on the
+            # GENERATE_SERVER unit as the host_kv_tier_bytes parameter
+            # (one source of truth — the annotation; see graph/spec.py)
+            tier_bytes = parse_kv_tier_annotation(pspec)
             for replica in range(max(1, pspec.replicas)):
                 name = f"{dep.key}/{pspec.name}/{replica}/engine-{h[:8]}"
+                espec_dict = pspec.to_dict()
+                if tier_bytes is not None:
+                    espec_dict = inject_kv_tier_param(espec_dict, tier_bytes)
+                    # injected as a parameter now: strip the annotation
+                    # so any re-validation of the member spec doesn't
+                    # see both sources of truth at once
+                    espec_dict["annotations"] = {
+                        k: v
+                        for k, v in (espec_dict.get("annotations") or {}).items()
+                        if k != ANNOTATION_KV_TIER_BYTES
+                    }
                 specs.append(
                     ComponentSpec(
                         name=name,
@@ -238,7 +256,7 @@ class DeploymentController:
                         predictor=pspec.name,
                         replica=replica,
                         routable=True,
-                        engine_spec=pspec.to_dict(),
+                        engine_spec=espec_dict,
                     )
                 )
             espec = explainer_spec()
@@ -259,16 +277,24 @@ class DeploymentController:
         annotations are excluded from the component-naming hash exactly
         like ``replicas`` is."""
         n_prefill, n_decode = disagg
+        tier_bytes = parse_kv_tier_annotation(pspec)
 
         def pool_spec(role: str, extra) -> Dict:
             d = pspec.to_dict()
+            if tier_bytes is not None:
+                # both pools carry the tier: the prefill pool's tier is
+                # what the KV-port listener answers peer prefix-lookups
+                # from; the decode pool's tier is the pressure spill
+                d = inject_kv_tier_param(d, tier_bytes)
             # the pool member is already specialized: strip the disagg
-            # annotations so the runtime's re-validation doesn't see a
-            # role parameter on a spec that still asks to be split
+            # annotations (and the kv-tier annotation, now injected as
+            # a parameter) so the runtime's re-validation doesn't see a
+            # role/tier parameter on a spec that still asks to own it
             d["annotations"] = {
                 k: v
                 for k, v in (d.get("annotations") or {}).items()
                 if not k.startswith("seldon.io/disagg")
+                and k != ANNOTATION_KV_TIER_BYTES
             }
             graph = d["graph"]
             params = list(graph.get("parameters") or [])

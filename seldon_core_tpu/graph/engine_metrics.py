@@ -121,6 +121,19 @@ class MetricsRegistry:
         "gen_pressure_refused": "seldon_engine_pressure_refused",
         "gen_pressure_prefix_evictions":
             "seldon_engine_pressure_prefix_evictions",
+        # tiered KV memory: slabs demoted to the host-RAM tier, tier
+        # lookups that found an entry, entries promoted back to device
+        # (prefix match, peer pull, checkpoint copy-back), entries
+        # LRU-evicted/CRC-dropped, and resumes that expected a tier
+        # checkpoint but fell back to recompute + replay — the
+        # observable half of the spill-don't-destroy contract in
+        # docs/generate.md "Tiered KV memory"
+        "gen_kv_tier_demotions": "seldon_engine_kv_tier_demotions",
+        "gen_kv_tier_promotions": "seldon_engine_kv_tier_promotions",
+        "gen_kv_tier_hits": "seldon_engine_kv_tier_hits",
+        "gen_kv_tier_evictions": "seldon_engine_kv_tier_evictions",
+        "gen_kv_tier_replay_fallbacks":
+            "seldon_engine_kv_tier_replay_fallbacks",
         # live migration: graceful drains, checkpoints exported and
         # handed to a peer, resumes admitted from wire checkpoints /
         # resume tokens, and hot-swap straggler preemptions — the
@@ -145,6 +158,9 @@ class MetricsRegistry:
         "gen_pressure_budget_bytes":
             "seldon_engine_pressure_budget_bytes",
         "gen_pressure_active": "seldon_engine_pressure_active",
+        # host KV tier occupancy: HOST RAM, deliberately not one of the
+        # HBM pressure gauges (the ledger never counts tier bytes)
+        "gen_kv_tier_bytes": "seldon_engine_kv_tier_bytes",
     }
 
     # generate SLO TIMERs (per completed request, shipped by the generate

@@ -322,6 +322,78 @@ def parse_disagg_annotations(spec: PredictorSpec) -> "Optional[tuple]":
     return prefill, decode
 
 
+# tiered KV memory (docs/generate.md "Tiered KV memory"): byte budget
+# of the generate scheduler's pinned host-RAM KV spill tier
+ANNOTATION_KV_TIER_BYTES = "seldon.io/kv-tier-bytes"
+
+
+def parse_kv_tier_annotation(spec: PredictorSpec) -> "Optional[int]":
+    """The ``seldon.io/kv-tier-bytes`` byte budget when the predictor
+    opts into the host KV tier, None otherwise. The ONE parser shared
+    by admission validation and the reconciler's parameter injection,
+    strict at apply time: the graph must contain a GENERATE_SERVER unit
+    (the tier is a generate-scheduler subsystem), the value must be a
+    non-negative integer, and the graph must not also set the
+    ``host_kv_tier_bytes`` parameter by hand (the annotation owns it —
+    two sources of truth for one budget is how operators get neither)."""
+    ann = spec.annotations or {}
+    raw = ann.get(ANNOTATION_KV_TIER_BYTES)
+    if raw is None:
+        return None
+    try:
+        tier_bytes = int(str(raw).strip())
+    except (TypeError, ValueError) as e:
+        raise GraphSpecError(
+            f"predictor {spec.name!r}: malformed {ANNOTATION_KV_TIER_BYTES} "
+            f"annotation {raw!r}: {e}"
+        ) from e
+    if tier_bytes < 0:
+        raise GraphSpecError(
+            f"predictor {spec.name!r}: {ANNOTATION_KV_TIER_BYTES} must be "
+            f">= 0, got {tier_bytes}"
+        )
+    gen_units = [
+        u for u in spec.graph.walk()
+        if u.implementation == "GENERATE_SERVER"
+    ]
+    if not gen_units:
+        raise GraphSpecError(
+            f"predictor {spec.name!r}: {ANNOTATION_KV_TIER_BYTES} needs a "
+            "GENERATE_SERVER unit (the KV tier is a generate-scheduler "
+            "subsystem)"
+        )
+    for unit in gen_units:
+        for p in unit.parameters:
+            if p.name == "host_kv_tier_bytes":
+                raise GraphSpecError(
+                    f"predictor {spec.name!r}: {ANNOTATION_KV_TIER_BYTES} "
+                    "owns the 'host_kv_tier_bytes' parameter — drop it "
+                    "from the graph (the reconciler injects it per member)"
+                )
+    return tier_bytes
+
+
+def inject_kv_tier_param(spec_dict: Dict, tier_bytes: int) -> Dict:
+    """Append ``host_kv_tier_bytes`` to every GENERATE_SERVER node of a
+    predictor-spec dict (the reconciler's injection half of the
+    annotation contract). Mutates and returns ``spec_dict``."""
+
+    def visit(node: Dict) -> None:
+        if node.get("implementation") == "GENERATE_SERVER":
+            params = list(node.get("parameters") or [])
+            params.append({
+                "name": "host_kv_tier_bytes",
+                "value": str(int(tier_bytes)),
+                "type": "STRING",
+            })
+            node["parameters"] = params
+        for child in node.get("children") or []:
+            visit(child)
+
+    visit(spec_dict["graph"])
+    return spec_dict
+
+
 def validate_predictor(spec: PredictorSpec) -> None:
     """Reference checks: seldondeployment_webhook.go:388-411."""
     if spec.replicas < 0:
@@ -346,6 +418,9 @@ def validate_predictor(spec: PredictorSpec) -> None:
     # rollout annotations): a typo'd pool size or a multi-node disagg
     # graph fails the apply, not the reconcile
     parse_disagg_annotations(spec)
+    # kv-tier annotation: same strict-at-apply policy (a typo'd budget
+    # or a tier on a non-generate graph fails the apply)
+    parse_kv_tier_annotation(spec)
 
 
 def validate_deployment(predictors: List[PredictorSpec]) -> None:

@@ -2416,6 +2416,152 @@ def bench_pressure(
     }
 
 
+def bench_kvtier(
+    root: str,
+    n_requests: int = 6,
+    prompt_len: int = 6,
+    max_new_tokens: int = 16,
+    slots: int = 2,
+    steps_per_poll: int = 4,
+    config: Optional[Dict[str, Any]] = None,
+    deadline_s: float = 120.0,
+    shrink_lanes: float = 1.3,
+    after_polls: int = 4,
+    restore_after_polls: int = 24,
+    label: str = "llm-kvtier",
+) -> Dict[str, Any]:
+    """Tiered KV memory: the spill-vs-destroy proof, tier on vs off in
+    ONE entry (docs/generate.md "Tiered KV memory").
+
+    The same mid-run ledger shrink (SELDON_FAULTS pressure hook) runs
+    against two servers: tier OFF — preempted lanes resume by prompt
+    recompute + teacher-forced replay (``replayed_tokens`` > 0 in the
+    flight records) — and tier ON, where every resume rides the
+    host-tier copy-back (``seldon_engine_kv_tier_hits`` > 0, the
+    replay-fallback counter quiet, zero tokens replayed). Both modes
+    must produce greedy output byte-identical to the pressure-free
+    reference, and the tier window's slowest request bounds the resume
+    cost the spill saved."""
+    from .resilience.faults import FaultInjector
+    from .servers.generateserver import GenerateServer
+
+    cfg = dict(config or {})
+    cfg.setdefault("max_seq", 64)
+    model_dir = write_model_dir(root, "llm", cfg)
+    vocab = cfg.get("vocab_size", 256)
+    common = dict(
+        model_uri=model_dir, steps_per_poll=steps_per_poll,
+        warmup_prompt_lens=[prompt_len],
+        warmup_max_new_tokens=max_new_tokens,
+    )
+    rs = np.random.RandomState(23)
+    prompts = [rs.randint(1, vocab, prompt_len).tolist()
+               for _ in range(n_requests)]
+    greedy_kw = dict(max_new_tokens=max_new_tokens, temperature=0.0,
+                     eos_id=None, seed=0)
+
+    ref = GenerateServer(slots=slots, **common)
+    ref.load()
+    refs = [ref.batcher.generate(list(p), **greedy_kw) for p in prompts]
+    ref.close()
+
+    def run_window(tier_on: bool) -> Dict[str, Any]:
+        srv = GenerateServer(
+            slots=slots, hbm_ledger_bytes=1 << 40,
+            # generous host budget: the tier only ever holds what the
+            # window actually spills (a few lane slabs + prefix slabs),
+            # and at flagship scale one 1.26B lane checkpoint is tens of
+            # MB — the budget must not be what refuses it
+            host_kv_tier_bytes=(2 << 30) if tier_on else 0,
+            kv_tier_min_tokens=2, **common,
+        )
+        srv.load()
+        b = srv.batcher
+        lane_bytes = (
+            b._attn_need(prompt_len + max_new_tokens) * b._kv_key_bytes
+        )
+        inj = FaultInjector([], pressure={
+            "shrink_to_bytes": max(1, int(shrink_lanes * lane_bytes)),
+            "after_polls": after_polls,
+            "restore_after_polls": restore_after_polls,
+        })
+        b.pressure_hook = inj.pressure_hook()
+        t0 = time.perf_counter()
+        try:
+            futs = [b.submit(list(p), **greedy_kw) for p in prompts]
+            outs, slowest = [], 0.0
+            for f in futs:
+                t_req = time.perf_counter()
+                try:
+                    outs.append(f.result(timeout=deadline_s))
+                except Exception as e:  # noqa: BLE001 - typed failures counted
+                    outs.append(type(e).__name__)
+                slowest = max(slowest, time.perf_counter() - t_req)
+            b.sync_kv_tier_stats()
+            stats = dict(b.stats)
+            replayed = sum(
+                e.get("replayed_tokens", 0)
+                for e in (b.flight.snapshot() if b.flight else [])
+                if e.get("type") == "preempt_resume"
+            )
+        finally:
+            elapsed = time.perf_counter() - t0
+            srv.close()
+        return {
+            "identical": outs == refs,
+            "completed_all": all(isinstance(o, list) for o in outs),
+            "slowest_s": round(slowest, 3),
+            "elapsed_s": round(elapsed, 3),
+            "preemptions": stats["preemptions"],
+            "preempt_resumes": stats["preempt_resumes"],
+            "replayed_tokens": replayed,
+            "kv_tier_demotions": stats["kv_tier_demotions"],
+            "kv_tier_hits": stats["kv_tier_hits"],
+            "kv_tier_promotions": stats["kv_tier_promotions"],
+            "kv_tier_replay_fallbacks": stats["kv_tier_replay_fallbacks"],
+        }
+
+    off = run_window(tier_on=False)
+    on = run_window(tier_on=True)
+    identical = off["identical"] and on["identical"]
+    return {
+        "model": label,
+        "scenario": (
+            "mid-run HBM-ledger shrink, tier off vs on in one entry: "
+            "off resumes by recompute+replay (destroy), on resumes by "
+            "host-tier copy-back (spill — kv_tier_hits > 0, replay "
+            "fallbacks quiet, zero tokens replayed); greedy identity "
+            "both modes"
+        ),
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new_tokens,
+        "requests_total": 2 * n_requests,
+        # the acceptance bits
+        "greedy_identical": identical,
+        "completed_all": off["completed_all"] and on["completed_all"],
+        "no_hang": max(off["slowest_s"], on["slowest_s"]) <= deadline_s,
+        "preemption_exercised": (
+            off["preemptions"] >= 1 and on["preemptions"] >= 1
+        ),
+        "copyback_exercised": (
+            on["kv_tier_hits"] >= 1
+            and on["kv_tier_replay_fallbacks"] == 0
+            and on["replayed_tokens"] == 0
+        ),
+        "destroy_replayed_tokens": off["replayed_tokens"],
+        "tier_off": off,
+        "tier_on": on,
+        "slowest_tier_off_s": off["slowest_s"],
+        "slowest_tier_on_s": on["slowest_s"],
+        "tokens_per_s": round(
+            2 * n_requests * max_new_tokens
+            / max(off["elapsed_s"] + on["elapsed_s"], 1e-9), 2,
+        ),
+        "p50_ms": None,
+        "p99_ms": None,
+    }
+
+
 def bench_migration(
     root: str,
     n_requests: int = 4,
@@ -2853,6 +2999,19 @@ def run_model_tier(
                     "n_heads": 2, "n_kv_heads": 2, "d_ff": 64, "max_seq": 64,
                 },
             )
+            # tiered-KV-memory proof: the SAME ledger shrink with the
+            # host tier off (recompute+replay resume) vs on (host-tier
+            # copy-back — kv_tier_hits > 0, replay fallbacks quiet,
+            # zero tokens replayed) in one entry, greedy identity both
+            # modes (chip scales the same harness)
+            results["llm_1b_kvtier"] = bench_kvtier(
+                root, n_requests=4, prompt_len=6, max_new_tokens=16,
+                slots=2, steps_per_poll=4,
+                config={
+                    "vocab_size": 256, "d_model": 32, "n_layers": 2,
+                    "n_heads": 2, "n_kv_heads": 2, "d_ff": 64, "max_seq": 64,
+                },
+            )
             # zero-loss serving proof: graceful drain of a loaded member
             # mid-decode (mixed greedy+seeded batch + live stream) hands
             # every lane's SGC1 checkpoint to a peer byte-identically
@@ -3238,6 +3397,16 @@ def run_model_tier(
             results["llm_1b_pressure"] = bench_pressure(
                 root, label="llm-1.26b-pressure",
                 n_requests=8, prompt_len=128, max_new_tokens=64,
+                slots=4, steps_per_poll=16,
+                config={**big_cfg, "max_seq": 256},
+            )
+            # tiered KV memory at flagship scale: the spill-vs-destroy
+            # delta is paid at real model size — a 1.26B lane's
+            # copy-back is a tens-of-MB PCIe pull where the destroy
+            # path re-runs a 128-token prefill + teacher-forced replay
+            results["llm_1b_kvtier"] = bench_kvtier(
+                root, label="llm-1.26b-kvtier",
+                n_requests=6, prompt_len=128, max_new_tokens=64,
                 slots=4, steps_per_poll=16,
                 config={**big_cfg, "max_seq": 256},
             )

@@ -118,6 +118,31 @@ Model URI layout: same ``jax_config.json`` as jaxserver with
                      (default 0.90): crossing it latches pressure
     pressure_low     low watermark (default 0.75): reclaim runs until
                      usage drops here, then admissions resume
+    host_kv_tier_bytes
+                     tiered KV memory: byte budget of the pinned
+                     host-RAM spill tier (0 = off, the disable flag).
+                     With it on, the reclaim ladder DEMOTES prefix
+                     slabs to host instead of destroying them (a later
+                     match promotes: device_put + splice — a PCIe copy
+                     instead of a re-prefill), preempted lanes
+                     checkpoint their exact K/V for copy-back resume
+                     (recompute+replay stays the fallback), prefill
+                     exports publish their slabs for peers, and the KV
+                     port answers peer prefix-lookups from the tier —
+                     see docs/generate.md "Tiered KV memory"
+    kv_tier_min_tokens
+                     demote threshold: prefixes shorter than this never
+                     enter the tier (0 = prefix_cache_min_tokens)
+    kv_tier_promote_min_tokens
+                     promote threshold: tier matches shallower than
+                     this are not worth the PCIe copy (0 = the demote
+                     threshold)
+    kv_tier_peer_lookup
+                     decode role: ask the prefill peers' host tiers for
+                     a shared prefix before requesting a full prefill
+                     (-1 = auto, on exactly when host_kv_tier_bytes is
+                     set; 0 = off; 1 = force on — needs a local prefix
+                     cache to splice the pulled slab)
     resume_tokens    live migration: attach an opaque SGC1 resume token
                      (serving/migration.py) to every streamed span (and
                      the unary response) so a member death mid-
@@ -182,6 +207,7 @@ class GenerateServer(SeldonComponent):
     _kv_server = None
     _kv_client = None
     _resume_tokens = False
+    _kv_tier_peer_lookup = False
     batcher = None
 
     def __init__(
@@ -215,6 +241,10 @@ class GenerateServer(SeldonComponent):
         hbm_ledger_bytes: int = 0,
         pressure_high: float = 0.90,
         pressure_low: float = 0.75,
+        host_kv_tier_bytes: int = 0,
+        kv_tier_min_tokens: int = 0,
+        kv_tier_promote_min_tokens: int = 0,
+        kv_tier_peer_lookup: int = -1,
         resume_tokens: int = 0,
         swap_drain_ms: int = 0,
         swap_resume_policy: str = "resume",
@@ -238,6 +268,14 @@ class GenerateServer(SeldonComponent):
         self._hbm_ledger_bytes = int(hbm_ledger_bytes)
         self._pressure_high = float(pressure_high)
         self._pressure_low = float(pressure_low)
+        self._host_kv_tier_bytes = int(host_kv_tier_bytes)
+        self._kv_tier_min_tokens = int(kv_tier_min_tokens)
+        self._kv_tier_promote_min_tokens = int(kv_tier_promote_min_tokens)
+        # -1 = auto: peer prefix-lookups ride exactly the tier knob
+        self._kv_tier_peer_lookup = (
+            self._host_kv_tier_bytes > 0
+            if int(kv_tier_peer_lookup) < 0 else bool(int(kv_tier_peer_lookup))
+        )
         # typed-params env delivers booleans as strings
         self._resume_tokens = (
             resume_tokens.lower() == "true"
@@ -401,6 +439,9 @@ class GenerateServer(SeldonComponent):
             hbm_ledger_bytes=self._hbm_ledger_bytes,
             pressure_high=self._pressure_high,
             pressure_low=self._pressure_low,
+            host_kv_tier_bytes=self._host_kv_tier_bytes,
+            kv_tier_min_tokens=self._kv_tier_min_tokens,
+            kv_tier_promote_min_tokens=self._kv_tier_promote_min_tokens,
             swap_drain_ms=self._swap_drain_ms,
             swap_resume_policy=self._swap_resume_policy,
         )
@@ -546,9 +587,16 @@ class GenerateServer(SeldonComponent):
         """PREFILL-side transport handler: run the prompt forward and
         return ``(meta, slab)`` for the wire codec. Called by the
         loopback transport directly and by PrefillTransportServer per
-        TCP connection."""
+        TCP connection. A ``prefix_lookup`` request is answered from
+        the HOST KV TIER instead — no device work at all: the longest
+        stored prefix's slab (CRC-verified on read) goes back over the
+        same codec, or a typed :class:`~..serving.disagg.TierMiss`
+        frame that the failover layer passes through without ejecting
+        (a cold tier is not a dead pool)."""
         if self.batcher is None:
             self.load()
+        if request.get("prefix_lookup"):
+            return self._tier_lookup(request)
         toks = request.get("tokens")
         if not toks:
             raise ValueError("prefill request needs tokens")
@@ -560,6 +608,92 @@ class GenerateServer(SeldonComponent):
             seed=int(request.get("seed", 0)),
             covered_len=int(request.get("covered_len", 0)),
         )
+
+    @caller_thread
+    def _tier_lookup(self, request: Dict[str, Any]):
+        """Answer a peer's prefix-lookup from the local host KV tier:
+        ``(meta, slab)`` covering the ENTRY's full token path (the
+        puller re-inserts it into its own radix index and lets the
+        ordinary match serve the common depth). Runs on transport
+        handler threads — the tier is host bytes under its own lock, so
+        this never touches the device or the scheduler."""
+        from ..serving.disagg import TierMiss
+
+        b = self.batcher
+        tier = b._kv_tier
+        toks = [int(t) for t in request.get("tokens") or []]
+        if tier is None or not toks:
+            raise TierMiss("no host KV tier on this member")
+        want_version = request.get("weight_version")
+        if want_version != b.weight_version:
+            raise TierMiss(
+                f"tier serves weight_version {b.weight_version!r}, "
+                f"peer asked for {want_version!r}"
+            )
+        # the SHARED usable-hit probe (ContinuousBatcher.tier_prefix_
+        # lookup): the same promote-gate + donor-width/near-max caps the
+        # puller applies locally run HERE, before the transfer is paid
+        # (pool members share one model config, so bucket geometry
+        # agrees) — a corrupt entry is dropped typed inside the probe
+        # and answers a MISS frame, never a generic error that would
+        # eject a healthy listener
+        hit = b.tier_prefix_lookup(
+            toks, min_tokens=int(request.get("min_tokens", 0))
+        )
+        if hit is None:
+            raise TierMiss(
+                "no usable stored prefix for this prompt (miss, below "
+                "the promote gate, or not a win at this prompt's bucket)"
+            )
+        depth, meta, slab = hit
+        with b._export_lock:
+            # the peer-serving hit is a TIER hit on THIS member (its RAM
+            # saved the peer a prefill); the puller counts the promotion
+            b.stats["kv_tier_hits"] = tier.stats["hits"]
+        if b.flight is not None and b.flight.enabled:
+            from ..serving.disagg import prompt_hash
+
+            b.flight.record({
+                "type": "tier_hit", "kind": "prefix", "source": "peer",
+                "tokens": depth,
+                "phash": prompt_hash(meta.get("tokens") or [])[:8],
+            })
+        out_meta = {
+            "kind": "tier_prefix",
+            "tokens": meta.get("tokens"),
+            "weight_version": b.weight_version,
+            "tier_depth": depth,
+        }
+        return out_meta, slab
+
+    @caller_thread
+    def _peer_prefix_pull(self, toks, deadline_s) -> int:
+        """Decode-role tier sharing: on a LOCAL radix miss, ask the
+        prefill peers' host tiers for a shared prefix and promote the
+        answer into the local radix index. Returns the new
+        ``remote_covered_len`` (0 when nothing was pulled). Misses and
+        transport trouble are non-events — the ordinary full-prefill
+        path is always right behind."""
+        from ..serving.disagg import DisaggError, TierMiss
+
+        b = self.batcher
+        try:
+            meta, slab = self._kv_client.prefill({
+                "prefix_lookup": True,
+                "tokens": [int(t) for t in toks],
+                "weight_version": b.weight_version,
+                "min_tokens": b.tier_promote_gate,
+            }, deadline_s=deadline_s)
+        except TierMiss:
+            return 0
+        except DisaggError:
+            # peer trouble is the failover layer's business (it already
+            # ejected/rotated as needed); the lookup is opportunistic
+            return 0
+        if meta.get("weight_version") != b.weight_version:
+            return 0
+        b.promote_peer_prefix(meta, slab)
+        return b.remote_covered_len(toks)
 
     @caller_thread
     def _remote_submit(self, toks, kw, deadline_s, covered=None,
@@ -602,6 +736,22 @@ class GenerateServer(SeldonComponent):
         self.batcher._shed_check(deadline_s, remote=True)
         if covered is None:
             covered = self.batcher.remote_covered_len(toks)
+            if covered == 0 and self.batcher._kv_tier is not None:
+                # a demoted prefix in this member's OWN tier promotes
+                # back before asking anyone else
+                covered = self.batcher.consult_tier_covered_len(toks)
+            if (
+                covered == 0
+                and self._kv_tier_peer_lookup
+                and self.batcher._prefix_index is not None
+                and len(toks) >= self.batcher.tier_promote_gate
+            ):
+                # cluster-wide prefix sharing: a local radix miss asks
+                # the prefill peers' host tiers before paying a full
+                # prefill + full-slab transfer (the pulled slab promotes
+                # into the local radix index, so the suffix-only
+                # request below dedups the wire bytes too)
+                covered = self._peer_prefix_pull(toks, deadline_s)
         request = {
             "tokens": [int(t) for t in toks],
             "covered_len": int(covered),
@@ -708,6 +858,11 @@ class GenerateServer(SeldonComponent):
         drained = b.drain(timeout_s=timeout_s)
         cks = [migration.checkpoint_of(req, b.weight_version)
                for req in drained]
+        for req in drained:
+            # the work leaves this member (peer resume, or typed failure
+            # below): its host-tier K/V checkpoint would otherwise pin
+            # tier budget forever
+            b._release_tier_ckpt(req)
         with b._export_lock:
             b.stats["checkpoint_exports"] += len(cks)
         if b.flight is not None and b.flight.enabled:
@@ -1220,6 +1375,8 @@ class GenerateServer(SeldonComponent):
         tail-latency regression. None when the recorder is off/not loaded."""
         if self.batcher is None or self.batcher.flight is None:
             return None
+        if self.batcher._kv_tier is not None:
+            self.batcher.sync_kv_tier_stats()
         out = self.batcher.flight.dump(limit)
         out["slo"] = self.batcher.slo_summary()
         out["stats"] = {k: v for k, v in self.batcher.stats.items()}
@@ -1227,6 +1384,9 @@ class GenerateServer(SeldonComponent):
         pressure = self.batcher.pressure_summary()
         if pressure is not None:
             out["pressure"] = pressure
+        tier = self.batcher.kv_tier_summary()
+        if tier is not None:
+            out["kv_tier"] = tier
         return out
 
     def metrics(self) -> List[Dict]:
@@ -1337,6 +1497,22 @@ class GenerateServer(SeldonComponent):
         if s.get("pressure_prefix_evictions"):
             out.append(delta("gen_pressure_prefix_evictions",
                              s["pressure_prefix_evictions"]))
+        # tiered KV memory: demote/promote/hit/evict counters plus the
+        # tier's live byte level — engine_metrics maps them to the
+        # first-class seldon_engine_kv_tier_* series (host RAM, NOT the
+        # HBM pressure gauges)
+        if self.batcher._kv_tier is not None:
+            self.batcher.sync_kv_tier_stats()
+            out.extend([
+                delta("gen_kv_tier_demotions", s["kv_tier_demotions"]),
+                delta("gen_kv_tier_promotions", s["kv_tier_promotions"]),
+                delta("gen_kv_tier_hits", s["kv_tier_hits"]),
+                delta("gen_kv_tier_evictions", s["kv_tier_evictions"]),
+                delta("gen_kv_tier_replay_fallbacks",
+                      s["kv_tier_replay_fallbacks"]),
+                {"type": "GAUGE", "key": "gen_kv_tier_bytes",
+                 "value": float(s["kv_tier_bytes"])},
+            ])
         pressure = self.batcher.pressure_summary()
         if pressure is not None:
             out.extend([

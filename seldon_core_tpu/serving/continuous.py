@@ -295,6 +295,9 @@ class ContinuousBatcher:
         hbm_ledger_bytes: int = 0,
         pressure_high: float = 0.90,
         pressure_low: float = 0.75,
+        host_kv_tier_bytes: int = 0,
+        kv_tier_min_tokens: int = 0,
+        kv_tier_promote_min_tokens: int = 0,
         swap_drain_ms: int = 0,
         swap_resume_policy: str = "resume",
     ):
@@ -439,6 +442,37 @@ class ContinuousBatcher:
             from .prefix_cache import RadixPrefixIndex
 
             self._prefix_index = RadixPrefixIndex(self._prefix_cache_budget)
+        # -- tiered KV memory: host-RAM spill tier (serving/kvtier.py) ----
+        # SKV1-serialized slabs in pinned host RAM under their own byte
+        # budget (0 = off): the reclaim ladder DEMOTES prefix slabs here
+        # instead of destroying them (promote = device_put + splice on a
+        # later match, locally or from a peer's tier over the KV
+        # transport), and preempted lanes checkpoint their exact cache
+        # columns for copy-back resume (recompute+replay stays the
+        # fallback when the tier evicted the entry).
+        self.host_kv_tier_bytes = max(0, int(host_kv_tier_bytes))
+        # demote threshold: prefixes shorter than this never enter the
+        # tier (defaults to prefix_cache_min_tokens); promote threshold:
+        # tier matches shallower than this are not worth the PCIe copy
+        # (defaults to the demote threshold)
+        self.kv_tier_min_tokens = (
+            int(kv_tier_min_tokens) or self.prefix_cache_min_tokens
+        )
+        self.kv_tier_promote_min_tokens = (
+            int(kv_tier_promote_min_tokens) or self.kv_tier_min_tokens
+        )
+        self._kv_tier = None
+        if self.host_kv_tier_bytes > 0:
+            from .kvtier import HostKVTier
+
+            self._kv_tier = HostKVTier(
+                self.host_kv_tier_bytes,
+                min_tokens=self.kv_tier_min_tokens,
+                version=self.weight_version
+                if hasattr(self, "weight_version") else 0,
+            )
+        # checkpoint-entry keys are per-batcher sequence numbers
+        self._tier_ck_seq = 0
         # spec_rounds / spec_emitted feed the acceptance-rate gauge:
         # emitted/rounds ranges 1 (nothing accepted) .. gamma+1 (all).
         # prefill_steps/prefill_tokens split device prefill work out from
@@ -487,6 +521,17 @@ class ContinuousBatcher:
             "preemptions": 0, "preempt_resumes": 0,
             "pressure_sheds": 0, "pressure_refused": 0,
             "pressure_prefix_evictions": 0,
+            # tiered KV memory (host-RAM spill tier): slabs demoted to
+            # host RAM (prefix demotions + lane checkpoints + export
+            # publishes), tier lookups that found an entry, entries
+            # promoted back to device (device_put: local prefix match,
+            # peer pull, checkpoint copy-back), entries LRU-evicted or
+            # CRC-dropped, the tier's live byte level, and resumes that
+            # EXPECTED a tier checkpoint but fell back to recompute +
+            # teacher-forced replay (the tier evicted/refused it)
+            "kv_tier_demotions": 0, "kv_tier_promotions": 0,
+            "kv_tier_hits": 0, "kv_tier_evictions": 0,
+            "kv_tier_bytes": 0, "kv_tier_replay_fallbacks": 0,
             # fused multi-step decode: device steps run inside stop-aware
             # fused bursts, and the dispatches that carried them — the
             # dispatch-floor win IS fused_steps / fused_dispatches
@@ -1708,6 +1753,21 @@ class ContinuousBatcher:
         # suffix-only when the decode side already holds the prefix
         k = np.asarray(cache_one["k"])
         v = np.asarray(cache_one["v"])
+        if self._kv_tier is not None:
+            # the FULL prompt slab is already host-side here — publishing
+            # it into the tier costs one SKV1 encode and zero device
+            # work, and makes this member's KV port answer peer
+            # prefix-lookups for the prompt (cluster-wide sharing)
+            if self._kv_tier.put_prefix(tokens, {"k": k, "v": v},
+                                        self.weight_version):
+                if self.flight is not None and self.flight.enabled:
+                    self.flight.record({
+                        "type": "kv_demote", "kind": "prefix",
+                        "source": "export",
+                        "tokens": n,
+                        "phash": prompt_hash(tokens)[:8],
+                        "bytes": int(k.nbytes) + int(v.nbytes),
+                    })
         if covered:
             k = k[:, :, :, covered:, :]
             v = v[:, :, :, covered:, :]
@@ -2185,6 +2245,12 @@ class ContinuousBatcher:
                 purged = self._prefix_index.set_version(swap.version)
                 self.stats["prefix_evicted"] += purged
                 self.stats["prefix_cache_bytes"] = self._prefix_index.total_bytes
+            if self._kv_tier is not None:
+                # the tier's entries are OLD-weights K/V too: purge on
+                # the same version key (a swap straggler's checkpoint
+                # then replays on the new weights instead of splicing
+                # stale cache — correct by construction)
+                self._kv_tier.set_version(swap.version)
             self.stats["weight_swaps"] += 1
             if self.flight is not None and self.flight.enabled:
                 self.flight.record({
@@ -2707,12 +2773,26 @@ class ContinuousBatcher:
         if swap is not None and not swap.future.done():
             swap.future.set_exception(err)
 
+    def _release_tier_ckpt(self, req: GenRequest) -> None:
+        """Release a request's host-tier checkpoint (if any): the
+        request was cancelled, failed, or migrated away, so the entry
+        would otherwise pin tier budget forever — prefix demotions can
+        never evict checkpoints. Callable from any thread (the tier is
+        lock-protected; ``pop`` makes the release idempotent)."""
+        ck = req.resume
+        if ck is None or self._kv_tier is None:
+            return
+        key = ck.pop("tier", None)
+        if key is not None:
+            self._kv_tier.drop_ckpt(key)
+
     def _drain_queue(self, err: Exception) -> None:
         while self._resume_queue:
             try:
                 req = self._resume_queue.popleft()
             except IndexError:  # raced another drainer
                 break
+            self._release_tier_ckpt(req)
             if not req.future.done():
                 req.future.set_exception(err)
         while True:
@@ -3097,6 +3177,17 @@ class ContinuousBatcher:
         n = len(tokens)
         m, slab = self._prefix_index.match(tokens)
         m = min(m, n - 1)
+        if (
+            (slab is None or m < self.prefix_cache_min_tokens)
+            and self._kv_tier is not None
+        ):
+            # device radix miss: consult the host tier — a demoted slab
+            # promotes (device_put + re-insert) and serves this very
+            # admission as an ordinary splice
+            promoted = self._promote_tier_prefix(tokens)
+            if promoted is not None:
+                m, slab = promoted
+                m = min(m, n - 1)
         if slab is None or m < self.prefix_cache_min_tokens:
             return None
         if m + self._bucket(n - m) > self.max_seq:
@@ -3221,6 +3312,252 @@ class ContinuousBatcher:
         # upload buffer frees as soon as the insert's copy completes
         req.remote = None
 
+    # -- tiered KV memory: host-RAM spill tier (serving/kvtier.py) ---------
+
+    def sync_kv_tier_stats(self) -> None:
+        """Mirror the tier's internal counters into the batcher's stats
+        surface (flight dumps, server metric deltas). Tier counters are
+        written under the tier lock by scheduler AND transport threads;
+        these are plain int copies, safe from any thread."""
+        tier = self._kv_tier
+        if tier is None:
+            return
+        t = tier.stats
+        self.stats["kv_tier_demotions"] = t["demotions"]
+        self.stats["kv_tier_hits"] = t["hits"]
+        self.stats["kv_tier_evictions"] = t["evictions"]
+        self.stats["kv_tier_bytes"] = tier.total_bytes
+
+    def kv_tier_summary(self) -> Optional[Dict[str, Any]]:
+        return self._kv_tier.summary() if self._kv_tier is not None else None
+
+    @property
+    def tier_promote_gate(self) -> int:
+        """Effective promote threshold: a tier match below
+        ``prefix_cache_min_tokens`` could never serve an admission (the
+        radix-hit gate would discard it right after the PCIe copy), so
+        the promote gate is the max of the two knobs."""
+        return max(self.kv_tier_promote_min_tokens,
+                   self.prefix_cache_min_tokens)
+
+    @scheduler_only
+    def _demote_prefix_slabs(self, victims) -> None:
+        """Demote reclaim-ladder prefix victims to the host tier:
+        ``device_get`` each slab at this poll boundary (the one designed
+        sync of the demote path — pressure reclaim is already a
+        poll-boundary event and the copy IS the feature: a PCIe pull now
+        buys back a whole re-prefill later), SKV1-encode, store keyed by
+        (weight_version, token path)."""
+        import jax
+
+        from .disagg import prompt_hash
+
+        tier = self._kv_tier
+        for tokens, slab, _nbytes in victims:
+            # refuse BEFORE the PCIe pull, not after: a victim below the
+            # demote threshold, or already covered by a stored entry,
+            # would be refused by put_prefix anyway — paying two
+            # device_get syncs for it mid-pressure-event is the worst
+            # possible time
+            if (
+                len(tokens) < tier.min_tokens
+                or tier.prefix_covered_len(tokens, self.weight_version)
+                >= len(tokens)
+            ):
+                continue
+            host = {
+                "k": jax.device_get(slab["k"]),  # seldon-lint: disable=host-sync-hot-path (tier demote: poll-boundary PCIe pull of an evicted prefix slab — the copy replaces a future re-prefill; reclaim is latched, not steady-state)
+                "v": jax.device_get(slab["v"]),  # seldon-lint: disable=host-sync-hot-path (tier demote: second half of the same poll-boundary slab pull)
+            }
+            if tier.put_prefix(tokens, host, self.weight_version):
+                if self.flight is not None and self.flight.enabled:
+                    self.flight.record({
+                        "type": "kv_demote", "kind": "prefix",
+                        "tokens": len(tokens),
+                        "phash": prompt_hash(tokens)[:8],
+                        "bytes": int(host["k"].nbytes) + int(host["v"].nbytes),
+                    })
+
+    def tier_prefix_lookup(self, tokens, min_tokens: Optional[int] = None):
+        """The ONE usable-hit probe of this member's host tier, shared
+        by the scheduler's promote-on-miss, the decode role's
+        transfer-dedup consult, and the KV-port listener's peer lookup
+        — so the gate (promote threshold, donor-bucket cap, near-max
+        suffix cap) can never drift between the side that SHIPS a slab
+        and the side that must splice it. Returns ``(m, meta, host)``
+        with host arrays CRC-verified, or None on miss / corruption
+        (entry already dropped, logged) / caps. Thread-safe: pure host
+        reads under the tier lock."""
+        from .disagg import DisaggError
+
+        tier = self._kv_tier
+        if tier is None:
+            return None
+        tokens = [int(t) for t in tokens]
+        n = len(tokens)
+        try:
+            hit = tier.match_prefix(tokens, self.weight_version)
+        except DisaggError as e:
+            logger.warning("kv tier prefix entry dropped: %s", e)
+            return None
+        if hit is None:
+            return None
+        depth, meta, host = hit
+        m = min(depth, n - 1)
+        if m < max(int(min_tokens or 0), self.tier_promote_gate):
+            return None
+        if (
+            host["k"].shape[3] > self._bucket(n)
+            or m + self._bucket(n - m) > self.max_seq
+        ):
+            # same caps the device-side match applies: a donor wider
+            # than the prompt bucket (or a near-max suffix insert) costs
+            # more than the prefill it skips
+            return None
+        return m, meta, host
+
+    @scheduler_only
+    def _promote_tier_prefix(self, tokens):
+        """Tier consult on a device radix miss: decode the longest
+        stored host prefix (CRC-verified), ``device_put`` it, re-insert
+        it into the device radix index under its ENTRY path, and return
+        ``(m, device_slab)`` ready for the ordinary splice — a warm hit
+        that costs a PCIe copy instead of a re-prefill. None on miss,
+        corruption (entry already dropped), or when the usability caps
+        say the splice would not win (see :meth:`tier_prefix_lookup`)."""
+        import jax.numpy as jnp
+
+        from .disagg import prompt_hash
+
+        idx = self._prefix_index
+        if idx is None:
+            return None
+        hit = self.tier_prefix_lookup(tokens)
+        if hit is None:
+            return None
+        m, meta, host = hit
+        entry_tokens = [int(t) for t in meta.get("tokens") or []]
+        slab_dev = {"k": jnp.asarray(host["k"]), "v": jnp.asarray(host["v"])}
+        nbytes = int(host["k"].nbytes) + int(host["v"].nbytes)
+        self.stats["prefix_evicted"] += idx.insert(
+            entry_tokens, slab_dev, nbytes
+        )
+        self.stats["prefix_cache_bytes"] = idx.total_bytes
+        self.stats["kv_tier_promotions"] += 1
+        if self.flight is not None and self.flight.enabled:
+            self.flight.record({
+                "type": "tier_hit", "kind": "prefix", "source": "local",
+                "tokens": m, "phash": prompt_hash(entry_tokens)[:8],
+            })
+            self.flight.record({
+                "type": "kv_promote", "kind": "prefix", "source": "local",
+                "tokens": m, "bytes": nbytes,
+                "phash": prompt_hash(entry_tokens)[:8],
+            })
+        return m, slab_dev
+
+    @caller_thread
+    def consult_tier_covered_len(self, tokens) -> int:
+        """Decode-role transfer-dedup consult of this member's OWN host
+        tier: a demoted prefix that matches the prompt promotes into the
+        device radix index right here (caller thread — the H2D upload
+        overlaps whatever burst the scheduler is running, exactly like a
+        remote admit's slab), and the refreshed ``remote_covered_len``
+        is returned so the prefill request ships suffix-only. 0 on
+        miss/corruption/caps — the full-slab path is always right
+        behind."""
+        if self._prefix_index is None:
+            return 0
+        hit = self.tier_prefix_lookup(tokens)
+        if hit is None:
+            return 0
+        _m, meta, host = hit
+        self.promote_peer_prefix(meta, host, source="local")
+        return self.remote_covered_len(tokens)
+
+    @caller_thread
+    def promote_peer_prefix(self, meta: Dict[str, Any],
+                            host: Dict[str, Any],
+                            source: str = "peer") -> int:
+        """Insert a prefix slab pulled from a PEER's host tier into the
+        LOCAL device radix index (H2D upload on this caller thread,
+        exactly like a remote admit's slab upload), so the ordinary
+        match/splice machinery — and the transfer-dedup consult — serve
+        it from here on. Returns the entry's token count."""
+        import jax.numpy as jnp
+
+        from .disagg import prompt_hash
+
+        idx = self._prefix_index
+        if idx is None:
+            return 0
+        entry_tokens = [int(t) for t in meta.get("tokens") or []]
+        if not entry_tokens:
+            return 0
+        slab_dev = {"k": jnp.asarray(host["k"]), "v": jnp.asarray(host["v"])}
+        nbytes = int(host["k"].nbytes) + int(host["v"].nbytes)
+        evicted = idx.insert(entry_tokens, slab_dev, nbytes)
+        with self._export_lock:
+            self.stats["prefix_evicted"] += evicted
+            self.stats["prefix_cache_bytes"] = idx.total_bytes
+            self.stats["kv_tier_promotions"] += 1
+        if self.flight is not None and self.flight.enabled:
+            self.flight.record({
+                "type": "kv_promote", "kind": "prefix", "source": source,
+                "tokens": len(entry_tokens), "bytes": nbytes,
+                "phash": prompt_hash(entry_tokens)[:8],
+            })
+        return len(entry_tokens)
+
+    @scheduler_only
+    def _checkpoint_kv_to_tier(self, slot: int, req: GenRequest) -> None:
+        """Ladder rung 3's spill half: copy the preempted lane's exact
+        cache columns ``[0, pos)`` to the host tier (when budget allows)
+        so resume is a copy-back insert instead of prompt-recompute +
+        teacher-forced replay. The extract is a device-side copy; the
+        pull to host is the one designed sync — the pipeline is already
+        drained (preemption's own requirement), and the bytes pulled
+        here are exactly the recompute the resume no longer pays."""
+        import jax
+
+        from .disagg import prompt_hash
+
+        tier = self._kv_tier
+        ck = req.resume
+        if tier is None or ck is None:
+            return
+        emitted = ck["emitted"]
+        n = len(req.tokens)
+        pos = n + len(emitted) - 1
+        width = self._attn_need(pos)
+        slab = self._extract_fn(self._cache, slot, width)
+        host = {
+            "k": jax.device_get(slab["k"]),  # seldon-lint: disable=host-sync-hot-path (tier checkpoint: poll-boundary pull of a preempted lane's K/V — pipeline already drained; this copy replaces the resume's whole recompute+replay)
+            "v": jax.device_get(slab["v"]),  # seldon-lint: disable=host-sync-hot-path (tier checkpoint: second half of the same poll-boundary lane pull)
+        }
+        self._tier_ck_seq += 1
+        key = self._tier_ck_seq
+        stored = tier.put_ckpt(
+            key,
+            {"pos": pos, "width": width, "prompt_tokens": n,
+             "emitted": len(emitted)},
+            host, version=self.weight_version,
+        )
+        if stored:
+            ck["tier"] = key
+            if self.flight is not None and self.flight.enabled:
+                self.flight.record({
+                    "type": "kv_demote", "kind": "ckpt", "lane": slot,
+                    "tokens": pos, "phash": prompt_hash(req.tokens)[:8],
+                    "bytes": int(host["k"].nbytes) + int(host["v"].nbytes),
+                })
+        else:
+            # belt and braces: a refused checkpoint leaves no key behind
+            # (the checkpoint dict is freshly built per preemption, but
+            # a stale key here would make the next resume count a
+            # phantom replay fallback)
+            ck.pop("tier", None)
+
     # -- HBM pressure: ledger, reclaim ladder, decode-lane preemption ------
 
     def pressure_summary(self) -> Optional[Dict[str, Any]]:
@@ -3295,11 +3632,14 @@ class ContinuousBatcher:
                     pc.restore_budget()
                 else:
                     pc.set_budget(int(nb))
+                if self._kv_tier is not None:
+                    pc.host_bytes = self._kv_tier.total_bytes
                 if self.flight is not None and self.flight.enabled:
                     self.flight.record({
                         "type": "pressure_budget",
                         "budget_bytes": pc.budget_bytes,
                         "restored": int(nb) < 0,
+                        "host_tier_bytes": pc.host_bytes,
                     })
         if pc.budget_bytes <= 0:
             # a restore can land back on a ZERO boot budget (pressure
@@ -3311,6 +3651,15 @@ class ContinuousBatcher:
             if pc.active:
                 pc.update(self._ledger_components())
             return
+        if self._kv_tier is not None:
+            # host-RAM occupancy rides the summary/flight surface but
+            # never the HBM ledger math (host RAM is not HBM — counting
+            # it would double-bill every demotion). Refreshed only on
+            # the budget>0 path: the no-pressure hot loop stays the two
+            # attribute checks the method contract promises
+            # (metrics()/flight_dump refresh on demand).
+            pc.host_bytes = self._kv_tier.total_bytes
+            self.sync_kv_tier_stats()
         pc.update(self._ledger_components())
         if not pc.active:
             if self._spec_suppressed:
@@ -3339,16 +3688,26 @@ class ContinuousBatcher:
         idx = self._prefix_index
         if pc.active and idx is not None and idx.total_bytes > 0:
             target = max(0, idx.total_bytes - pc.overshoot_bytes())
-            evicted = idx.evict_to(target)
+            # rung 1 is DEMOTE, not evict, when the host tier is on:
+            # victims are collected under the index lock, then pulled to
+            # host + SKV1-encoded into the tier out here (the slow part
+            # must not hold readers off the radix walk)
+            demoted: Optional[List] = (
+                [] if self._kv_tier is not None else None
+            )
+            evicted = idx.evict_to(target, collect=demoted)
             if evicted:
                 self.stats["prefix_evicted"] += evicted
                 self.stats["pressure_prefix_evictions"] += evicted
                 self.stats["prefix_cache_bytes"] = idx.total_bytes
+                if demoted:
+                    self._demote_prefix_slabs(demoted)
                 if self.flight is not None and self.flight.enabled:
                     self.flight.record({
                         "type": "pressure_reclaim",
                         "action": "evict_prefix",
                         "evicted": evicted,
+                        "demoted": len(demoted) if demoted else 0,
                         "used_bytes": pc.used,
                     })
                 pc.update(self._ledger_components())
@@ -3472,9 +3831,18 @@ class ContinuousBatcher:
     @scheduler_only
     def _preempt_lane(self, slot: int) -> None:
         """Preempt one decode lane (pressure ladder rung 3): checkpoint
-        to host via :meth:`_checkpoint_lane` and requeue for
-        recompute-resume."""
+        to host via :meth:`_checkpoint_lane` and requeue for resume.
+        With the host KV tier on, the lane's exact cache columns spill
+        there too (budget allowing) so the resume is a copy-back insert;
+        without it — or when the tier refuses/evicts — resume falls back
+        to recompute + teacher-forced replay, byte-identical either
+        way."""
         s, req = self._checkpoint_lane(slot)
+        if self._kv_tier is not None and req.resume is not None:
+            # spill the K/V BEFORE anything can reuse the slot's columns
+            # (same poll, scheduler thread — nothing dispatched since
+            # the drain)
+            self._checkpoint_kv_to_tier(slot, req)
         self.stats["preemptions"] += 1
         if self.flight is not None and self.flight.enabled:
             self.flight.record({
@@ -3563,15 +3931,18 @@ class ContinuousBatcher:
 
     @scheduler_only
     def _activate_resumed(self, slot: int, req: GenRequest,
-                          emitted: List[int]) -> None:
-        """Shared tail of the plain and chunked resume paths: replay the
-        emitted tokens' K/V, re-derive the draft prefix (speculation),
-        and re-activate the lane with crediting continuing AFTER the
-        checkpoint (already-delivered stream spans are never re-sent;
+                          emitted: List[int], replay: bool = True) -> None:
+        """Shared tail of the plain, chunked, and tier-copy-back resume
+        paths: replay the emitted tokens' K/V (``replay=False`` when a
+        tier checkpoint already restored the exact cache columns),
+        re-derive the draft prefix (speculation), and re-activate the
+        lane with crediting continuing AFTER the checkpoint
+        (already-delivered stream spans are never re-sent;
         first_pending False keeps the insert's token — emitted[-1] —
         from being credited twice)."""
         n = len(req.tokens)
-        self._replay_emitted(slot, n, emitted[:-1])
+        if replay:
+            self._replay_emitted(slot, n, emitted[:-1])
         if self._spec_active():
             self._draft_admit_tokens(slot, req.tokens + emitted[:-1])
         s = _Slot(request=req)
@@ -3587,10 +3958,67 @@ class ContinuousBatcher:
             self.flight.record({
                 "type": "preempt_resume", "lane": slot,
                 "prompt_tokens": n,
-                "replayed_tokens": max(0, len(emitted) - 1),
+                "replayed_tokens": max(0, len(emitted) - 1) if replay else 0,
+                "copyback": not replay,
                 "emitted": len(emitted),
                 "cache_hit_tokens": req.cache_hit_tokens,
             })
+
+    @scheduler_only
+    def _resume_from_tier(self, slot: int, req: GenRequest,
+                          emitted: List[int], first_tok, lane_key,
+                          end_pos: int, tier_key) -> bool:
+        """Copy-back resume: take the lane's tier checkpoint (one-shot),
+        ``device_put`` the stored cache columns, and insert them with
+        the checkpointed continuation registers. True when the lane is
+        live again; False sends the caller down the recompute+replay
+        fallback (entry evicted, stale version, or corrupt — the tier
+        already dropped a corrupt entry, typed, before any lane state
+        was touched)."""
+        import jax.numpy as jnp
+
+        from ..tracing import device_trace
+        from .disagg import DisaggError, prompt_hash
+
+        try:
+            ent = self._kv_tier.take_ckpt(tier_key, self.weight_version)
+        except DisaggError as e:
+            logger.warning("kv tier checkpoint dropped: %s", e)
+            return False
+        if ent is None:
+            return False
+        meta, host = ent
+        if int(meta.get("pos", -1)) != end_pos:
+            # a drifted checkpoint must never splice: the registers and
+            # the cache would disagree on where the lane is
+            logger.warning(
+                "kv tier checkpoint position %s != lane end %d — replaying",
+                meta.get("pos"), end_pos,
+            )
+            return False
+        slab_dev = {"k": jnp.asarray(host["k"]), "v": jnp.asarray(host["v"])}
+        with device_trace("gen.lane_insert"):
+            self._cache, self._cur_tok, self._pos, self._keys = (
+                self._insert_fn(
+                    self._cache, slab_dev, slot, first_tok, end_pos,
+                    lane_key, self._cur_tok, self._pos, self._keys,
+                )
+            )
+        self.stats["kv_tier_promotions"] += 1
+        if self.flight is not None and self.flight.enabled:
+            self.flight.record({
+                "type": "tier_hit", "kind": "ckpt", "source": "local",
+                "lane": slot, "tokens": end_pos,
+                "phash": prompt_hash(req.tokens)[:8],
+            })
+            self.flight.record({
+                "type": "kv_promote", "kind": "ckpt", "source": "local",
+                "lane": slot, "tokens": end_pos,
+                "bytes": int(host["k"].nbytes) + int(host["v"].nbytes),
+                "phash": prompt_hash(req.tokens)[:8],
+            })
+        self._activate_resumed(slot, req, emitted, replay=False)
+        return True
 
     @scheduler_only
     def _admit_resume(self, slot: int, req: GenRequest) -> None:
@@ -3617,6 +4045,33 @@ class ContinuousBatcher:
         first_tok = jnp.int32(int(emitted[-1]))
         lane_key = jnp.asarray(np.asarray(ck["key"], np.uint32))
         t_admit = time.monotonic()
+        # the tier key is POPPED here whatever happens next: take_ckpt
+        # is one-shot, so a later re-preemption must re-checkpoint under
+        # a fresh key — a stale key left behind would make the next
+        # resume count a phantom replay fallback
+        tier_key = (
+            ck.pop("tier", None) if self._kv_tier is not None else None
+        )
+        if tier_key is not None:
+            # copy-back fast path: the preemption spilled this lane's
+            # exact cache columns to the host tier — device_put them
+            # back through the ordinary insert executable (cur_tok/pos/
+            # key restored to the checkpointed registers) and skip BOTH
+            # the prompt recompute and the teacher-forced replay. The
+            # restored bytes are the bytes the lane held, so decode from
+            # here is the identical computation either way.
+            if self._resume_from_tier(slot, req, emitted, first_tok,
+                                      lane_key, end_pos, tier_key):
+                self._emit_span(
+                    req, "gen.resume", t_admit, time.monotonic(),
+                    tags={"lane": slot, "emitted": len(emitted),
+                          "copyback": True},
+                )
+                return
+            # the tier evicted/refused/corrupted the entry: recompute +
+            # replay below is the documented fallback — count it so the
+            # "spill, don't destroy" win stays measurable
+            self.stats["kv_tier_replay_fallbacks"] += 1
         hit = self._prefix_match(req)
         C = self.prefill_chunk
         if C and (
@@ -4255,6 +4710,9 @@ class ContinuousBatcher:
                             break
                     if req.future.cancelled():
                         self.stats["cancelled"] += 1
+                        # a preempted-then-cancelled request must not
+                        # leave its K/V checkpoint pinning tier budget
+                        self._release_tier_ckpt(req)
                         continue  # caller gave up while queued
                     if self._pressure.budget_bytes > 0:
                         # watermark-aware admission: if this request's
@@ -4290,6 +4748,7 @@ class ContinuousBatcher:
                                 self._admit_resume(slot, req)
                             except Exception as e:  # noqa: BLE001 - bad state
                                 logger.exception("preemption resume failed")
+                                self._release_tier_ckpt(req)
                                 if not req.future.done():
                                     req.future.set_exception(e)
                             continue

@@ -97,6 +97,18 @@ class PrefixGone(DisaggError):
     was evicted before the admit; the caller re-requests a full slab."""
 
 
+class TierMiss(DisaggError):
+    """A peer prefix-lookup found nothing usable in the listener's host
+    KV tier (no entry, below the promote gate, caps, or a
+    weight-version gap). Peer-SPECIFIC state, not peer death: the
+    failover layer rotates the lookup to another peer's tier WITHOUT
+    ejecting (the prefix may be warm one member over), and when every
+    consulted tier misses the decode side simply prefills as usual — a
+    cold tier must never look like a dead pool."""
+
+    status = 404
+
+
 class PeerBusy(DisaggError):
     """The prefill peer shed the transfer at its capacity bound — busy,
     not dead. The failover layer tries another peer WITHOUT ejecting
@@ -178,6 +190,7 @@ def decode_slab(
         cls = {
             "weight_version": WeightVersionMismatch,
             "capacity": PeerBusy,
+            "tier_miss": TierMiss,
         }.get(err.get("kind"), DisaggError)
         raise cls(err.get("error", "prefill peer error"))
     if magic != MAGIC:
@@ -231,6 +244,8 @@ def encode_error(err: Exception, kind: Optional[str] = None) -> bytes:
             kind = "weight_version"
         elif isinstance(err, PeerBusy):
             kind = "capacity"
+        elif isinstance(err, TierMiss):
+            kind = "tier_miss"
         else:
             kind = "error"
     body = json.dumps({"error": str(err), "kind": kind}).encode()
@@ -704,9 +719,12 @@ class FailoverKVClient:
     ) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
         tried: list = []
         busy_err: Optional[Exception] = None
+        miss_err: Optional[Exception] = None
         while len(tried) < 2:
             peer = self._pick(exclude=tried)
             if peer is None:
+                if miss_err is not None:
+                    raise miss_err  # consulted tiers all missed
                 if busy_err is not None:
                     raise busy_err  # every peer busy != every peer dead
                 raise AllPeersDown(
@@ -717,6 +735,13 @@ class FailoverKVClient:
                 out = peer.transport.prefill(request, deadline_s=deadline_s)
             except (WeightVersionMismatch, PrefixGone):
                 raise  # about the request/version, not the peer
+            except TierMiss as e:
+                # peer-SPECIFIC state (that member's tier is cold), not
+                # request state: rotate to another peer's tier WITHOUT
+                # ejecting — the prefix may well be warm one peer over
+                miss_err = e
+                tried.append(peer)
+                continue
             except PeerBusy as e:
                 busy_err = e
                 tried.append(peer)
@@ -734,6 +759,8 @@ class FailoverKVClient:
         # two peers failed the SAME transfer: surface a typed error (the
         # unary caller maps it; the decode server may still fall back
         # locally when the pool then fully ejects)
+        if miss_err is not None:
+            raise miss_err  # both consulted tiers missed: a miss, typed
         if busy_err is not None:
             raise busy_err  # capacity, not death: 503-retry semantics
         if self.healthy_count() == 0:

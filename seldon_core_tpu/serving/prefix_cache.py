@@ -119,6 +119,16 @@ class RadixPrefixIndex:
             stack.extend(n.children.values())
         return out
 
+    def _node_tokens(self, node: _Node) -> Tuple[int, ...]:
+        """Full token path of ``node`` (root -> node edge concat): the
+        identity an evicted slab carries into the host KV tier."""
+        parts: List[Tuple[int, ...]] = []
+        cur: Optional[_Node] = node
+        while cur is not None and cur is not self.root:
+            parts.append(cur.edge)
+            cur = cur.parent
+        return tuple(t for edge in reversed(parts) for t in edge)
+
     def _prune(self, node: _Node) -> None:
         """Remove slab-less leaves up the ancestry (never the root)."""
         while (
@@ -246,13 +256,18 @@ class RadixPrefixIndex:
     def _evict_to_budget(self) -> int:
         return self._evict_down_locked(self.budget_bytes)
 
-    def _evict_down_locked(self, target_bytes: int) -> int:
+    def _evict_down_locked(self, target_bytes: int, collect=None) -> int:
         evicted = 0
         while self.total_bytes > target_bytes:
             nodes = self._slab_nodes()
             if not nodes:
                 break
             victim = min(nodes, key=lambda n: n.last_used)
+            if collect is not None:
+                collect.append((
+                    self._node_tokens(victim), victim.slab,
+                    victim.slab_bytes,
+                ))
             self.total_bytes -= victim.slab_bytes
             victim.slab = None
             victim.slab_bytes = 0
@@ -261,15 +276,38 @@ class RadixPrefixIndex:
             self._prune(victim)
         return evicted
 
-    def evict_to(self, target_bytes: int) -> int:
+    def evict_to(self, target_bytes: int, collect=None) -> int:
         """LRU-evict slabs until ``total_bytes <= target_bytes`` (the
         pressure ladder's first rung: the batcher demotes the cache
         below its own budget to reclaim HBM for live lanes). Returns the
         number of slabs evicted. Eviction only drops the tree's
         reference — an admit that matched a slab moments earlier keeps
-        it alive exactly as long as the splice needs it."""
+        it alive exactly as long as the splice needs it. ``collect``
+        (a list) receives ``(tokens, slab, nbytes)`` per victim so the
+        caller can DEMOTE the slabs to the host KV tier instead of
+        losing them — the append happens under the lock; the (slow)
+        device pull belongs on the caller's side of it."""
         with self._lock:
-            return self._evict_down_locked(max(0, int(target_bytes)))
+            return self._evict_down_locked(max(0, int(target_bytes)), collect)
+
+    def remove(self, tokens) -> bool:
+        """Drop the slab stored at EXACTLY ``tokens`` (no prefix
+        semantics). Returns True when an entry was removed. The host KV
+        tier uses this to drop a corrupt entry by its recorded path."""
+        tokens = tuple(tokens)
+        with self._lock:
+            for node in self._slab_nodes():
+                if (
+                    node.slab_tokens == len(tokens)
+                    and self._node_tokens(node) == tokens
+                ):
+                    self.total_bytes -= node.slab_bytes
+                    node.slab = None
+                    node.slab_bytes = 0
+                    node.slab_tokens = 0
+                    self._prune(node)
+                    return True
+        return False
 
     def set_version(self, version) -> int:
         with self._lock:

@@ -95,6 +95,12 @@ class PressureController:
         self.low_frac = low
         self.used = 0
         self.components: Dict[str, int] = {}
+        # host KV tier occupancy (serving/kvtier.py): HOST RAM, not HBM
+        # — tracked for the summary/flight surface but deliberately
+        # OUTSIDE ``used`` and the watermark math (counting it would
+        # double-bill a demotion: the ledger's whole point is that
+        # demoted bytes stopped costing HBM)
+        self.host_bytes = 0
         self.active = False
         self.stats = {
             "updates": 0,
@@ -164,6 +170,9 @@ class PressureController:
             "low_bytes": self.low_bytes,
             "active": self.active,
             "components": dict(self.components),
+            # host KV tier bytes ride OUTSIDE ``components``: they are
+            # host RAM, not HBM — ``used_bytes`` must never count them
+            "host_tier_bytes": self.host_bytes,
             "activations": self.stats["activations"],
             "budget_changes": self.stats["budget_changes"],
         }

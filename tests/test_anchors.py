@@ -219,6 +219,26 @@ def test_bench_migration_scenario_anchor():
     assert "llm_1b_migration" in gen_src
 
 
+def test_bench_kvtier_scenario_anchor():
+    """The ``llm_1b_kvtier`` bench scenario is an acceptance artifact
+    (the spill-vs-destroy proof: tier-off resumes replay tokens, tier-on
+    resumes ride host-tier copy-back with the replay-fallback counter
+    quiet, greedy byte-identity both modes — all read from its entry):
+    it must stay wired through BOTH model tiers, and the numbers-table
+    generator must know its key."""
+    import seldon_core_tpu.modelbench as modelbench
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    mb_src = open(modelbench.__file__).read()
+    assert mb_src.count('results["llm_1b_kvtier"]') >= 2  # tiny + chip
+    assert hasattr(modelbench, "bench_kvtier")
+    # the entry asserts the acceptance bits like prior scenarios
+    assert '"greedy_identical": identical' in mb_src
+    assert '"copyback_exercised"' in mb_src
+    gen_src = open(os.path.join(root, "tools", "gen_arch_numbers.py")).read()
+    assert "llm_1b_kvtier" in gen_src
+
+
 def test_bench_pressure_scenario_anchor():
     """The ``llm_1b_pressure`` bench scenario is an acceptance artifact
     (byte-identity of greedy AND seeded-sampling outputs across a

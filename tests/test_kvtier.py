@@ -1,0 +1,667 @@
+"""Tiered KV memory (serving/kvtier.py): the host-RAM spill tier.
+
+The load-bearing contracts: (1) byte-identity — greedy AND
+seeded-sampling outputs are identical tier-on vs tier-off under
+pressure chaos, whether a resume rides the copy-back fast path or the
+recompute+replay fallback, and a tier-promoted warm hit equals a
+device-resident warm hit (warm-vs-warm: a warm splice vs a cold full
+prefill is NOT bitwise-guaranteed on toy models, so every identity
+comparison here pairs like with like); (2) a CRC-corrupt tier entry
+refuses typed BEFORE any lane state and is dropped, never re-served;
+(3) peer pulls ride the existing failover transport with
+PeerBusy/ejection semantics intact (a TierMiss never ejects).
+"""
+
+import io
+import json
+import time
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.models.llm import DecoderLM
+from seldon_core_tpu.resilience.faults import FaultInjector
+from seldon_core_tpu.serving.continuous import ContinuousBatcher, GenRequest
+from seldon_core_tpu.serving.kvtier import HostKVTier, TierEntryCorrupt
+
+CFG = dict(
+    vocab_size=256,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    d_ff=64,
+    max_seq=64,
+    dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = DecoderLM(**CFG)
+    return model, model.init_params(0)
+
+
+def make_batcher(model_and_params, **kw):
+    model, params = model_and_params
+    kw.setdefault("slots", 4)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("prefill_buckets", (8, 16, 32))
+    kw.setdefault("steps_per_poll", 2)
+    return ContinuousBatcher(model, params, **kw)
+
+
+PROMPTS = [[3, 17, 42, 99, 7], [1, 2, 3], [9, 8, 7, 6], [5, 5, 5, 5, 5, 5]]
+
+
+@pytest.fixture(scope="module")
+def references(model_and_params):
+    """Pressure-free, tier-free outputs: greedy and seeded, per prompt."""
+    b = make_batcher(model_and_params)
+    try:
+        greedy = [
+            b.generate(p, max_new_tokens=40, temperature=0.0)
+            for p in PROMPTS
+        ]
+        sampled = [
+            b.generate(p, max_new_tokens=30, temperature=0.8, seed=11 + i)
+            for i, p in enumerate(PROMPTS)
+        ]
+    finally:
+        b.close()
+    return {"greedy": greedy, "sampled": sampled}
+
+
+def arm_shrink(b, lanes=1.3, after=1, restore=12):
+    shrink = int(lanes * b._attn_need(b.max_seq) * b._kv_key_bytes)
+    inj = FaultInjector([], pressure={
+        "shrink_to_bytes": shrink,
+        "after_polls": b._work_poll_count + after,
+        "restore_after_polls": restore,
+    })
+    b.pressure_hook = inj.pressure_hook()
+
+
+def wait_lanes(b, n, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(b._active) + len(b._chunked) >= n:
+            return True
+        time.sleep(0.002)
+    return False
+
+
+def _slab(w=8):
+    return {
+        "k": np.arange(2 * 2 * w * 4, dtype=np.float32).reshape(2, 1, 2, w, 4),
+        "v": np.zeros((2, 1, 2, w, 4), np.float32),
+    }
+
+
+# -- HostKVTier unit ---------------------------------------------------------
+
+
+def test_tier_put_match_and_lru_budget():
+    tier = HostKVTier(1 << 20, min_tokens=4)
+    s = _slab()
+    toks = list(range(8))
+    assert tier.put_prefix(toks, s, 0)
+    depth, meta, got = tier.match_prefix(toks + [99], 0)
+    assert depth == 8 and meta["tokens"] == toks
+    assert (got["k"] == s["k"]).all() and (got["v"] == s["v"]).all()
+    # below the demote threshold: refused
+    assert not tier.put_prefix([1, 2], s, 0)
+    # an entry over half the budget: refused (thrash guard)
+    tiny = HostKVTier(100, min_tokens=1)
+    assert not tiny.put_prefix(toks, s, 0)
+    assert tiny.stats["refused"] >= 1
+    # LRU under budget pressure: oldest untouched entry evicts first
+    one_entry = len(tier._index.match(toks)[1][2])
+    lru = HostKVTier(int(one_entry * 2.5), min_tokens=4)
+    assert lru.put_prefix(list(range(100, 108)), s, 0)
+    assert lru.put_prefix(list(range(200, 208)), s, 0)
+    lru.match_prefix(list(range(100, 108)), 0)  # touch the first
+    assert lru.put_prefix(list(range(300, 308)), s, 0)
+    assert lru.stats["evictions"] >= 1
+    assert lru.match_prefix(list(range(200, 208)), 0) is None  # LRU victim
+    assert lru.match_prefix(list(range(100, 108)), 0) is not None
+
+
+def test_tier_ckpt_one_shot_and_eviction_policy():
+    tier = HostKVTier(1 << 20, min_tokens=4)
+    s = _slab()
+    assert tier.put_ckpt("a", {"pos": 9}, s, 0)
+    meta, got = tier.take_ckpt("a", 0)
+    assert meta["pos"] == 9 and (got["k"] == s["k"]).all()
+    assert tier.take_ckpt("a", 0) is None  # one-shot
+    # a stale-version take is a miss (resume falls back to replay)
+    assert tier.put_ckpt("b", {"pos": 3}, s, 0)
+    assert tier.take_ckpt("b", "v1") is None
+    # checkpoints evict prefix entries (pure cache) before other ckpts,
+    # and older ckpts before newer
+    one = len(HostKVTier._encode({"kind": "tier_ckpt", "pos": 0,
+                                  "weight_version": 0}, s))
+    small = HostKVTier(int(one * 2.5), min_tokens=4)
+    assert small.put_prefix(list(range(8)), s, 0)
+    assert small.put_ckpt("c1", {"pos": 1}, s, 0)
+    assert small.put_ckpt("c2", {"pos": 2}, s, 0)
+    # the prefix entry (pure cache) went first
+    assert small.match_prefix(list(range(8)), 0) is None
+    # a third checkpoint evicts the OLDEST checkpoint, never a newer one
+    assert small.put_ckpt("c3", {"pos": 3}, s, 0)
+    assert small.take_ckpt("c1", 0) is None
+    assert small.take_ckpt("c2", 0) is not None
+    assert small.take_ckpt("c3", 0) is not None
+
+
+def test_tier_corruption_refuses_typed_and_drops():
+    tier = HostKVTier(1 << 20, min_tokens=4)
+    s = _slab()
+    toks = list(range(8))
+    tier.put_prefix(toks, s, 0)
+    tag, etoks, payload = tier._index.match(toks)[1]
+    bad = bytearray(payload)
+    bad[len(bad) // 2] ^= 0xFF
+    tier._index.remove(etoks)
+    tier._index.insert(etoks, (tag, etoks, bytes(bad)), len(bad))
+    with pytest.raises(TierEntryCorrupt):
+        tier.match_prefix(toks, 0)
+    # dropped on the way out: never re-served
+    assert tier.match_prefix(toks, 0) is None
+    assert tier.stats["evictions"] >= 1
+    # same contract for checkpoints
+    tier.put_ckpt("k", {"pos": 5}, s, 0)
+    ent = tier._ckpts["k"]
+    raw = bytearray(ent.payload)
+    raw[len(raw) // 2] ^= 0xFF
+    ent.payload = bytes(raw)
+    with pytest.raises(TierEntryCorrupt):
+        tier.take_ckpt("k", 0)
+    assert tier.take_ckpt("k", 0) is None
+
+
+def test_tier_put_prefix_cannot_evict_itself_or_double_encode():
+    """Regressions from review: (1) a prefix slab larger than the space
+    prefixes may claim (budget minus checkpoint bytes) is REFUSED, not
+    inserted-then-self-evicted while counting a demotion; (2) a
+    re-publish of an already-covered path is a no-op that never pays
+    the SKV1 encode or counts a demotion."""
+    s = _slab()
+    one_ck = len(HostKVTier._encode({"kind": "tier_ckpt", "pos": 0,
+                                     "weight_version": 0}, s))
+    tier = HostKVTier(int(one_ck * 2.2), min_tokens=4)
+    assert tier.put_ckpt("a", {"pos": 1}, s, 0)
+    assert tier.put_ckpt("b", {"pos": 2}, s, 0)
+    # prefixes may claim ~0.2 of a slab's bytes now: refuse, count no
+    # demotion, and leave the checkpoints alone
+    d0 = tier.stats["demotions"]
+    assert not tier.put_prefix(list(range(8)), s, 0)
+    assert tier.stats["demotions"] == d0
+    assert tier.take_ckpt("a", 0) is not None
+    # no-op re-publish: covered path, no encode, no demotion count
+    big = HostKVTier(1 << 20, min_tokens=4)
+    assert big.put_prefix(list(range(12)), s, 0)
+    d1 = big.stats["demotions"]
+    assert not big.put_prefix(list(range(12)), s, 0)       # exact path
+    assert not big.put_prefix(list(range(8)), s, 0)        # covered sub-path
+    assert big.stats["demotions"] == d1
+
+
+def test_tier_drop_ckpt_releases_budget():
+    """A cancelled/migrated request's checkpoint is RELEASED (drop_ckpt)
+    so it stops pinning budget prefix demotions can never reclaim."""
+    s = _slab()
+    tier = HostKVTier(1 << 20, min_tokens=4)
+    assert tier.put_ckpt("dead", {"pos": 1}, s, 0)
+    used = tier.total_bytes
+    assert used > 0
+    assert tier.drop_ckpt("dead")
+    assert tier.total_bytes == 0
+    assert not tier.drop_ckpt("dead")  # idempotent
+    assert tier.stats["released"] == 1
+
+
+def test_cancelled_preempted_request_releases_tier_ckpt(model_and_params):
+    """Batcher-level regression: a preempted request whose future is
+    cancelled while on the resume queue drops its tier checkpoint at
+    the admission sweep instead of orphaning it."""
+    b = make_batcher(model_and_params, slots=2,
+                     host_kv_tier_bytes=1 << 22, kv_tier_min_tokens=2)
+    try:
+        prompt = PROMPTS[0]
+        want = b.generate(prompt, max_new_tokens=8)
+        s = _slab()
+        b._kv_tier.put_ckpt(7, {"pos": 3}, s, b.weight_version)
+        req = GenRequest(tokens=list(prompt), max_new_tokens=8,
+                         temperature=0.0)
+        req.submit_t = time.monotonic()
+        req.future.gen_request = req
+        req.resume = {"emitted": want[len(prompt):][:4], "key": [0, 0],
+                      "tier": 7}
+        req.future.cancel()
+        b._resume_queue.append(req)
+        b.start()
+        deadline = time.monotonic() + 30
+        while 7 in b._kv_tier._ckpts and time.monotonic() < deadline:
+            b.submit([1, 2], max_new_tokens=2).result(timeout=30)
+        assert 7 not in b._kv_tier._ckpts
+        assert b._kv_tier.stats["released"] >= 1
+    finally:
+        b.close()
+
+
+def test_tier_version_purge():
+    tier = HostKVTier(1 << 20, min_tokens=4)
+    s = _slab()
+    tier.put_prefix(list(range(8)), s, 0)
+    tier.put_ckpt("a", {"pos": 1}, s, 0)
+    assert tier.set_version("v1") == 2
+    assert tier.total_bytes == 0
+    assert tier.match_prefix(list(range(8)), "v1") is None
+    # puts under the OLD version are refused after the flip
+    assert not tier.put_prefix(list(range(8)), s, 0)
+
+
+# -- demote -> promote: warm-hit vs warm-hit identity ------------------------
+
+
+def test_demote_then_promote_warm_hit_identity(model_and_params):
+    """Reclaim rung 1 demotes the published prefix slab; the next
+    shared-prefix admission promotes it from the tier and the output
+    equals a DEVICE-resident warm hit (tier-off reference) exactly —
+    warm-vs-warm, the roundtrip is bitwise."""
+    sys_prompt = [7, 3, 9, 1, 4, 4, 2, 8]
+    p_seed, p_warm = sys_prompt + [10, 11], sys_prompt + [20, 21]
+    cache_kw = dict(slots=2, prefix_cache_hbm_bytes=1 << 20,
+                    prefix_cache_min_tokens=4)
+
+    ref = make_batcher(model_and_params, **cache_kw)
+    try:
+        ref.generate(p_seed, max_new_tokens=8)      # publishes the prompt
+        want = ref.generate(p_warm, max_new_tokens=12)  # device warm hit
+        assert ref.stats["prefix_hits"] == 1
+    finally:
+        ref.close()
+
+    b = make_batcher(model_and_params, hbm_ledger_bytes=1 << 30,
+                     host_kv_tier_bytes=1 << 22, kv_tier_min_tokens=4,
+                     **cache_kw)
+    try:
+        b.generate(p_seed, max_new_tokens=8)
+        assert b._prefix_index.total_bytes > 0
+        # shrink the ledger under one lane: rung 1 demotes the slab
+        f = b.submit([1, 2, 3], max_new_tokens=50)
+        b._pressure.set_budget(1024)
+        deadline = time.monotonic() + 60
+        while (b.stats["pressure_prefix_evictions"] == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+        b._pressure.restore_budget()
+        f.result(timeout=120)
+        assert b._prefix_index.total_bytes == 0
+        b.sync_kv_tier_stats()
+        assert b.stats["kv_tier_demotions"] >= 1
+        hits0 = b.stats["prefix_hits"]
+        got = b.generate(p_warm, max_new_tokens=12)
+        assert got == want
+        assert b.stats["prefix_hits"] == hits0 + 1  # served as a warm hit
+        assert b.stats["kv_tier_promotions"] >= 1
+        kinds = {e["type"] for e in b.flight.snapshot()}
+        assert {"kv_demote", "kv_promote", "tier_hit"} <= kinds
+        # the pressure summary carries the host component OUTSIDE the
+        # HBM ledger (never double-counted)
+        summary = b._pressure.summary()
+        assert "host_tier_bytes" in summary
+        assert "host_tier_bytes" not in summary["components"]
+        assert summary["used_bytes"] == sum(summary["components"].values())
+    finally:
+        b.close()
+
+
+# -- copy-back resume under pressure chaos -----------------------------------
+
+
+def test_copyback_resume_byte_identity(model_and_params, references):
+    """Preemption with the tier on resumes via host-tier copy-back
+    (kv_tier_hits > 0, replay-fallback counter quiet) and greedy AND
+    seeded outputs are byte-identical to the tier-off references."""
+    b = make_batcher(model_and_params, hbm_ledger_bytes=1 << 40,
+                     host_kv_tier_bytes=1 << 22, kv_tier_min_tokens=2)
+    try:
+        futs = [
+            b.submit(p, max_new_tokens=40, temperature=0.0) for p in PROMPTS
+        ]
+        assert wait_lanes(b, 2)
+        arm_shrink(b)
+        outs = [f.result(timeout=120) for f in futs]
+        assert outs == references["greedy"]
+        assert b.stats["preemptions"] >= 1
+        b.sync_kv_tier_stats()
+        assert b.stats["kv_tier_hits"] >= 1
+        assert b.stats["kv_tier_replay_fallbacks"] == 0
+        resumes = [
+            e for e in b.flight.snapshot() if e["type"] == "preempt_resume"
+        ]
+        assert resumes and all(r.get("copyback") for r in resumes)
+
+        futs = [
+            b.submit(p, max_new_tokens=30, temperature=0.8, seed=11 + i)
+            for i, p in enumerate(PROMPTS)
+        ]
+        assert wait_lanes(b, 2)
+        arm_shrink(b)
+        outs = [f.result(timeout=120) for f in futs]
+        assert outs == references["sampled"]
+        assert b.stats["kv_tier_replay_fallbacks"] == 0
+    finally:
+        b.close()
+
+
+def test_replay_fallback_when_tier_evicted(model_and_params):
+    """A resume whose tier checkpoint is gone (evicted) — or corrupt —
+    falls back to recompute + teacher-forced replay byte-identically,
+    and the fallback counter records it. Greedy lanes ignore the RNG
+    key, so crafted checkpoints exercise the exact resume paths."""
+    b = make_batcher(model_and_params, slots=2,
+                     host_kv_tier_bytes=1 << 22, kv_tier_min_tokens=2)
+    try:
+        prompt = PROMPTS[0]
+        want = b.generate(prompt, max_new_tokens=24)
+        generated = want[len(prompt):]
+
+        def resume_with(tier_key):
+            req = GenRequest(tokens=list(prompt), max_new_tokens=24,
+                             temperature=0.0)
+            req.submit_t = time.monotonic()
+            req.future.gen_request = req
+            req.resume = {"emitted": generated[:10], "key": [0, 0],
+                          "tier": tier_key}
+            b._resume_queue.append(req)
+            b.start()
+            return req.future.result(timeout=120)
+
+        # evicted: the key was never stored
+        fb0 = b.stats["kv_tier_replay_fallbacks"]
+        assert resume_with(991) == want
+        assert b.stats["kv_tier_replay_fallbacks"] == fb0 + 1
+        # corrupt: stored bytes fail their CRC -> typed drop -> replay
+        s = _slab()
+        b._kv_tier.put_ckpt(992, {"pos": len(prompt) + 9}, s,
+                            b.weight_version)
+        ent = b._kv_tier._ckpts[992]
+        raw = bytearray(ent.payload)
+        raw[len(raw) // 2] ^= 0xFF
+        ent.payload = bytes(raw)
+        assert resume_with(992) == want
+        assert b.stats["kv_tier_replay_fallbacks"] == fb0 + 2
+        # drifted position: refused before any lane state, replayed
+        b._kv_tier.put_ckpt(993, {"pos": 1}, s, b.weight_version)
+        assert resume_with(993) == want
+        assert b.stats["kv_tier_replay_fallbacks"] == fb0 + 3
+    finally:
+        b.close()
+
+
+# -- cluster-wide sharing: peer pull over loopback AND TCP -------------------
+
+
+def test_peer_tier_pull_loopback_and_tcp(model_and_params, tmp_path):
+    from seldon_core_tpu.serving.disagg import PrefillTransportServer
+    from seldon_core_tpu.servers.generateserver import GenerateServer
+
+    d = tmp_path / "llm"
+    d.mkdir()
+    (d / "jax_config.json").write_text(
+        json.dumps({"family": "llm", "config": CFG})
+    )
+    common = dict(model_uri=str(d), slots=2, steps_per_poll=2,
+                  prefix_cache_hbm_bytes=1 << 20,
+                  prefix_cache_min_tokens=8,
+                  host_kv_tier_bytes=1 << 22)
+    system = list(range(20, 32))
+    kw = dict(max_new_tokens=6, temperature=0.0, eos_id=None, seed=0)
+
+    unified = GenerateServer(**common)
+    unified.load()
+    prefill = GenerateServer(role="prefill", **common)
+    prefill.load()
+    listener = PrefillTransportServer(prefill, port=0)
+    dec_lo = GenerateServer(role="decode", **common)
+    dec_lo.load()
+    dec_lo.set_peer(prefill)
+    dec_tcp = GenerateServer(
+        role="decode", peer=f"127.0.0.1:{listener.port}", **common
+    )
+    dec_tcp.load()
+    try:
+        ref = unified.batcher.generate(system + [50, 51], **kw)
+        # seed the prefill member's tier: an export publishes its slab
+        # (already host-side) for peers
+        prefill.batcher.export_prefill(system + [40, 41],
+                                       max_new_tokens=6)
+        assert prefill.batcher.kv_tier_summary()["prefix_entries"] >= 1
+
+        for dec, transport in ((dec_lo, "loopback"), (dec_tcp, "tcp")):
+            fut = dec._remote_submit(system + [50, 51], dict(kw), None)
+            out = fut.result(timeout=60)
+            assert out == ref, transport
+            gr = fut.gen_request
+            # the shared system prefix came from the PEER's host tier:
+            # promoted locally, then the slab shipped suffix-only
+            assert gr.cache_hit_tokens >= 8, transport
+            assert dec.batcher.stats["kv_tier_promotions"] >= 1, transport
+            assert dec.batcher.stats["kv_transfer_bytes_saved"] > 0, transport
+        # the serving member counted the tier hits
+        prefill.batcher.sync_kv_tier_stats()
+        assert prefill.batcher.stats["kv_tier_hits"] >= 2
+        hits = [
+            e for e in prefill.batcher.flight.snapshot()
+            if e["type"] == "tier_hit" and e.get("source") == "peer"
+        ]
+        assert hits
+
+        # a prompt with NO shared prefix: TierMiss passes through the
+        # failover layer (no ejection) and the request still answers
+        probe = [99, 98, 97, 96, 95, 94, 93, 92, 91]
+        want = unified.batcher.generate(probe, **kw)
+        out = dec_tcp._remote_submit(probe, dict(kw), None).result(timeout=60)
+        assert out == want
+        assert dec_tcp.batcher.stats["peer_ejections"] == 0
+    finally:
+        listener.close()
+        for s in (unified, prefill, dec_lo, dec_tcp):
+            s.close()
+
+
+def test_failover_rotates_tier_miss_without_ejecting():
+    """Tier state is PER-MEMBER: a TierMiss rotates the lookup to the
+    next peer's tier (the prefix may be warm one member over) without
+    ejecting anyone; all-miss surfaces the typed TierMiss."""
+    from seldon_core_tpu.serving.disagg import FailoverKVClient, TierMiss
+
+    class Cold:
+        name = "cold"
+
+        def __init__(self, addr):
+            self.addr = addr
+
+        def prefill(self, request, deadline_s=None):
+            raise TierMiss(f"{self.addr} tier is cold")
+
+        def probe(self, timeout_s=2.0):
+            return True
+
+        def close(self):
+            pass
+
+    class Warm(Cold):
+        def prefill(self, request, deadline_s=None):
+            return {"tokens": [1, 2]}, {"k": "slab"}
+
+    fc = FailoverKVClient([Cold("a"), Warm("b")])
+    meta, _slab = fc.prefill({"prefix_lookup": True})
+    assert meta["tokens"] == [1, 2]
+    assert fc.healthy_count() == 2  # the miss ejected nobody
+    fc_all_cold = FailoverKVClient([Cold("a"), Cold("b")])
+    with pytest.raises(TierMiss):
+        fc_all_cold.prefill({"prefix_lookup": True})
+    assert fc_all_cold.healthy_count() == 2
+
+
+# -- controlplane plumbing ---------------------------------------------------
+
+
+def test_kv_tier_annotation_parse_and_injection():
+    from seldon_core_tpu.graph.spec import (
+        GraphSpecError,
+        PredictorSpec,
+        inject_kv_tier_param,
+        parse_kv_tier_annotation,
+        validate_predictor,
+    )
+
+    def spec(ann=None, params=None, impl="GENERATE_SERVER"):
+        return PredictorSpec.from_dict({
+            "name": "p",
+            "annotations": ann or {},
+            "graph": {
+                "name": "gen", "type": "MODEL", "implementation": impl,
+                "modelUri": "file:///m",
+                "parameters": params or [],
+            },
+        })
+
+    assert parse_kv_tier_annotation(spec()) is None
+    s = spec({"seldon.io/kv-tier-bytes": "1048576"})
+    assert parse_kv_tier_annotation(s) == 1 << 20
+    validate_predictor(s)  # strict at admission, and this one is legal
+    with pytest.raises(GraphSpecError):
+        parse_kv_tier_annotation(spec({"seldon.io/kv-tier-bytes": "lots"}))
+    with pytest.raises(GraphSpecError):
+        parse_kv_tier_annotation(spec({"seldon.io/kv-tier-bytes": "-1"}))
+    with pytest.raises(GraphSpecError):
+        parse_kv_tier_annotation(
+            spec({"seldon.io/kv-tier-bytes": "4096"}, impl="SKLEARN_SERVER")
+        )
+    # the annotation owns the parameter: both at once is a typo
+    with pytest.raises(GraphSpecError):
+        parse_kv_tier_annotation(spec(
+            {"seldon.io/kv-tier-bytes": "4096"},
+            params=[{"name": "host_kv_tier_bytes", "value": "1",
+                     "type": "STRING"}],
+        ))
+    # injection lands on the GENERATE_SERVER node
+    d = spec({"seldon.io/kv-tier-bytes": "4096"}).to_dict()
+    out = inject_kv_tier_param(d, 4096)
+    names = {p["name"]: p["value"] for p in out["graph"]["parameters"]}
+    assert names["host_kv_tier_bytes"] == "4096"
+
+
+def test_reconciler_injects_kv_tier_param():
+    from seldon_core_tpu.controlplane.reconciler import DeploymentController
+    from seldon_core_tpu.controlplane.resource import SeldonDeployment
+
+    rec = DeploymentController.__new__(DeploymentController)
+    rec._kv_ports = {}
+    rec.components = {}
+    dep = SeldonDeployment.from_dict({
+        "metadata": {"name": "d", "namespace": "ns"},
+        "spec": {"predictors": [{
+            "name": "p",
+            "annotations": {"seldon.io/kv-tier-bytes": "8192"},
+            "graph": {"name": "gen", "type": "MODEL",
+                      "implementation": "GENERATE_SERVER",
+                      "modelUri": "file:///m"},
+        }]},
+    })
+    import asyncio
+
+    specs = asyncio.run(rec.desired_components(dep))
+    engines = [s for s in specs if s.kind == "engine"]
+    assert engines
+    for es in engines:
+        params = {
+            p["name"]: p["value"]
+            for p in es.engine_spec["graph"].get("parameters") or []
+        }
+        assert params.get("host_kv_tier_bytes") == "8192"
+        # injected as a parameter: the annotation is stripped so member
+        # re-validation doesn't see two sources of truth
+        assert "seldon.io/kv-tier-bytes" not in (
+            es.engine_spec.get("annotations") or {}
+        )
+
+
+# -- observability -----------------------------------------------------------
+
+
+def test_flight_report_renders_tier_and_thrash_diagnosis():
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "flight_report", os.path.join(root, "tools", "flight_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    entries = []
+    for _ in range(3):
+        entries.append({"type": "kv_demote", "kind": "prefix",
+                        "phash": "aabbccdd", "tokens": 12, "bytes": 4096})
+        entries.append({"type": "kv_promote", "kind": "prefix",
+                        "source": "local", "phash": "aabbccdd",
+                        "tokens": 12, "bytes": 4096})
+    entries.append({"type": "kv_demote", "kind": "ckpt",
+                    "phash": "11223344", "tokens": 20, "bytes": 8192})
+    entries.append({"type": "tier_hit", "kind": "prefix", "source": "peer",
+                    "phash": "aabbccdd", "tokens": 12})
+    dump = {
+        "entries": entries, "recorded_total": len(entries), "dropped": 0,
+        "kv_tier": {"budget_bytes": 1 << 20, "used_bytes": 12288,
+                    "prefix_entries": 1, "ckpt_entries": 1, "evictions": 0},
+    }
+    text = mod.render(dump)
+    assert "kv tier demotions" in text
+    assert "kv tier promotions" in text
+    assert "served to peers" in text
+    assert "THRASH" in text
+    assert "pressure_high/pressure_low" in text
+    # a healthy spill (demote once, promote once) must NOT cry thrash
+    calm = {
+        "entries": [
+            {"type": "kv_demote", "kind": "prefix", "phash": "x", "bytes": 1},
+            {"type": "kv_promote", "kind": "prefix", "phash": "x",
+             "source": "local", "bytes": 1},
+        ],
+        "recorded_total": 2, "dropped": 0,
+    }
+    assert "THRASH" not in mod.render(calm)
+
+
+def test_kv_tier_metrics_map_to_first_class_series():
+    from seldon_core_tpu.graph.engine_metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.record_custom([
+        {"type": "COUNTER", "key": "gen_kv_tier_demotions", "value": 3},
+        {"type": "COUNTER", "key": "gen_kv_tier_promotions", "value": 2},
+        {"type": "COUNTER", "key": "gen_kv_tier_hits", "value": 2},
+        {"type": "COUNTER", "key": "gen_kv_tier_evictions", "value": 1},
+        {"type": "COUNTER", "key": "gen_kv_tier_replay_fallbacks",
+         "value": 0},
+        {"type": "GAUGE", "key": "gen_kv_tier_bytes", "value": 4096.0},
+    ], {"unit": "gen"})
+    expo = reg.expose()
+    for series in (
+        "seldon_engine_kv_tier_demotions",
+        "seldon_engine_kv_tier_promotions",
+        "seldon_engine_kv_tier_hits",
+        "seldon_engine_kv_tier_evictions",
+        "seldon_engine_kv_tier_replay_fallbacks",
+        "seldon_engine_kv_tier_bytes",
+    ):
+        assert series in expo, series
+    assert reg.counter_total(
+        "seldon_engine_kv_tier_demotions", {"unit": "gen"}
+    ) == 3.0

@@ -122,6 +122,18 @@ def test_model_tier_tiny_end_to_end():
     assert pr["preemption_exercised"] is True
     assert pr["preempt_resumes"] >= 1
     assert pr["ttft_bounded"] is True
+    # tiered KV memory: the same shrink with the host tier OFF must
+    # resume by replay (destroy: replayed tokens recorded) and with it
+    # ON by copy-back (spill: kv_tier hits, zero replay fallbacks,
+    # zero tokens replayed), greedy-identical both modes
+    kt = results["llm_1b_kvtier"]
+    assert kt["greedy_identical"] is True
+    assert kt["completed_all"] is True
+    assert kt["no_hang"] is True
+    assert kt["preemption_exercised"] is True
+    assert kt["copyback_exercised"] is True
+    assert kt["destroy_replayed_tokens"] > 0
+    assert kt["tier_on"]["kv_tier_demotions"] >= 1
     # live migration: draining a loaded member mid-decode must complete
     # every request byte-identically with zero client failures and no
     # stream span re-sent, the drain/migration counters must match the

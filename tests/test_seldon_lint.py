@@ -393,6 +393,56 @@ def test_host_sync_flags_item_and_block_until_ready(tmp_path):
     assert all("_helper" in f.message for f in res.findings)
 
 
+def test_host_sync_flags_unjustified_tier_demote_sync(tmp_path):
+    """The kv-tier must-flag twin: a scheduler-reachable device_get —
+    the shape of a tier demote — WITHOUT a justified suppression is
+    still a finding. The rule must keep catching unjustified syncs in
+    scheduler-reachable code even though the real demote path carries
+    suppressions."""
+    res = lint(tmp_path, JIT_PREAMBLE + """
+        def _loop(self):
+            self._demote(0)
+
+        def _demote(self, slot):
+            slab = self._burst_fn(self.params, self.cache, 8)
+            return jax.device_get(slab)  # unjustified sync
+    """, rules=["host-sync-hot-path"])
+    assert rules_of(res) == ["host-sync-hot-path"]
+    assert "_demote" in res.findings[0].message
+
+
+def test_host_sync_not_flagging_justified_tier_demote(tmp_path):
+    """The kv-tier must-not-flag twin: the demote/checkpoint pull IS a
+    designed poll-boundary sync — with the justification suppression it
+    is recorded as suppressed, not a finding (exactly how
+    continuous._demote_prefix_slabs / _checkpoint_kv_to_tier carry
+    theirs)."""
+    res = lint(tmp_path, JIT_PREAMBLE + """
+        def _loop(self):
+            self._demote(0)
+
+        def _demote(self, slot):
+            slab = self._burst_fn(self.params, self.cache, 8)
+            return jax.device_get(slab)  # seldon-lint: disable=host-sync-hot-path (tier demote: poll-boundary PCIe pull replaces a future re-prefill)
+    """, rules=["host-sync-hot-path"])
+    assert res.findings == []
+    assert len(res.suppressed) == 1
+
+
+def test_host_sync_repo_tier_paths_carry_suppressions():
+    """The real tier integration points in serving/continuous.py must
+    keep their justified suppressions (a refactor that drops one will
+    fail the CI lint gate; this pins the contract in the test suite
+    too)."""
+    src = open(os.path.join(
+        REPO, "seldon_core_tpu", "serving", "continuous.py"
+    )).read()
+    for method in ("_demote_prefix_slabs", "_checkpoint_kv_to_tier"):
+        body = src.split(f"def {method}")[1].split("\n    @")[0]
+        assert "jax.device_get" in body, method
+        assert "seldon-lint: disable=host-sync-hot-path" in body, method
+
+
 def test_host_sync_not_flagging_cold_paths_or_metadata(tmp_path):
     """Casts outside poll-reachable code, casts of untracked values, and
     metadata reads (.nbytes/.shape) off jitted results are all fine."""

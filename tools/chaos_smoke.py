@@ -241,6 +241,105 @@ def main() -> int:
                 prs_h.stop()
                 prs.close()
 
+            # -- tier leg: the SAME pressure grammar with the host KV
+            # tier on — the preempted lane must resume via host-tier
+            # COPY-BACK (kv_tier_hits > 0, the replay-fallback counter
+            # quiet) with greedy AND seeded output byte-identical to
+            # tier-off, a demoted prefix must promote back on resume of
+            # traffic, and the seldon_engine_kv_tier_* series must land
+            # in the exposition
+            tier_kw = {"max_new_tokens": 40, "temperature": 0.0}
+            seeded_kw = {"max_new_tokens": 30, "temperature": 0.8,
+                         "seed": 9}
+            tier_prompts = prompts[:3]
+            tier_refs = [
+                unified.batcher.generate(list(p), **tier_kw)
+                for p in tier_prompts
+            ]
+            seeded_refs = [
+                unified.batcher.generate(list(p), **seeded_kw)
+                for p in tier_prompts
+            ]
+            os.environ["SELDON_FAULTS"] = json.dumps({
+                "pressure": {"shrink_to_bytes": shrink_to,
+                             "after_polls": 4,
+                             "restore_after_polls": 24},
+            })
+            try:
+                tsv = GenerateServer(
+                    slots=2, hbm_ledger_bytes=1 << 40,
+                    host_kv_tier_bytes=64 << 20, kv_tier_min_tokens=2,
+                    prefix_cache_hbm_bytes=1 << 20,
+                    prefix_cache_min_tokens=4, **common,
+                )
+                tsv.load()
+            finally:
+                del os.environ["SELDON_FAULTS"]
+            tsv_h = EngineHarness(tsv, name="chaos-kvtier").start()
+            try:
+                futs = [
+                    tsv.batcher.submit(list(p), **tier_kw)
+                    for p in tier_prompts
+                ]
+                outs = [f.result(timeout=60) for f in futs]
+                tb = tsv.batcher
+                tb.sync_kv_tier_stats()
+                st = tb.stats
+                check("tier leg preempted a lane", st["preemptions"] >= 1,
+                      f"preemptions={st['preemptions']}")
+                check("tier copy-back resume exercised",
+                      st["kv_tier_hits"] >= 1,
+                      f"hits={st['kv_tier_hits']}")
+                check("tier replay-fallback counter quiet",
+                      st["kv_tier_replay_fallbacks"] == 0,
+                      f"fallbacks={st['kv_tier_replay_fallbacks']}")
+                check("tier greedy resume byte-identical",
+                      outs == tier_refs)
+                # seeded window: arm a second shrink through the hook
+                from seldon_core_tpu.resilience.faults import FaultInjector
+                inj = FaultInjector([], pressure={
+                    "shrink_to_bytes": shrink_to,
+                    "after_polls": tb._work_poll_count + 2,
+                    "restore_after_polls": 24,
+                })
+                tb.pressure_hook = inj.pressure_hook()
+                sfuts = [
+                    tb.submit(list(p), **seeded_kw) for p in tier_prompts
+                ]
+                souts = [f.result(timeout=60) for f in sfuts]
+                check("tier seeded resume byte-identical",
+                      souts == seeded_refs)
+                tb.sync_kv_tier_stats()
+                check("tier demotions recorded",
+                      st["kv_tier_demotions"] >= 1,
+                      f"demotions={st['kv_tier_demotions']}")
+                # one engine-served request flushes the gen_kv_tier_*
+                # deltas into the registry
+                short_ref2 = unified.batcher.generate(
+                    list(tier_prompts[0]), max_new_tokens=6,
+                    temperature=0.0)
+                got = greedy(tsv_h.http_port, tier_prompts[0])
+                check("tier engine path byte-identical",
+                      got["tokens"][0] == short_ref2)
+                expo = REGISTRY.expose()
+                for series in ("seldon_engine_kv_tier_demotions",
+                               "seldon_engine_kv_tier_promotions",
+                               "seldon_engine_kv_tier_hits",
+                               "seldon_engine_kv_tier_evictions",
+                               "seldon_engine_kv_tier_replay_fallbacks",
+                               "seldon_engine_kv_tier_bytes"):
+                    check(f"exposition has {series}", series in expo)
+                check("tier hit counter counts the copy-backs",
+                      REGISTRY.counter_total(
+                          "seldon_engine_kv_tier_hits", {}) >= 1)
+                check("tier replay-fallback series quiet",
+                      REGISTRY.counter_total(
+                          "seldon_engine_kv_tier_replay_fallbacks",
+                          {}) == 0)
+            finally:
+                tsv_h.stop()
+                tsv.close()
+
             # -- migration leg: graceful drain over TCP (POST /drain),
             # then a decode member killed MID-STREAM with the client
             # resuming on the peer from the span's SGC1 resume token —

@@ -137,6 +137,60 @@ def main() -> int:
                   ) > 0)
             _ = first  # first shared request seeds the radix cache
 
+            # -- tiered KV memory: peer prefix pull over TCP --------------
+            # a prefill member with the host tier on publishes every
+            # exported slab; a FRESH decode member (empty local radix)
+            # pulls the shared prefix from the PEER'S tier over TCP,
+            # promotes it locally, and ships the suffix only — the
+            # kv_transfer_bytes_saved accounting must stay correct
+            # (decode-side count, covered tokens priced per slab token)
+            tier_common = dict(common, host_kv_tier_bytes=64 << 20,
+                               kv_tier_min_tokens=8)
+            pf_tier = GenerateServer(role="prefill", **tier_common)
+            pf_tier.load()
+            tier_listener = PrefillTransportServer(pf_tier, port=0)
+            dec_tier = GenerateServer(
+                slots=2, role="decode",
+                peer=f"127.0.0.1:{tier_listener.port}", **tier_common,
+            )
+            dec_tier.load()
+            tier_h = EngineHarness(dec_tier, name="disagg-kvtier").start()
+            try:
+                shared = list(range(40, 52))  # 12-token shared prefix
+                ref_t = greedy(uni_h.http_port, shared + [60, 61])["tokens"][0]
+                # seed the PREFILL tier: one export publishes the slab
+                pf_tier.batcher.export_prefill(shared + [55, 56],
+                                               max_new_tokens=6)
+                check("prefill tier holds the exported prefix",
+                      pf_tier.batcher.kv_tier_summary()["prefix_entries"]
+                      >= 1)
+                saved0 = dec_tier.batcher.stats["kv_transfer_bytes_saved"]
+                out_t = greedy(tier_h.http_port, shared + [60, 61])
+                check("peer tier pull greedy identical",
+                      out_t["tokens"][0] == ref_t)
+                pulled = (out_t.get("cache_hit_tokens") or [0])[0]
+                check("peer tier pull covered the shared prefix",
+                      pulled >= 8, f"covered={pulled}")
+                check("decode member promoted the peer slab",
+                      dec_tier.batcher.stats["kv_tier_promotions"] >= 1)
+                saved = (dec_tier.batcher.stats["kv_transfer_bytes_saved"]
+                         - saved0)
+                want_saved = pulled * dec_tier.batcher._slab_token_bytes
+                check("bytes_saved accounting matches covered tokens",
+                      saved == want_saved,
+                      f"saved={saved} want={want_saved}")
+                pf_tier.batcher.sync_kv_tier_stats()
+                check("prefill tier counted the peer hit",
+                      pf_tier.batcher.stats["kv_tier_hits"] >= 1)
+                expo = REGISTRY.expose()
+                check("exposition has seldon_engine_kv_tier_promotions",
+                      "seldon_engine_kv_tier_promotions" in expo)
+            finally:
+                tier_h.stop()
+                tier_listener.close()
+                pf_tier.close()
+                dec_tier.close()
+
             # -- peer death mid-run: failover / local degradation ---------
             # kill the TCP listener, then keep issuing requests through
             # the decode engine: the dead peer is ejected (peer_ejected

@@ -217,6 +217,77 @@ def _pressure_lines(
     return lines
 
 
+def _tier_lines(
+    demotes: List[Dict[str, Any]],
+    promotes: List[Dict[str, Any]],
+    tier_hits: List[Dict[str, Any]],
+    tier: Dict[str, Any],
+) -> List[str]:
+    """Host-KV-tier records, shown inline with the scheduling story:
+    demotions (prefix slabs + lane checkpoints spilled to host RAM),
+    promotions back to device, and tier hits (local match, peer lookup
+    served, checkpoint copy-back) — plus a THRASH diagnosis when the
+    same slab keeps bouncing between HBM and the tier."""
+    lines: List[str] = []
+    if demotes:
+        prefixes = [d for d in demotes if d.get("kind") == "prefix"]
+        ckpts = [d for d in demotes if d.get("kind") == "ckpt"]
+        total = sum(d.get("bytes", 0) for d in demotes)
+        lines.append(
+            f"kv tier demotions: {len(prefixes)} prefix slab(s) + "
+            f"{len(ckpts)} lane checkpoint(s) spilled to host RAM "
+            f"({total / 1e6:.2f} MB)"
+        )
+    if promotes:
+        copybacks = [p for p in promotes if p.get("kind") == "ckpt"]
+        peer = [p for p in promotes if p.get("source") == "peer"]
+        lines.append(
+            f"kv tier promotions: {len(promotes)} slab(s) back to device "
+            f"({len(copybacks)} copy-back resume(s), {len(peer)} pulled "
+            "from a peer's tier)"
+        )
+    if tier_hits:
+        served_peers = [h for h in tier_hits if h.get("source") == "peer"]
+        if served_peers:
+            lines.append(
+                f"kv tier peer lookups: {len(served_peers)} prefix(es) "
+                "served to peers from this member's host tier"
+            )
+    # thrash: the SAME slab (by prompt-hash) demoted AND promoted
+    # repeatedly inside one ring window — each cycle pays a PCIe round
+    # trip that a wider watermark gap would have avoided
+    cycles: Dict[str, List[int]] = {}
+    for d in demotes:
+        if d.get("phash"):
+            cycles.setdefault(d["phash"], [0, 0])[0] += 1
+    for p in promotes:
+        if p.get("phash"):
+            cycles.setdefault(p["phash"], [0, 0])[1] += 1
+    thrashing = [
+        (ph, c) for ph, c in cycles.items() if c[0] >= 2 and c[1] >= 2
+    ]
+    if thrashing:
+        worst = max(thrashing, key=lambda t: min(t[1]))
+        lines.append(
+            f"DIAGNOSIS: kv tier THRASH — {len(thrashing)} slab(s) "
+            f"demoted→promoted repeatedly (worst {worst[0]}: "
+            f"{worst[1][0]} demotions / {worst[1][1]} promotions in one "
+            "ring window); the ledger is re-tripping its high watermark "
+            "right after reclaim — widen the pressure_high/pressure_low "
+            "gap (or raise hbm_ledger_bytes) so a promoted slab fits "
+            "inside it"
+        )
+    if tier:
+        lines.append(
+            f"kv tier: {tier.get('used_bytes', 0) / 1e6:.2f} of "
+            f"{tier.get('budget_bytes', 0) / 1e6:.2f} MB host RAM "
+            f"({tier.get('prefix_entries', 0)} prefix entries, "
+            f"{tier.get('ckpt_entries', 0)} checkpoint(s); "
+            f"{tier.get('evictions', 0)} eviction(s))"
+        )
+    return lines
+
+
 def _migration_lines(
     drains: List[Dict[str, Any]],
     exports: List[Dict[str, Any]],
@@ -287,6 +358,9 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
     ]
     kv_exports = [e for e in entries if e.get("type") == "kv_export"]
     kv_inserts = [e for e in entries if e.get("type") == "remote_insert"]
+    kv_demotes = [e for e in entries if e.get("type") == "kv_demote"]
+    kv_promotes = [e for e in entries if e.get("type") == "kv_promote"]
+    tier_hits = [e for e in entries if e.get("type") == "tier_hit"]
     restarts = [e for e in entries if e.get("type") == "batcher_restart"]
     ejects = [e for e in entries if e.get("type") == "peer_ejected"]
     readmits = [e for e in entries if e.get("type") == "peer_readmitted"]
@@ -345,6 +419,9 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
         # a prefill-role pool member never polls: its whole story is the
         # export stream
         lines.extend(_kv_lines(kv_exports, kv_inserts))
+        lines.extend(_tier_lines(
+            kv_demotes, kv_promotes, tier_hits, dump.get("kv_tier") or {}
+        ))
         lines.extend(_fault_lines(restarts, ejects, readmits, degraded))
         lines.extend(_pressure_lines(
             preempts, resumes, reclaims, budgets, dump.get("pressure") or {}
@@ -446,6 +523,11 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
 
     # -- disaggregated serving (KV-slab handoff) ------------------------------
     lines.extend(_kv_lines(kv_exports, kv_inserts))
+
+    # -- tiered KV memory (host-RAM spill tier) -------------------------------
+    lines.extend(_tier_lines(
+        kv_demotes, kv_promotes, tier_hits, dump.get("kv_tier") or {}
+    ))
 
     # -- fault tolerance (supervision, peer failover, degradation) -----------
     lines.extend(_fault_lines(restarts, ejects, readmits, degraded))

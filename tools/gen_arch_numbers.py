@@ -258,6 +258,20 @@ def rows_from(mt, fronts):
                else "")
             + ("; no hangs" if gp.get("no_hang") else ""),
         ))
+    gk = mt.get("llm_1b_kvtier") or {}
+    if gk:
+        on = gk.get("tier_on") or {}
+        rows.append((
+            "generate(), tiered KV memory (host spill tier)",
+            f"{fmt(on.get('kv_tier_hits'))} copy-back resume(s) vs "
+            f"{fmt(gk.get('destroy_replayed_tokens'))} tokens replayed "
+            "tier-off",
+            "same ledger shrink, tier off vs on"
+            + ("; greedy bytes identical both modes"
+               if gk.get("greedy_identical") else "")
+            + ("; replay fallbacks quiet"
+               if gk.get("copyback_exercised") else ""),
+        ))
     gm = mt.get("llm_1b_migration") or {}
     if gm:
         rows.append((
