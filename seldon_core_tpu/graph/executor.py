@@ -106,9 +106,16 @@ class UnitRuntime:
         return self.unit.implementation or self.unit.model_uri or self.name
 
 
-def _branch_index(route_response: Dict[str, Any]) -> int:
-    """Decode branch from the router's response tensor
-    (reference: getBranchIndex PredictiveUnitBean.java:301-312)."""
+def _branch_index(route_response: Dict[str, Any], n_children: int,
+                  unit: str = "?") -> int:
+    """Decode + validate the branch from the router's response tensor
+    (reference: getBranchIndex PredictiveUnitBean.java:301-312).
+
+    A malformed route response — non-numeric, a non-integral float
+    (``int()`` used to TRUNCATE 0.7 to branch 0 silently), or a branch
+    outside ``[-1, n_children)`` — is a typed 400: the route decision is
+    request-shaped garbage, and retrying the identical request cannot
+    pick a valid child. ``-1`` stays the broadcast branch."""
     data = route_response.get("data") or {}
     if "ndarray" in data:
         v = np.asarray(data["ndarray"]).ravel()
@@ -118,7 +125,22 @@ def _branch_index(route_response: Dict[str, Any]) -> int:
         raise UnitCallError(500, "router response has no tensor/ndarray data")
     if v.size == 0:
         raise UnitCallError(500, "router returned empty branch tensor")
-    return int(v[0])
+    try:
+        raw = float(v[0])
+    except (TypeError, ValueError):
+        raise UnitCallError(
+            400, f"router {unit} returned non-numeric branch {v[0]!r}"
+        ) from None
+    if not raw.is_integer():
+        raise UnitCallError(
+            400, f"router {unit} returned non-integer branch {raw!r}"
+        )
+    branch = int(raw)
+    if branch >= n_children or branch < -1:
+        raise UnitCallError(
+            400, f"router {unit} chose branch {branch} of {n_children}"
+        )
+    return branch
 
 
 def _ann_seconds(ann: Dict[str, str], key: str, default_s: float) -> float:
@@ -185,6 +207,24 @@ class GraphExecutor:
             max_workers=int(inprocess_workers), thread_name_prefix="unit-call"
         )
         self.root = self._build(spec.graph)
+        # graph fusion (opt-in via seldon.io/fuse): chains of mesh-co-
+        # resident jitted units compile into ONE XLA executable so
+        # activations never leave HBM between stages; any per-unit
+        # semantics condition falls back to this hop-by-hop walk
+        # (fusion.py module docstring has the full contract). The hook
+        # below is set by whoever wires a rollout shadow mirror (the
+        # engine app): divergence analysis keeps the per-unit path.
+        self.shadow_active_fn = None
+        self.fusion = None
+        from .spec import parse_fuse_annotation
+
+        # the ONE strict parser (admission uses the same): a typo'd
+        # value must fail construction here too, never silently serve
+        # hop-by-hop while the operator believes fusion is on
+        if parse_fuse_annotation(spec):
+            from .fusion import FusionPlan
+
+            self.fusion = FusionPlan(self)
 
     # -- construction -------------------------------------------------------
 
@@ -368,6 +408,26 @@ class GraphExecutor:
         return response
 
     async def _get_output(self, rt: UnitRuntime, message: Dict[str, Any], ctx: RequestCtx):
+        if self.fusion is not None:
+            seg = self.fusion.segment_at(rt.name)
+            if seg is not None:
+                reason = seg.blocked(self, ctx, message)
+                if reason is None:
+                    try:
+                        out = await seg.run(self, message, ctx)
+                    except Exception as e:  # noqa: BLE001 - counted fallback
+                        # per-unit attribution of the failure comes from
+                        # re-running hop-by-hop (stages are pure jitted
+                        # functions — re-execution is side-effect free)
+                        seg.note_fallback("error", detail=str(e))
+                    else:
+                        if seg.continue_at is not None:
+                            return await self._get_output(
+                                seg.continue_at, out, ctx
+                            )
+                        return out
+                else:
+                    seg.note_fallback(reason)
         ctx.request_path[rt.name] = rt.identity
 
         # 1. input transform
@@ -380,11 +440,7 @@ class GraphExecutor:
         if rt.children:
             if rt.type == UnitType.ROUTER:
                 route_resp = await self._call(rt, "route", message, ctx)
-                branch = _branch_index(route_resp)
-                if branch >= len(rt.children) or branch < -1:
-                    raise UnitCallError(
-                        500, f"router {rt.name} chose branch {branch} of {len(rt.children)}"
-                    )
+                branch = _branch_index(route_resp, len(rt.children), rt.name)
                 ctx.routing[rt.name] = branch
                 selected = rt.children if branch == -1 else [rt.children[branch]]
             else:

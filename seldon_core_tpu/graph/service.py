@@ -143,6 +143,11 @@ class EngineApp:
         # responses diffed. None (the default) is a single attribute check
         # on the hot path — byte-identical behavior without a rollout.
         self.shadow_mirror = None
+        # graph fusion observes the mirror: while a shadow rollout is
+        # live, fused segments fall back to the per-unit walk so a
+        # divergence verdict can never implicate the fusion compiler
+        # (fusion.py's "shadow" fallback reason)
+        self.executor.shadow_active_fn = lambda: self.shadow_mirror is not None
 
     def _inflight_add(self, n: int) -> None:
         with self._inflight_lock:
@@ -521,6 +526,11 @@ class EngineApp:
                 dump = dump_fn(limit)
                 if dump is not None:
                     units[rt.name] = dump
+            # graph-fusion dispatch/fallback records live at the
+            # EXECUTOR, not on a unit — surface them under a reserved
+            # pseudo-unit key so flight_report reads one payload
+            if self.executor.fusion is not None:
+                units["(fusion)"] = self.executor.fusion.dump(limit)
             if not units:
                 return Response(
                     error_body(404, "no unit exposes a flight recorder"), 404

@@ -231,6 +231,8 @@ def default_predictor(spec: PredictorSpec, separate_pods: bool = False) -> Predi
                 unit.type = UnitType.ROUTER
             elif impl == "AVERAGE_COMBINER":
                 unit.type = UnitType.COMBINER
+            elif impl == "RAG_PROMPT_BUILDER":
+                unit.type = UnitType.TRANSFORMER
             else:
                 unit.type = UnitType.MODEL
         if unit.endpoint.service_port == 0:
@@ -320,6 +322,29 @@ def parse_disagg_annotations(spec: PredictorSpec) -> "Optional[tuple]":
             f"each, got prefill={prefill} decode={decode}"
         )
     return prefill, decode
+
+
+# graph fusion (docs/graphs.md "Graph fusion"): opt-in flag compiling
+# chains of co-resident jitted units into single XLA executables
+ANNOTATION_FUSE = "seldon.io/fuse"
+
+
+def parse_fuse_annotation(spec: PredictorSpec) -> bool:
+    """Strict-at-apply parse of ``seldon.io/fuse``: only "true"/"false"
+    (any case) are meaningful — a typo'd value means the operator
+    believes fusion is on, so it fails the apply instead of silently
+    serving hop-by-hop."""
+    ann = spec.annotations or {}
+    raw = ann.get(ANNOTATION_FUSE)
+    if raw is None:
+        return False
+    val = str(raw).strip().lower()
+    if val not in ("true", "false"):
+        raise GraphSpecError(
+            f"predictor {spec.name!r}: {ANNOTATION_FUSE} must be "
+            f'"true" or "false", got {raw!r}'
+        )
+    return val == "true"
 
 
 # tiered KV memory (docs/generate.md "Tiered KV memory"): byte budget
@@ -421,6 +446,9 @@ def validate_predictor(spec: PredictorSpec) -> None:
     # kv-tier annotation: same strict-at-apply policy (a typo'd budget
     # or a tier on a non-generate graph fails the apply)
     parse_kv_tier_annotation(spec)
+    # fuse annotation: strict-at-apply (a typo'd value must not silently
+    # serve hop-by-hop while the operator believes fusion is on)
+    parse_fuse_annotation(spec)
 
 
 def validate_deployment(predictors: List[PredictorSpec]) -> None:

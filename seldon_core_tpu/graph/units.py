@@ -56,6 +56,19 @@ class AverageCombinerUnit(SeldonComponent):
             raise ValueError(f"combiner inputs disagree on shape: {sorted(shapes)}")
         return np.mean(arrays, axis=0)
 
+    def fused_aggregate(self, Ys: List):
+        """Pure-jax mean for the graph-fusion compiler (graph/fusion.py):
+        lets a COMBINER fan-in whose children are in-process jittable
+        models compile into one executable. Computes in float32 on
+        device where the host path computes float64 — bit-identity with
+        hop-by-hop therefore holds only when the mean is exact at f32
+        (identical children, or values whose sum is f32-representable);
+        docs/graphs.md "Graph fusion" documents the caveat."""
+        import jax.numpy as jnp
+
+        stacked = jnp.stack([y.astype(jnp.float32) for y in Ys])
+        return jnp.mean(stacked, axis=0)
+
 
 class RandomABTestUnit(SeldonComponent):
     """Seeded 50/50 (configurable ratio) A/B split.
@@ -72,9 +85,42 @@ class RandomABTestUnit(SeldonComponent):
         return 0 if self._rng.random() < self.ratio_a else 1
 
 
+class RagPromptBuilder(SeldonComponent):
+    """Bridge from a retrieval tail to a GENERATE_SERVER unit: takes the
+    reranker's winning doc-token tensor ``[B, L]`` (models/retrieval.py)
+    and emits the generate request body the LLM unit consumes. Host-side
+    by design — it sits between the fused retrieval segment and the
+    generate scheduler, so it is deliberately NOT fusable (the generate
+    unit is a batching scheduler, not a jitted stage)."""
+
+    def __init__(self, max_new_tokens=16, temperature=0.0, seed=0,
+                 eos_id=None):
+        # graph parameters arrive as strings
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        self.eos_id = int(eos_id) if eos_id not in (None, "", "none") else None
+
+    def transform_input(self, X, names, meta=None):
+        toks = np.asarray(X)
+        if toks.ndim != 2:
+            raise ValueError(
+                f"RAG prompt builder expects [batch, doc_len] token rows, "
+                f"got shape {toks.shape}"
+            )
+        return {
+            "prompt_tokens": [[int(t) for t in row] for row in toks],
+            "max_new_tokens": self.max_new_tokens,
+            "temperature": self.temperature,
+            "seed": self.seed,
+            "eos_id": self.eos_id,
+        }
+
+
 BUILTIN_IMPLEMENTATIONS = {
     "SIMPLE_MODEL": SimpleModelUnit,
     "SIMPLE_ROUTER": SimpleRouterUnit,
     "AVERAGE_COMBINER": AverageCombinerUnit,
     "RANDOM_ABTEST": RandomABTestUnit,
+    "RAG_PROMPT_BUILDER": RagPromptBuilder,
 }

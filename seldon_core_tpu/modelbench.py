@@ -84,17 +84,22 @@ class EngineHarness:
 
     def __init__(
         self,
-        component,
+        component=None,
         unit_name: str = "model",
         name: str = "bench",
         batching: Optional[Dict[str, Any]] = None,
         annotations: Optional[Dict[str, str]] = None,
         faults=None,
+        graph: Optional[Dict[str, Any]] = None,
+        registry: Optional[Dict[str, Any]] = None,
+        metrics=None,
     ):
         # ``batching`` is ONE unit's MicroBatcher kwargs (max_batch/
         # timeout_ms/...); it is wrapped as {unit_name: batching} for
         # EngineApp, which takes the per-unit mapping form. ``faults`` is
         # a resilience.FaultInjector for degraded-mode scenarios.
+        # ``graph``/``registry`` serve multi-unit graphs (the RAG/fusion
+        # smoke); the default stays the single in-process MODEL node.
         from .graph.service import EngineApp
         from .graph.spec import PredictorSpec, default_predictor
 
@@ -102,16 +107,20 @@ class EngineHarness:
             PredictorSpec.from_dict(
                 {
                     "name": name,
-                    "graph": {"name": unit_name, "type": "MODEL"},
+                    "graph": graph or {"name": unit_name, "type": "MODEL"},
                     **({"annotations": annotations} if annotations else {}),
                 }
             )
         )
         self.app = EngineApp(
             spec,
-            registry={unit_name: component},
+            registry=registry if registry is not None else {unit_name: component},
             batching={unit_name: batching} if batching else None,
             faults=faults,
+            # side-by-side engines (the fusion smoke's fused vs plain vs
+            # chaos trio) need isolated registries or one engine's
+            # counters leak into another's /metrics assertions
+            **({"metrics": metrics} if metrics is not None else {}),
         )
         self.http_port = free_port()
         self.grpc_port = free_port()
@@ -2562,6 +2571,251 @@ def bench_kvtier(
     }
 
 
+def bench_rag(
+    root: str,
+    n_requests: int = 24,
+    query_len: int = 8,
+    doc_len: int = 8,
+    max_new_tokens: int = 12,
+    d_embed: int = 16,
+    corpus_size: int = 64,
+    top_k: int = 4,
+    slots: int = 2,
+    steps_per_poll: int = 1,
+    bert_config: Optional[Dict[str, Any]] = None,
+    llm_config: Optional[Dict[str, Any]] = None,
+    fused_slowdown_budget: float = 1.10,
+    label: str = "llm-rag",
+) -> Dict[str, Any]:
+    """The RAG workload + graph-fusion proof (docs/graphs.md "Graph
+    fusion"): an embed -> retrieve -> rerank -> generate graph served
+    fused vs hop-by-hop in ONE entry.
+
+    Three windows over the SAME loaded components (identical weights by
+    construction): (1) hop-by-hop reference, (2) fused — the retrieval
+    chain compiled into one XLA executable (``seldon.io/fuse``), greedy
+    output byte-identical and the interleaved per-request p50 no slower
+    than hop-by-hop, with the trace spans proving 3 stages -> 1 device
+    dispatch (one ``gen.fused_segment`` span, zero per-stage spans),
+    and (3) a chaos leg — a fault injector targeting the interior
+    rerank unit forces a COUNTED fallback to the per-unit path
+    (``seldon_engine_fusion_fallbacks{reason="faults"}``) with output
+    still identical to the reference."""
+    import asyncio
+
+    from . import tracing
+    from .graph.engine_metrics import MetricsRegistry
+    from .graph.executor import GraphExecutor
+    from .graph.spec import PredictorSpec, default_predictor
+    from .graph.units import RagPromptBuilder
+    from .resilience.faults import FaultInjector
+    from .servers.generateserver import GenerateServer
+    from .servers.jaxserver import JAXServer
+
+    vocab = (llm_config or {}).get("vocab_size", 256)
+    bert_cfg = dict(bert_config or {
+        "vocab_size": vocab, "d_model": 32, "n_layers": 2, "n_heads": 2,
+        "d_ff": 64, "max_seq": 64,
+    })
+    bert_cfg["num_classes"] = d_embed
+    bert_cfg.setdefault("vocab_size", vocab)
+    ret_cfg = {
+        "corpus_size": corpus_size, "d_embed": d_embed, "top_k": top_k,
+        "doc_len": doc_len, "vocab_size": vocab, "seed": 7,
+    }
+    llm_cfg = dict(llm_config or {
+        "vocab_size": vocab, "d_model": 32, "n_layers": 2, "n_heads": 2,
+        "n_kv_heads": 2, "d_ff": 64, "max_seq": 64,
+    })
+    embed = JAXServer(model_uri=write_model_dir(root, "bert", bert_cfg))
+    embed.load()
+    retrieve = JAXServer(
+        model_uri=write_model_dir(root, "retrieval", ret_cfg)
+    )
+    retrieve.load()
+    rerank = JAXServer(model_uri=write_model_dir(root, "reranker", ret_cfg))
+    rerank.load()
+    gen = GenerateServer(
+        model_uri=write_model_dir(root, "llm", llm_cfg), slots=slots,
+        steps_per_poll=steps_per_poll, warmup_prompt_lens=[doc_len],
+        warmup_max_new_tokens=max_new_tokens,
+    )
+    gen.load()
+    registry = {
+        "embed": embed, "retrieve": retrieve, "rerank": rerank,
+        "prompt": RagPromptBuilder(max_new_tokens=max_new_tokens),
+        "generate": gen,
+    }
+    graph = {
+        "name": "embed", "type": "MODEL", "children": [{
+            "name": "retrieve", "type": "MODEL", "children": [{
+                "name": "rerank", "type": "MODEL", "children": [{
+                    "name": "prompt",
+                    "implementation": "RAG_PROMPT_BUILDER",
+                    "children": [{"name": "generate", "type": "MODEL"}],
+                }],
+            }],
+        }],
+    }
+    stage_units = ("embed", "retrieve", "rerank")
+
+    executors: List[GraphExecutor] = []
+
+    def mk(fuse: bool, metrics=None, faults=None) -> GraphExecutor:
+        spec = default_predictor(PredictorSpec.from_dict({
+            "name": "rag",
+            **({"annotations": {"seldon.io/fuse": "true"}} if fuse else {}),
+            "graph": json.loads(json.dumps(graph)),
+        }))
+        ex = GraphExecutor(spec, registry=registry, metrics=metrics,
+                           faults=faults)
+        executors.append(ex)
+        return ex
+
+    rs = np.random.RandomState(11)
+    requests = [
+        {"data": {"ndarray": rs.randint(1, vocab, (1, query_len)).tolist()}}
+        for _ in range(n_requests)
+    ]
+
+    def scrub(out: Dict[str, Any]) -> Dict[str, Any]:
+        out = json.loads(json.dumps(out))
+        out.get("meta", {}).pop("puid", None)
+        # TIMER metrics are wall-clock telemetry, not data
+        m = out.get("meta", {})
+        if "metrics" in m:
+            m["metrics"] = [
+                x for x in m["metrics"] if x.get("type") != "TIMER"
+            ]
+        return out
+
+    loop = asyncio.new_event_loop()
+    try:
+        hop_reg, fused_reg, chaos_reg = (
+            MetricsRegistry(), MetricsRegistry(), MetricsRegistry()
+        )
+        ex_hop = mk(False, metrics=hop_reg)
+        ex_fused = mk(True, metrics=fused_reg)
+
+        def call(ex, req):
+            t0 = time.perf_counter()
+            out = ex.predict(json.loads(json.dumps(req)))
+            out = loop.run_until_complete(out)
+            return out, (time.perf_counter() - t0) * 1000.0
+
+        # warmup both paths (compiles + thread pools) outside the window
+        for ex in (ex_hop, ex_fused):
+            call(ex, requests[0])
+        # interleaved measurement: drift hits both paths equally
+        hop_lat, fused_lat = [], []
+        hop_outs, fused_outs = [], []
+        for req in requests:
+            oh, lh = call(ex_hop, req)
+            of, lf = call(ex_fused, req)
+            hop_outs.append(scrub(oh))
+            fused_outs.append(scrub(of))
+            hop_lat.append(lh)
+            fused_lat.append(lf)
+        identical = hop_outs == fused_outs
+        seg = ex_fused.fusion.segments.get("embed")
+        p50_hop = float(np.percentile(hop_lat, 50))
+        p50_fused = float(np.percentile(fused_lat, 50))
+
+        # span proof: N stages -> 1 device dispatch per segment
+        tracer = tracing.init_tracer(enabled=True)
+        try:
+            call(ex_fused, requests[0])
+            fused_ops = [s.operation for s in tracer.finished_spans()]
+            fused_seg_spans = fused_ops.count("gen.fused_segment")
+            fused_stage_spans = sum(
+                fused_ops.count(f"{u}.predict") for u in stage_units
+            )
+            seg_span_us = [
+                s.duration_us for s in tracer.finished_spans()
+                if s.operation == "gen.fused_segment"
+            ]
+            tracer = tracing.init_tracer(enabled=True)
+            call(ex_hop, requests[0])
+            hop_spans = {
+                s.operation: s.duration_us
+                for s in tracer.finished_spans()
+                if s.operation.split(".")[0] in stage_units
+            }
+        finally:
+            tracing.init_tracer(enabled=False)
+        single_dispatch = fused_seg_spans == 1 and fused_stage_spans == 0
+
+        # chaos leg (PR 7): faults on the interior rerank unit — fusion
+        # must disable itself (counted) and serve per-unit, output
+        # identical to the reference
+        inj = FaultInjector([{"unit": "rerank", "latency_ms": 1.0}])
+        ex_chaos = mk(True, metrics=chaos_reg, faults=inj)
+        chaos_outs = [scrub(call(ex_chaos, r)[0]) for r in requests[:4]]
+        chaos_identical = chaos_outs == hop_outs[:4]
+        chaos_fallbacks = chaos_reg.counter_total(
+            "seldon_engine_fusion_fallbacks", {"reason": "faults"}
+        )
+        fused_total = fused_reg.counter_total("seldon_engine_fused_segments")
+    finally:
+        # each executor owns a unit-call thread pool: leave none behind
+        # (this bench runs in both tiers inside one modelbench process)
+        for ex in executors:
+            loop.run_until_complete(ex.close())
+        gen.close()
+        loop.close()
+
+    return {
+        "model": label,
+        "scenario": (
+            "RAG graph (embed -> retrieve -> rerank -> generate) fused "
+            "vs hop-by-hop in one entry: retrieval chain compiled into "
+            "ONE XLA executable, greedy byte-identity incl. the "
+            "generate tail, interleaved p50 no slower, 3 stages -> 1 "
+            "dispatch proven by trace spans; chaos leg forces a counted "
+            "fallback under fault injection with identical output"
+        ),
+        "requests_total": 2 * n_requests + 4,
+        "query_len": query_len,
+        "doc_len": doc_len,
+        "max_new_tokens": max_new_tokens,
+        "corpus_size": corpus_size,
+        "top_k": top_k,
+        # the acceptance bits
+        "greedy_identical": identical,
+        "fused_no_slower": p50_fused <= p50_hop * fused_slowdown_budget,
+        "single_dispatch_per_segment": single_dispatch,
+        # the chaos leg's contract: the faulted unit is COUNTED out of
+        # fusion and served per-unit with identical output — the
+        # remaining fault-free sub-chain may (and should) still fuse
+        "fallback_exercised": (
+            chaos_identical
+            and chaos_fallbacks >= 1
+            and not any(
+                "rerank" in seg.names
+                for seg in (ex_chaos.fusion.segments or {}).values()
+            )
+        ),
+        "fused_dispatches": int(seg.dispatches if seg else 0),
+        "fused_segments_metric": fused_total,
+        "segment_stages": list(seg.names) if seg else [],
+        # per-hop vs fused latency breakdown (one traced request each)
+        "hop_stage_us": {k: int(v) for k, v in sorted(hop_spans.items())},
+        "hop_stage_total_us": int(sum(hop_spans.values())),
+        "fused_segment_us": int(seg_span_us[0]) if seg_span_us else None,
+        "p50_hop_ms": round(p50_hop, 3),
+        "p50_fused_ms": round(p50_fused, 3),
+        "p99_hop_ms": round(float(np.percentile(hop_lat, 99)), 3),
+        "p99_fused_ms": round(float(np.percentile(fused_lat, 99)), 3),
+        "fused_speedup": round(p50_hop / max(p50_fused, 1e-9), 3),
+        "tokens_per_s": round(
+            n_requests * max_new_tokens / max(sum(fused_lat) / 1000.0, 1e-9),
+            2,
+        ),
+        "p50_ms": round(p50_fused, 3),
+        "p99_ms": round(float(np.percentile(fused_lat, 99)), 3),
+    }
+
+
 def bench_migration(
     root: str,
     n_requests: int = 4,
@@ -3026,6 +3280,18 @@ def run_model_tier(
                     "n_heads": 2, "n_kv_heads": 2, "d_ff": 64, "max_seq": 64,
                 },
             )
+            # graph-fusion + RAG proof: embed -> retrieve -> rerank
+            # compiled into ONE executable vs hop-by-hop, greedy
+            # byte-identity incl. the generate tail, interleaved p50 no
+            # slower (the CI-checked bit — per-hop host transfers are
+            # the cost fusion removes, so the small-model tier is where
+            # the win is proportionally largest), 3 stages -> 1 dispatch
+            # by span count, and the chaos leg's counted fallback
+            # (chip scales the same harness)
+            results["llm_rag"] = bench_rag(
+                root, n_requests=24, query_len=8, doc_len=8,
+                max_new_tokens=12, slots=2, steps_per_poll=1,
+            )
         else:
             # the raw-image path is transfer-bound and the most sensitive
             # to transient tunnel congestion: best-of-two per encoding,
@@ -3420,6 +3686,22 @@ def run_model_tier(
                 n_requests=4, prompt_len=128, max_new_tokens=32,
                 slots=4, steps_per_poll=8,
                 config={**big_cfg, "max_seq": 256},
+            )
+            # RAG + graph fusion at chip scale: a real bert-base-class
+            # embedder and a 1.26B-class generate tail — per-hop host
+            # transfers here are real PCIe D2H/H2D of [B, d_model]
+            # activations, so the fused-vs-hop delta is the measured
+            # on-chip value of keeping intermediates in HBM
+            results["llm_rag"] = bench_rag(
+                root, label="llm-rag-chip",
+                n_requests=24, query_len=64, doc_len=64,
+                max_new_tokens=32, d_embed=256, corpus_size=256,
+                top_k=8, slots=4, steps_per_poll=8,
+                bert_config={
+                    "vocab_size": 32000, "d_model": 768, "n_layers": 12,
+                    "n_heads": 12, "d_ff": 3072, "max_seq": 128,
+                },
+                llm_config={**big_cfg, "max_seq": 256},
             )
             # long-context serving, small decoder: the fast-step regime
             # where the per-burst host sync is the enemy — spp 32 buys a

@@ -17,6 +17,8 @@ _FAMILIES: Dict[str, str] = {
     "bert": "seldon_core_tpu.models.bert.BertClassifier",
     "llm": "seldon_core_tpu.models.llm.DecoderLM",
     "vit": "seldon_core_tpu.models.vit.ViTClassifier",
+    "retrieval": "seldon_core_tpu.models.retrieval.RetrievalIndex",
+    "reranker": "seldon_core_tpu.models.retrieval.Reranker",
 }
 
 

@@ -1,0 +1,195 @@
+"""Retrieval + rerank model families: the RAG graph's middle stages.
+
+The ``llm_rag`` workload (docs/graphs.md "Graph fusion") chains
+``embed → retrieve → rerank → generate``:
+
+* **retrieval** — :class:`RetrievalIndex`: jittable dense top-k over an
+  in-HBM embedding matrix. Input is a query embedding ``[B, E]`` (a
+  bert embedder's logits with ``num_classes = d_embed``); output is the
+  query concatenated with the top-k candidate doc indices, ``[B, E+K]``
+  float32, so the whole hop stays one tensor and the fusion compiler
+  can keep it in HBM.
+* **reranker** — :class:`Reranker`: gathers the candidates' embeddings,
+  scores each ``concat(query, candidate)`` feature with an MLP head
+  (reusing :class:`~seldon_core_tpu.models.mlp.MLP` — the "mlp
+  reranker"), picks the winner and emits its document's token row
+  ``[B, L]`` int32 — the prompt the generate unit decodes
+  (``RAG_PROMPT_BUILDER`` bridges the tensor to the request body).
+
+Both families derive the corpus (embeddings + doc token rows) from the
+same deterministic helper, so two units configured with the same
+``seed``/``corpus_size``/``d_embed``/``doc_len``/``vocab_size`` serve
+the SAME corpus without sharing parameters — the operator contract a
+RAG graph spec must hold.
+
+Precision note: graph hops downcast floating tensors to the component's
+compute dtype (bfloat16 by default), so candidate INDICES ride the
+rerank hop as bf16 floats. Integers are exact in bf16 only up to 256 —
+``corpus_size`` is therefore capped at 256 (validated at build), which
+keeps fused and hop-by-hop execution byte-identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .base import ServedModel
+
+# the largest integer bf16 represents exactly (8 mantissa bits): doc
+# indices above this would be rounded by the hop downcast
+_BF16_EXACT_INT_MAX = 256
+
+
+def corpus_params(seed: int, corpus_size: int, d_embed: int, doc_len: int,
+                  vocab_size: int):
+    """The ONE corpus derivation shared by both families: embeddings
+    ``[N, E]`` float32 and doc token rows ``[N, L]`` int32 (ids in
+    ``[1, vocab)`` — 0 is PAD everywhere in the zoo)."""
+    import jax
+    import jax.numpy as jnp
+
+    ke, kd = jax.random.split(jax.random.PRNGKey(seed ^ 0x5EED))
+    emb = jax.random.normal(ke, (corpus_size, d_embed), jnp.float32)
+    docs = jax.random.randint(
+        kd, (corpus_size, doc_len), 1, vocab_size, jnp.int32
+    )
+    return emb, docs
+
+
+@dataclasses.dataclass
+class RetrievalConfig:
+    corpus_size: int = 128
+    d_embed: int = 32
+    top_k: int = 4
+    doc_len: int = 8
+    vocab_size: int = 256
+    seed: int = 0
+    dtype: str = "bfloat16"
+
+
+def _cfg(cls, config):
+    fields = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in config.items() if k in fields})
+
+
+class RetrievalIndex(ServedModel):
+    """Dense top-k retrieval: ``scores = q @ E.T`` on the MXU, indices
+    by ``lax.top_k`` (deterministic — ties break to the lower index)."""
+
+    def __init__(self, **config):
+        self.cfg = _cfg(RetrievalConfig, config)
+        if self.cfg.corpus_size > _BF16_EXACT_INT_MAX:
+            raise ValueError(
+                f"corpus_size {self.cfg.corpus_size} > {_BF16_EXACT_INT_MAX}: "
+                "candidate indices ride graph hops as bf16 floats and stop "
+                "being exact integers past 256"
+            )
+        if self.cfg.top_k > self.cfg.corpus_size:
+            raise ValueError(
+                f"top_k {self.cfg.top_k} > corpus_size {self.cfg.corpus_size}"
+            )
+        self.example_input_shape = (self.cfg.d_embed,)
+        self.compute_dtype = self.cfg.dtype
+
+    def init_params(self, seed: int = 0):
+        cfg = self.cfg
+        emb, _docs = corpus_params(
+            cfg.seed or seed, cfg.corpus_size, cfg.d_embed, cfg.doc_len,
+            cfg.vocab_size,
+        )
+        return {"emb": emb}
+
+    def apply(self, params, q):
+        """q [B, E] -> [B, E+K] float32: the query rows (exact upcast)
+        followed by the top-k candidate indices as floats."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.dtype)
+        q = q.astype(dt)
+        scores = lax.dot_general(
+            q, params["emb"].astype(dt),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [B, N]
+        _vals, idx = lax.top_k(scores, cfg.top_k)
+        return jnp.concatenate(
+            [q.astype(jnp.float32), idx.astype(jnp.float32)], axis=-1
+        )
+
+    def flops_per_row(self, *_a) -> float:
+        return 2.0 * self.cfg.corpus_size * self.cfg.d_embed
+
+
+@dataclasses.dataclass
+class RerankConfig(RetrievalConfig):
+    hidden: tuple = (32,)
+
+
+class Reranker(ServedModel):
+    """MLP reranker over the retrieval stage's candidates: gather each
+    candidate's embedding, score ``concat(query, candidate)`` with an
+    MLP head, emit the winning document's token row."""
+
+    def __init__(self, **config):
+        from .mlp import MLP
+
+        self.cfg = _cfg(RerankConfig, config)
+        if self.cfg.corpus_size > _BF16_EXACT_INT_MAX:
+            raise ValueError(
+                f"corpus_size {self.cfg.corpus_size} > {_BF16_EXACT_INT_MAX}: "
+                "candidate indices ride graph hops as bf16 floats and stop "
+                "being exact integers past 256"
+            )
+        hidden = self.cfg.hidden
+        if isinstance(hidden, (int, float)):
+            hidden = (int(hidden),)
+        self._scorer = MLP(
+            in_features=2 * self.cfg.d_embed, hidden=tuple(hidden),
+            num_classes=2, dtype=self.cfg.dtype,
+        )
+        self.example_input_shape = (self.cfg.d_embed + self.cfg.top_k,)
+        self.compute_dtype = self.cfg.dtype
+
+    def init_params(self, seed: int = 0):
+        cfg = self.cfg
+        emb, docs = corpus_params(
+            cfg.seed or seed, cfg.corpus_size, cfg.d_embed, cfg.doc_len,
+            cfg.vocab_size,
+        )
+        return {
+            "emb": emb,
+            "docs": docs,
+            "scorer": self._scorer.init_params(cfg.seed or seed),
+        }
+
+    def apply(self, params, x):
+        """x [B, E+K] (query ++ candidate indices) -> winning doc token
+        rows [B, L] int32."""
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        E, K = cfg.d_embed, cfg.top_k
+        dt = jnp.dtype(cfg.dtype)
+        x = x.astype(dt)
+        q = x[:, :E]                                   # [B, E]
+        idx = x[:, E:].astype(jnp.int32)               # [B, K] (exact <= 256)
+        cand = params["emb"][idx].astype(dt)           # [B, K, E]
+        B = x.shape[0]
+        feats = jnp.concatenate(
+            [jnp.broadcast_to(q[:, None, :], (B, K, E)), cand], axis=-1
+        )                                              # [B, K, 2E]
+        # MLP softmax head: p(class 0) is the relevance score — any
+        # strictly monotonic readout works, this one reuses the zoo's
+        # smallest family unchanged
+        probs = self._scorer.apply(params["scorer"], feats)  # [B, K, 2]
+        best = jnp.argmax(probs[..., 0], axis=-1)      # [B]
+        doc_id = jnp.take_along_axis(idx, best[:, None], axis=1)[:, 0]
+        return params["docs"][doc_id]                  # [B, L] int32
+
+    def flops_per_row(self, *_a) -> float:
+        cfg = self.cfg
+        dims = (2 * cfg.d_embed, *self._scorer.hidden, 2)
+        mlp = sum(2.0 * a * b for a, b in zip(dims[:-1], dims[1:]))
+        return cfg.top_k * mlp

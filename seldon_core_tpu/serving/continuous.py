@@ -2652,6 +2652,33 @@ class ContinuousBatcher:
                         )
                     )
                     self._cache["k"][0].block_until_ready()  # seldon-lint: disable=host-sync-hot-path (warm precompile: intentional sync while the loop is idle)
+        if self._kv_tier is not None:
+            # tier spill / copy-back executables: a rung-3 preemption
+            # extracts the victim lane's cache columns at its ATTENTION
+            # width (_attn_need(pos)) and the copy-back resume inserts a
+            # slab of that same width — widths the prefix-cache warm
+            # above (prompt buckets) never touches. Compile every width
+            # a lane can spill at so the first preemption and the first
+            # resume never compile inline on the scheduler thread.
+            tier_widths = sorted({
+                self._attn_need(p) for p in range(max(1, lo), hi + 1)
+            })
+            for w in tier_widths:
+                slab = self._extract_fn(self._cache, 0, w)
+                self._cache, self._cur_tok, self._pos, self._keys = (
+                    self._insert_fn(
+                        self._cache, slab, 0, jnp.int32(0), w,
+                        jax.random.PRNGKey(0),
+                        self._cur_tok, self._pos, self._keys,
+                    )
+                )
+                self._cache["k"][0].block_until_ready()  # seldon-lint: disable=host-sync-hot-path (warm precompile: intentional sync while the loop is idle)
+            # census line, PR 13 style: a width-count jump between runs
+            # means a config change grew the tier's compile surface
+            logger.info(
+                "warm: kv-tier extract/insert compile census: %d width "
+                "variant(s) (%s)", len(tier_widths), tier_widths,
+            )
         active = jnp.zeros((self.slots,), bool)
         temps = jnp.zeros((self.slots,), jnp.float32)
         for attn_len in attn_lens:

@@ -307,6 +307,23 @@ class JAXComponent(SeldonComponent):
         )
         return payload.to_device(X, sharding=sharding, dtype=dtype)
 
+    def fused_stage(self):
+        """``(fn, params, compute_dtype)`` for the graph-fusion compiler
+        (graph/fusion.py): ``fn(params, x)`` is the SAME jitted
+        executable :meth:`predict` dispatches (jit-of-jit inlines), so a
+        fused segment runs exactly the computation the hop-by-hop path
+        would — the property the byte-identity contract rests on."""
+        if self._apply is None:
+            self.load()
+        return self._apply, self.params, self.compute_dtype
+
+    # graph-fusion eligibility marker (graph/fusion.py): a bare
+    # JAXComponent backs ONLY ``predict`` with its executable — its
+    # transform hooks degrade to identity, so a TRANSFORMER-typed unit
+    # must not be fused through ``_apply``. JAXTransformComponent flips
+    # this by routing the transform hooks through the same executable.
+    fused_transforms = False
+
     def predict(self, X, names, meta=None):
         if self._apply is None:
             self.load()
@@ -322,3 +339,20 @@ class JAXComponent(SeldonComponent):
         except AttributeError:  # non-jax outputs (user models returning np)
             pass
         return out
+
+
+class JAXTransformComponent(JAXComponent):
+    """A JAXComponent whose jitted executable also serves the transform
+    hooks, for TRANSFORMER / OUTPUT_TRANSFORMER graph nodes: the hop
+    path and the graph-fusion compiler (graph/fusion.py) then agree on
+    what the unit computes. A bare JAXComponent on a TRANSFORMER node
+    degrades to the identity transform (the client_* contract above) —
+    which is exactly why fusion refuses it."""
+
+    fused_transforms = True
+
+    def transform_input(self, X, names, meta=None):
+        return self.predict(X, names, meta)
+
+    def transform_output(self, X, names, meta=None):
+        return self.predict(X, names, meta)

@@ -257,3 +257,24 @@ def test_bench_pressure_scenario_anchor():
     assert '"no_hang"' in mb_src
     gen_src = open(os.path.join(root, "tools", "gen_arch_numbers.py")).read()
     assert "llm_1b_pressure" in gen_src
+
+
+def test_bench_rag_scenario_anchor():
+    """The ``llm_rag`` bench scenario is an acceptance artifact (fused
+    vs hop-by-hop greedy byte-identity with the generate tail, the
+    fused-no-slower bit, the 3-stages-to-1-dispatch span proof, and the
+    chaos leg's counted fallback are read from its entry): it must stay
+    wired through BOTH model tiers, and the numbers-table generator
+    must know its key."""
+    import seldon_core_tpu.modelbench as modelbench
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    mb_src = open(modelbench.__file__).read()
+    assert mb_src.count('results["llm_rag"]') >= 2  # tiny + chip
+    assert hasattr(modelbench, "bench_rag")
+    # the entry asserts the acceptance bits like prior scenarios
+    assert '"greedy_identical": identical' in mb_src
+    assert '"fused_no_slower"' in mb_src
+    assert '"single_dispatch_per_segment": single_dispatch' in mb_src
+    gen_src = open(os.path.join(root, "tools", "gen_arch_numbers.py")).read()
+    assert "llm_rag" in gen_src

@@ -368,3 +368,76 @@ def test_junk_timeout_annotations_fall_back():
     assert _ann_seconds({"k": "1500"}, "k", 5.0) == 1.5
     assert _ann_int({"k": "junk"}, "k") is None
     assert _ann_int({"k": "42"}, "k") == 42
+
+
+class FloatRouter(SeldonComponent):
+    """Returns a non-integral branch via the raw-response path (the typed
+    client_route hook already rejects non-ints host-side; a remote/raw
+    router can still put garbage on the wire)."""
+
+    def route_raw(self, msg):
+        from seldon_core_tpu.proto import prediction_pb2 as pb
+        from seldon_core_tpu.payload import build_proto_response
+
+        return build_proto_response([[0.7]], [], "ndarray")
+
+
+class OutOfRangeRouter(SeldonComponent):
+    def route(self, X, names, meta=None):
+        return 7
+
+
+def _router_graph():
+    return {
+        "name": "r",
+        "type": "ROUTER",
+        "children": [
+            {"name": "a", "type": "MODEL"},
+            {"name": "b", "type": "MODEL"},
+        ],
+    }
+
+
+def test_router_non_integer_branch_is_typed_4xx():
+    """A malformed route response (0.7) must refuse typed 400 — int()
+    used to silently truncate it to branch 0."""
+    ex = GraphExecutor(
+        make_spec(_router_graph()),
+        registry={"r": FloatRouter(), "a": Doubler(), "b": Tripler()},
+    )
+    with pytest.raises(UnitCallError) as ei:
+        run(ex.predict(dict(REQ)))
+    assert ei.value.status == 400
+    assert "non-integer" in ei.value.info
+
+
+def test_router_out_of_range_branch_is_typed_4xx():
+    ex = GraphExecutor(
+        make_spec(_router_graph()),
+        registry={"r": OutOfRangeRouter(), "a": Doubler(), "b": Tripler()},
+    )
+    with pytest.raises(UnitCallError) as ei:
+        run(ex.predict(dict(REQ)))
+    assert ei.value.status == 400
+    assert "branch 7 of 2" in ei.value.info
+
+
+def test_branch_index_unit_validation():
+    from seldon_core_tpu.graph.executor import _branch_index
+
+    ok = {"data": {"ndarray": [[1]]}}
+    assert _branch_index(ok, 2, "r") == 1
+    # -1 stays the broadcast branch
+    assert _branch_index({"data": {"ndarray": [[-1]]}}, 2, "r") == -1
+    # integral float is a valid branch encoding (the wire is float-typed)
+    assert _branch_index({"data": {"tensor": {"values": [1.0]}}}, 2, "r") == 1
+    for bad, frag in [
+        ({"data": {"ndarray": [[0.5]]}}, "non-integer"),
+        ({"data": {"ndarray": [["x"]]}}, "non-numeric"),
+        ({"data": {"ndarray": [[2]]}}, "branch 2 of 2"),
+        ({"data": {"ndarray": [[-3]]}}, "branch -3 of 2"),
+    ]:
+        with pytest.raises(UnitCallError) as ei:
+            _branch_index(bad, 2, "r")
+        assert ei.value.status == 400
+        assert frag in ei.value.info

@@ -665,3 +665,36 @@ def test_kv_tier_metrics_map_to_first_class_series():
     assert reg.counter_total(
         "seldon_engine_kv_tier_demotions", {"unit": "gen"}
     ) == 3.0
+
+
+def test_warm_precompiles_tier_extract_insert_widths(model_and_params):
+    """ROADMAP item 2 leftover: the tier's extract/insert width variants
+    are part of warm()'s compile sweep, so the FIRST preemption spill and
+    the first copy-back resume never compile inline on the scheduler
+    thread. Asserted against the jit caches themselves: the executable
+    counts must not move across a spill + copy-back cycle."""
+    b = make_batcher(model_and_params, hbm_ledger_bytes=1 << 40,
+                     host_kv_tier_bytes=1 << 22, kv_tier_min_tokens=2)
+    try:
+        b.warm(prompt_lens=[len(p) for p in PROMPTS], max_new_tokens=40,
+               batch_sizes=(1,))
+        extract_n = b._extract_fn._cache_size()
+        insert_n = b._insert_fn._cache_size()
+        assert extract_n >= 1 and insert_n >= 1
+
+        futs = [
+            b.submit(p, max_new_tokens=40, temperature=0.0) for p in PROMPTS
+        ]
+        assert wait_lanes(b, 2)
+        arm_shrink(b)
+        for f in futs:
+            f.result(timeout=120)
+        b.sync_kv_tier_stats()
+        # the cycle actually exercised the tier fast path...
+        assert b.stats["preemptions"] >= 1
+        assert b.stats["kv_tier_hits"] >= 1
+        # ...and compiled NOTHING new on the scheduler thread
+        assert b._extract_fn._cache_size() == extract_n
+        assert b._insert_fn._cache_size() == insert_n
+    finally:
+        b.close()

@@ -147,6 +147,22 @@ def test_model_tier_tiny_end_to_end():
     assert mg["kill_resume_identical"] is True
     assert mg["kill_retries"] <= 1
     assert mg["no_hang"] is True
+    # graph fusion + RAG: the retrieval chain compiled into ONE
+    # executable must be byte-identical to hop-by-hop (greedy generate
+    # tail included), no slower at interleaved p50 (the CI-checked
+    # acceptance bit), ONE device dispatch per segment by span count,
+    # and the chaos leg's fault-injected interior unit must force a
+    # counted fallback with identical output
+    rg = results["llm_rag"]
+    assert rg["greedy_identical"] is True
+    assert rg["fused_no_slower"] is True
+    assert rg["single_dispatch_per_segment"] is True
+    assert rg["fallback_exercised"] is True
+    assert rg["segment_stages"] == ["embed", "retrieve", "rerank"]
+    assert rg["fused_dispatches"] >= 1
+    assert rg["fused_segments_metric"] >= 1
+    assert rg["hop_stage_total_us"] > 0
+    assert rg["fused_segment_us"] is not None
     # CPU has no published peak -> MFU is None there; on TPU it's a number
     mfu = results["resnet50_rest"]["mfu_pct"]
     assert mfu is None or 0 < mfu < 100

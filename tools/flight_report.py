@@ -337,6 +337,80 @@ def _migration_lines(
     return lines
 
 
+def _fusion_lines(
+    dispatches: List[Dict[str, Any]],
+    fallbacks: List[Dict[str, Any]],
+    segments: Dict[str, Any],
+) -> List[str]:
+    """Graph-fusion records (the executor's ``(fusion)`` pseudo-unit):
+    fused-segment dispatches vs counted fallbacks to the per-unit walk,
+    with a DIAGNOSIS when the fallback rate says fusion is configured
+    but barely serving."""
+    lines: List[str] = []
+    if not dispatches and not fallbacks and not segments:
+        return lines
+    for name, seg in sorted(segments.items()):
+        stages = seg.get("stages") or []
+        lines.append(
+            f"fused segment {name}: {' -> '.join(stages)} "
+            f"({seg.get('kind', '?')}, {len(stages)} stages -> 1 dispatch): "
+            f"{seg.get('dispatches', 0)} dispatch(es), fallbacks "
+            f"{seg.get('fallbacks') or {}}"
+        )
+    if dispatches:
+        durs = sorted(e.get("dur_ms", 0.0) for e in dispatches)
+        lines.append(
+            f"fused dispatches in window: {len(dispatches)}, "
+            f"p50 {durs[len(durs) // 2]:.2f} ms"
+        )
+    if fallbacks:
+        # first-occurrence markers only (the ring is protected from
+        # per-request flooding); cumulative counts live on the segments
+        plan_reasons = sorted({
+            f.get("reason", "?") for f in fallbacks
+            if f.get("reason") in ("remote", "faults", "microbatch", "hedge")
+        })
+        if plan_reasons:
+            lines.append(
+                "fusion plan-time exclusions: "
+                + ", ".join(plan_reasons)
+                + " (per-unit semantics kept those units on the "
+                "hop-by-hop path)"
+            )
+    # the fallback RATE comes from the cumulative per-segment totals:
+    # every per-request fallback lands on its segment's counter, while
+    # plan-time exclusions (structure, not traffic) never do — so the
+    # rate cannot false-alarm a low-traffic window
+    total_disp = sum(s.get("dispatches", 0) for s in segments.values())
+    req_reasons: Dict[str, int] = {}
+    for seg in segments.values():
+        for r, n in (seg.get("fallbacks") or {}).items():
+            req_reasons[r] = req_reasons.get(r, 0) + n
+    total_fb = sum(req_reasons.values())
+    if req_reasons:
+        lines.append(
+            "fusion fallbacks (cumulative): "
+            + ", ".join(f"{n}x {r}" for r, n in sorted(req_reasons.items()))
+        )
+        rate = _pct(total_fb, total_disp + total_fb)
+        if rate >= 50.0:
+            dominant = max(req_reasons.items(), key=lambda kv: kv[1])[0]
+            hint = {
+                "deadline": "deadline-carrying traffic always takes the "
+                "per-unit path — fusion buys this workload nothing",
+                "shadow": "a live shadow rollout inhibits fusion; expected "
+                "until the rollout goes terminal",
+                "breaker_open": "an interior unit's breaker is open — fix "
+                "the sick unit, fusion resumes with it",
+            }.get(dominant, "look at the per-reason records above")
+            lines.append(
+                f"DIAGNOSIS: {rate:.0f}% of fusable requests FELL BACK to "
+                f"hop-by-hop (dominant reason: {dominant}) — the compiled "
+                f"segments are mostly idle; {hint}"
+            )
+    return lines
+
+
 def diagnose(dump: Dict[str, Any]) -> List[str]:
     """Report lines for one unit's flight-recorder dump."""
     lines: List[str] = []
@@ -367,11 +441,20 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
     degraded = [
         e for e in entries if e.get("type") == "degraded_local_prefill"
     ]
+    fused_disp = [e for e in entries if e.get("type") == "fused_dispatch"]
+    fused_fb = [e for e in entries if e.get("type") == "fusion_fallback"]
     lines.append(
         f"recorded {dump.get('recorded_total', len(entries))} records "
         f"(ring holds {len(entries)}, dropped "
         f"{dump.get('dropped', 0)} oldest)"
     )
+    if "segments" in dump:
+        # the executor's (fusion) pseudo-unit: no scheduler, no SLO
+        # reservoir — its whole story is the dispatch/fallback stream
+        lines.extend(_fusion_lines(
+            fused_disp, fused_fb, dump.get("segments") or {}
+        ))
+        return lines
 
     # -- SLO attribution ----------------------------------------------------
     slo = dump.get("slo")

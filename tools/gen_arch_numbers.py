@@ -258,6 +258,19 @@ def rows_from(mt, fronts):
                else "")
             + ("; no hangs" if gp.get("no_hang") else ""),
         ))
+    rg = mt.get("llm_rag") or {}
+    if rg:
+        rows.append((
+            "RAG graph, fusion (embed->retrieve->rerank->generate)",
+            f"p50 {fmt(rg.get('p50_fused_ms'), 2)} ms fused vs "
+            f"{fmt(rg.get('p50_hop_ms'), 2)} ms hop-by-hop "
+            f"({rg.get('fused_speedup', '—')}x)",
+            f"{len(rg.get('segment_stages') or [])} stages -> 1 dispatch"
+            + ("; greedy bytes identical incl. generate tail"
+               if rg.get("greedy_identical") else "")
+            + ("; chaos fallback counted"
+               if rg.get("fallback_exercised") else ""),
+        ))
     gk = mt.get("llm_1b_kvtier") or {}
     if gk:
         on = gk.get("tier_on") or {}
