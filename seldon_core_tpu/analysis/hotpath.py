@@ -138,11 +138,15 @@ def check_host_sync(
                         continue
                     # metadata reads (.nbytes/.shape/.dtype/...) off a
                     # device array never touch the device: exempt names
-                    # that only appear under such attributes
+                    # that only appear under such attributes. ``.sharding``
+                    # joins the set for mesh-sharded serving — a layout
+                    # read (shard_shape, is_fully_replicated) is pure
+                    # metadata, same as .shape
                     meta_names = set()
                     for wrap in ast.walk(target):
                         if isinstance(wrap, ast.Attribute) and wrap.attr in (
                             "nbytes", "shape", "ndim", "size", "dtype",
+                            "sharding",
                         ):
                             meta_names.update(
                                 id(leaf) for leaf in ast.walk(wrap.value)

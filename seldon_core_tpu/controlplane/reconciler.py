@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..graph.spec import (
     ANNOTATION_KV_TIER_BYTES,
+    ANNOTATION_MESH,
     GraphSpecError,
     PREPACKAGED_SERVERS,
     PredictorSpec,
@@ -28,6 +29,7 @@ from ..graph.spec import (
     inject_kv_tier_param,
     parse_disagg_annotations,
     parse_kv_tier_annotation,
+    parse_mesh_annotation,
     validate_deployment,
 )
 from ..storage import Storage
@@ -235,6 +237,11 @@ class DeploymentController:
             # GENERATE_SERVER unit as the host_kv_tier_bytes parameter
             # (one source of truth — the annotation; see graph/spec.py)
             tier_bytes = parse_kv_tier_annotation(pspec)
+            # mesh annotation: the shape lands on the member spec as the
+            # tpuMesh field (one source of truth — the annotation; see
+            # graph/spec.py) so placement and the engine's in-process
+            # mesh build both read the same already-validated shape
+            mesh_shape = parse_mesh_annotation(pspec)
             for replica in range(max(1, pspec.replicas)):
                 name = f"{dep.key}/{pspec.name}/{replica}/engine-{h[:8]}"
                 espec_dict = pspec.to_dict()
@@ -247,6 +254,15 @@ class DeploymentController:
                         k: v
                         for k, v in (espec_dict.get("annotations") or {}).items()
                         if k != ANNOTATION_KV_TIER_BYTES
+                    }
+                if mesh_shape is not None:
+                    espec_dict["tpuMesh"] = dict(mesh_shape)
+                    # same inject-then-strip: tpuMesh carries the shape
+                    # now, so re-validation never sees both sources
+                    espec_dict["annotations"] = {
+                        k: v
+                        for k, v in (espec_dict.get("annotations") or {}).items()
+                        if k != ANNOTATION_MESH
                     }
                 specs.append(
                     ComponentSpec(
@@ -714,6 +730,12 @@ class DeploymentController:
                     continue
                 pspec = dep.predictor(spec.predictor)
                 mesh_spec = pspec.tpu_mesh if pspec else None
+                if pspec is not None and mesh_spec is None:
+                    # seldon.io/mesh predictors carry no tpuMesh on the
+                    # DEPLOYMENT spec (the annotation owns it; the member
+                    # spec got tpuMesh injected) — consult the annotation
+                    # so placement carves the same block
+                    mesh_spec = parse_mesh_annotation(pspec)
                 if self.placement.assigned(spec.name) is None:
                     self.placement.allocate(spec.name, mesh_spec)
                     fresh.append(spec.name)

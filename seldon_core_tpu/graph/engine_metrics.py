@@ -161,6 +161,16 @@ class MetricsRegistry:
         # host KV tier occupancy: HOST RAM, deliberately not one of the
         # HBM pressure gauges (the ledger never counts tier bytes)
         "gen_kv_tier_bytes": "seldon_engine_kv_tier_bytes",
+        # sharded serving: the mesh shape a member serves on plus its
+        # per-chip footprint — param_shard_bytes under the TP layout
+        # (vs the global param bytes: the >1-chip-model headroom) and
+        # how many ways the KV cache's bytes divide per chip
+        "gen_mesh_devices": "seldon_engine_mesh_devices",
+        "gen_mesh_data": "seldon_engine_mesh_data",
+        "gen_mesh_model": "seldon_engine_mesh_model",
+        "gen_mesh_param_shard_bytes":
+            "seldon_engine_mesh_param_shard_bytes",
+        "gen_mesh_kv_shard": "seldon_engine_mesh_kv_shard",
     }
 
     # generate SLO TIMERs (per completed request, shipped by the generate

@@ -398,6 +398,53 @@ def parse_kv_tier_annotation(spec: PredictorSpec) -> "Optional[int]":
     return tier_bytes
 
 
+# sharded serving (docs/generate.md "Sharded serving"): the mesh shape
+# a generate predictor's engines partition ONE model replica across
+ANNOTATION_MESH = "seldon.io/mesh"
+
+
+def parse_mesh_annotation(spec: PredictorSpec) -> "Optional[Dict[str, int]]":
+    """The ``seldon.io/mesh`` shape (``"data=2,model=4"``) when the
+    predictor opts into sharded serving, None otherwise. The ONE parser
+    shared by admission validation and the reconciler's placement path,
+    strict at apply time: axis=size pairs only (typed
+    ``parallel.mesh.MeshShapeError`` surfaces as a GraphSpecError), the
+    graph must contain a GENERATE_SERVER unit (the mesh partitions the
+    generate model + KV cache), and the spec must not also set
+    ``tpuMesh`` by hand (the annotation owns the shape — two sources of
+    truth for one mesh is how operators get neither)."""
+    ann = spec.annotations or {}
+    raw = ann.get(ANNOTATION_MESH)
+    if raw is None:
+        return None
+    from ..parallel.mesh import MeshShapeError, parse_mesh_shape
+
+    try:
+        shape = parse_mesh_shape(str(raw))
+    except MeshShapeError as e:
+        raise GraphSpecError(
+            f"predictor {spec.name!r}: malformed {ANNOTATION_MESH} "
+            f"annotation {raw!r}: {e}"
+        ) from e
+    gen_units = [
+        u for u in spec.graph.walk()
+        if u.implementation == "GENERATE_SERVER"
+    ]
+    if not gen_units:
+        raise GraphSpecError(
+            f"predictor {spec.name!r}: {ANNOTATION_MESH} needs a "
+            "GENERATE_SERVER unit (the mesh partitions the generate "
+            "model and its KV cache)"
+        )
+    if spec.tpu_mesh:
+        raise GraphSpecError(
+            f"predictor {spec.name!r}: {ANNOTATION_MESH} owns the mesh "
+            "shape — drop the explicit tpuMesh field (two sources of "
+            "truth for one mesh)"
+        )
+    return shape
+
+
 def inject_kv_tier_param(spec_dict: Dict, tier_bytes: int) -> Dict:
     """Append ``host_kv_tier_bytes`` to every GENERATE_SERVER node of a
     predictor-spec dict (the reconciler's injection half of the
@@ -449,6 +496,9 @@ def validate_predictor(spec: PredictorSpec) -> None:
     # fuse annotation: strict-at-apply (a typo'd value must not silently
     # serve hop-by-hop while the operator believes fusion is on)
     parse_fuse_annotation(spec)
+    # mesh annotation: strict-at-apply (a malformed shape must refuse
+    # the apply, never surface as an opaque XLA failure at member boot)
+    parse_mesh_annotation(spec)
 
 
 def validate_deployment(predictors: List[PredictorSpec]) -> None:

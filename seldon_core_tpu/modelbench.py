@@ -3034,6 +3034,207 @@ def bench_migration(
     }
 
 
+def bench_sharded(
+    root: str,
+    seconds: float = 4.0,
+    concurrency: int = 2,
+    prompt_len: int = 6,
+    max_new_tokens: int = 16,
+    slots: int = 4,
+    steps_per_poll: int = 2,
+    mesh_shape: Optional[str] = None,
+    config: Optional[Dict[str, Any]] = None,
+    hbm_gb_s: Optional[float] = None,
+    n_probe: int = 3,
+    label: str = "llm-sharded",
+) -> Dict[str, Any]:
+    """Pod-scale sharded generate serving: ONE model served with
+    mesh-sharded params and a sharded KV cache next to the identical
+    unmeshed server on the SAME checkpoint.
+
+    The acceptance bits, in one entry: greedy AND seeded byte-identity
+    across the 1-device/N-device pair (serving math is
+    sharded-storage / replicated-compute, so a mesh must never change
+    a single output byte), sharded vs plain tokens/s and p50
+    side-by-side with the no-slower verdict, MBU for both sides, and
+    the per-shard HBM ledger the PressureController actually accounts
+    with (``param_shard_bytes`` + ``kv_shard`` from
+    ``pressure_summary`` — the pod-scale capacity win made visible).
+
+    ``mesh_shape`` defaults to the largest ``model`` axis (<= 4) that
+    divides the device count, the attention heads, the KV heads and
+    ``d_ff``, with every remaining chip on ``data``. On a single
+    device the entry publishes a skip marker instead of a vacuous
+    pair."""
+    import http.client
+
+    import jax
+
+    from .servers.generateserver import GenerateServer
+
+    cfg = dict(config or {})
+    cfg.setdefault("max_seq", max(64, 2 * (prompt_len + max_new_tokens)))
+    model_dir = write_model_dir(root, "llm", cfg)
+    dc = jax.device_count()
+    if mesh_shape is None:
+        heads = int(cfg.get("n_heads", 1))
+        kvh = int(cfg.get("n_kv_heads") or heads)
+        dff = int(cfg.get("d_ff", 1))
+        m = 1
+        for cand in (2, 4):
+            if (dc % cand == 0 and heads % cand == 0
+                    and kvh % cand == 0 and dff % cand == 0):
+                m = cand
+        mesh_shape = f"data={dc // m},model={m}"
+    if dc < 2 or mesh_shape.endswith("model=1"):
+        return {
+            "model": label,
+            "skipped": f"needs a shardable mesh ({dc} device(s), "
+                       f"shape {mesh_shape})",
+        }
+    common = dict(
+        model_uri=model_dir, slots=slots, steps_per_poll=steps_per_poll,
+        warmup_prompt_lens=[prompt_len], warmup_max_new_tokens=max_new_tokens,
+    )
+    plain = GenerateServer(**common)
+    plain.load()
+    shard = GenerateServer(
+        mesh_shape=mesh_shape, hbm_ledger_bytes=1 << 40, **common
+    )
+    shard.load()
+
+    def probe(server, temperature, seed):
+        rs = np.random.RandomState(7)
+        vocab = cfg.get("vocab_size", 256)
+        outs = []
+        for i in range(n_probe):
+            n = max(3, prompt_len - i)
+            p = rs.randint(1, vocab, n).tolist()
+            outs.append(server.predict(
+                {"prompt_tokens": [p], "max_new_tokens": max_new_tokens,
+                 "temperature": temperature, "seed": seed}, [],
+            )["tokens"][0])
+        return outs
+
+    def window(server):
+        harness = EngineHarness(server).start()
+        prompt = list(range(1, prompt_len + 1))
+        body = json.dumps({
+            "jsonData": {"prompt_tokens": [prompt],
+                         "max_new_tokens": max_new_tokens,
+                         "temperature": 0.0},
+        }).encode()
+        headers = {"Content-Type": "application/json",
+                   "Connection": "keep-alive"}
+        port = harness.http_port
+
+        def make_call():
+            conn = http.client.HTTPConnection("127.0.0.1", port)
+
+            def call() -> int:
+                conn.request("POST", "/api/v0.1/predictions", body, headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+                if resp.status != 200:
+                    raise RuntimeError(
+                        f"sharded bench HTTP {resp.status}: {payload[:200]}"
+                    )
+                toks = json.loads(payload)["jsonData"]["tokens"][0]
+                return len(toks) - prompt_len
+
+            return call
+
+        try:
+            return closed_loop(make_call, seconds, concurrency,
+                               warmup_calls=2)
+        finally:
+            harness.stop()
+
+    try:
+        greedy_identical = probe(plain, 0.0, 0) == probe(shard, 0.0, 0)
+        sampled_identical = probe(plain, 0.8, 17) == probe(shard, 0.8, 17)
+        w_plain = window(plain)
+        w_shard = window(shard)
+        b = shard.batcher
+        n_active = 1
+        for n in dict(b.mesh.shape).values():
+            n_active *= int(n)
+        ledger = b.pressure_summary() or {}
+        kv_shard = int(ledger.get("kv_shard", b._kv_shard))
+        param_shard_bytes = int(
+            ledger.get("param_shard_bytes", b._param_shard_bytes)
+        )
+        model = shard._model
+        param_total = model.n_params() * 2  # bf16 resident
+        avg_ctx = prompt_len + max_new_tokens / 2.0
+        entry: Dict[str, Any] = {
+            "model": label,
+            "scenario": (
+                "one checkpoint served 1-device vs mesh-sharded "
+                f"({mesh_shape}): greedy+seeded byte-identity probes, "
+                "tokens/s + p50 side-by-side, per-shard HBM ledger"
+            ),
+            "transport": "engine REST, continuous batching",
+            "mesh_shape": mesh_shape,
+            "devices": dc,
+            "prompt_len": prompt_len,
+            "max_new_tokens": max_new_tokens,
+            "slots": slots,
+            "greedy_identical": greedy_identical,
+            "sampled_identical": sampled_identical,
+            "tokens_per_s": w_shard["rows_per_s"],
+            "plain_tokens_per_s": w_plain["rows_per_s"],
+            "p50_ms": w_shard["p50_ms"],
+            "plain_p50_ms": w_plain["p50_ms"],
+            "p99_ms": w_shard["p99_ms"],
+            # two verdicts, both with the rollout bench's 10% guard-rail.
+            # Raw no-slower is the REAL-CHIP claim: N chips each run the
+            # replicated compute in parallel wall-clock, so a mesh must
+            # not cost latency. On a HOST-EMULATED mesh the N "devices"
+            # timeshare one socket, so raw p50 necessarily carries the
+            # ~N x serialization of the emulation — there the per-chip
+            # verdict is the regression gate: one emulated chip's share
+            # of the wall clock must stay no slower than the 1-device
+            # server (it catches real sharding overhead — a gather that
+            # stops CSE-ing, a reshard in the step loop — while not
+            # penalising the emulator for having one socket).
+            "p50_no_slower": w_shard["p50_ms"] <= w_plain["p50_ms"] * 1.10,
+            "p50_no_slower_per_chip": (
+                w_shard["p50_ms"] / n_active
+                <= w_plain["p50_ms"] * 1.10
+            ),
+            "active_devices": n_active,
+            "kv_shard": kv_shard,
+            "param_shard_bytes": param_shard_bytes,
+            "param_total_bytes": param_total,
+            "n_params": model.n_params(),
+        }
+        if hbm_gb_s:
+            # MBU side-by-side: the plain side reads the FULL params per
+            # fused step, the sharded side only its 1/kv_shard resident
+            # slice per chip — the same per-shard byte model the ledger
+            # accounts with
+            bytes_per_tok = model.decode_bytes_per_token(avg_ctx, batch=slots)
+            shard_bytes_per_tok = (
+                bytes_per_tok - (param_total - param_shard_bytes) / slots
+            )
+            entry["hbm_gb_s"] = round(hbm_gb_s, 1)
+            entry["plain_mbu_pct"] = round(
+                100.0 * w_plain["rows_per_s"] * bytes_per_tok
+                / (hbm_gb_s * 1e9), 2
+            )
+            entry["mbu_pct"] = round(
+                100.0 * w_shard["rows_per_s"] * max(shard_bytes_per_tok, 0.0)
+                / (hbm_gb_s * 1e9), 2
+            )
+        return entry
+    finally:
+        if plain.batcher is not None:
+            plain.batcher.close()
+        if shard.batcher is not None:
+            shard.batcher.close()
+
+
 def _ablate_generate(
     root: str,
     base_kw: Dict[str, Any],
@@ -3278,6 +3479,19 @@ def run_model_tier(
                 config={
                     "vocab_size": 256, "d_model": 32, "n_layers": 2,
                     "n_heads": 2, "n_kv_heads": 2, "d_ff": 64, "max_seq": 64,
+                },
+            )
+            # pod-scale sharded serving proof: the same checkpoint served
+            # 1-device vs mesh-sharded (params + KV at 1/N per chip),
+            # greedy+seeded byte-identity probes, tokens/s + p50
+            # side-by-side, and the per-shard HBM ledger published
+            # (chip scales the same harness to the 1.26B tier)
+            results["llm_1b_sharded"] = bench_sharded(
+                root, seconds=min(seconds, 3.0), concurrency=2,
+                prompt_len=6, max_new_tokens=12, slots=2, steps_per_poll=2,
+                config={
+                    "vocab_size": 256, "d_model": 32, "n_layers": 2,
+                    "n_heads": 4, "n_kv_heads": 4, "d_ff": 64, "max_seq": 64,
                 },
             )
             # graph-fusion + RAG proof: embed -> retrieve -> rerank
@@ -3685,6 +3899,17 @@ def run_model_tier(
                 root, label="llm-1.26b-migration",
                 n_requests=4, prompt_len=128, max_new_tokens=32,
                 slots=4, steps_per_poll=8,
+                config={**big_cfg, "max_seq": 256},
+            )
+            # pod-scale sharded serving at flagship scale: the capacity
+            # win is real here — a 1.26B checkpoint's params + KV live at
+            # 1/N per chip while outputs stay byte-identical to the
+            # 1-device server; tokens/s + p50 + per-chip MBU side-by-side
+            results["llm_1b_sharded"] = bench_sharded(
+                root, label="llm-1.26b-sharded",
+                seconds=seconds, concurrency=4,
+                prompt_len=64, max_new_tokens=32,
+                slots=4, steps_per_poll=8, hbm_gb_s=hbm,
                 config={**big_cfg, "max_seq": 256},
             )
             # RAG + graph fusion at chip scale: a real bert-base-class

@@ -379,6 +379,7 @@ class DecoderLM(ServedModel):
 
         cfg = self.cfg
         dt = jnp.dtype(cfg.dtype)
+        params = self._tp_gather(params)  # exact serving-mesh entry gather
         tokens = tokens.astype(jnp.int32)
         x = params["embed"][tokens].astype(dt)
         positions = jnp.arange(tokens.shape[1])
@@ -435,6 +436,9 @@ class DecoderLM(ServedModel):
         ``attn_len`` (static int, optional) bounds the cache READ length."""
         from jax import lax
 
+        # serving-mesh entry gather / exit reshard (see set_serving_mesh)
+        params = self._tp_gather(params)
+        cache = self._tp_gather(cache)
         x = self._embed_tokens(params, tokens)  # [B,1,D]
 
         def body(x, inputs):
@@ -445,7 +449,7 @@ class DecoderLM(ServedModel):
             return x, (nk, nv)
 
         x, (nk, nv) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
-        return self._decode_head(params, x), {"k": nk, "v": nv}
+        return self._decode_head(params, x), self._tp_slab({"k": nk, "v": nv})
 
     def decode_step(self, params, cache, tokens, pos):
         """One decode step: tokens [B, 1], pos scalar int. Returns
@@ -498,6 +502,10 @@ class DecoderLM(ServedModel):
 
         pos = pos.astype(jnp.int32)
         wp = pos if write_pos is None else write_pos.astype(jnp.int32)
+        # serving-mesh entry gather / exit reshard (see set_serving_mesh)
+        params = self._tp_gather(params)
+        ks = self._tp_gather(ks)
+        vs = self._tp_gather(vs)
         x = self._embed_tokens(params, tokens)  # [B,1,D]
         blocks = params["blocks"]
         nks: list = []
@@ -507,8 +515,8 @@ class DecoderLM(ServedModel):
             x, nk, nv = self._decode_layer(
                 layer_p, x, pos, ks[l], vs[l], wp, attn_len
             )
-            nks.append(nk)
-            nvs.append(nv)
+            nks.append(self._tp_cache(nk))
+            nvs.append(self._tp_cache(nv))
         return self._decode_head(params, x), nks, nvs
 
     def decode_chunk_ragged_list(self, params, ks, vs, tokens, pos, attn_len=None):
@@ -536,6 +544,10 @@ class DecoderLM(ServedModel):
         pos = pos.astype(jnp.int32)
         B, W = tokens.shape
         positions = pos[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]  # [B,W]
+        # serving-mesh entry gather / exit reshard (see set_serving_mesh)
+        params = self._tp_gather(params)
+        ks = self._tp_gather(ks)
+        vs = self._tp_gather(vs)
         x = self._embed_tokens(params, tokens)  # [B,W,D]
         blocks = params["blocks"]
         nks: list = []
@@ -557,8 +569,8 @@ class DecoderLM(ServedModel):
             # per-row scatter of the whole window: ck[b,:,pos[b]+j,:] = k[b,:,j,:]
             ck = ks[l].at[rows, :, positions, :].set(k.transpose(0, 2, 1, 3))
             cv = vs[l].at[rows, :, positions, :].set(v.transpose(0, 2, 1, 3))
-            nks.append(ck)
-            nvs.append(cv)
+            nks.append(self._tp_cache(ck))
+            nvs.append(self._tp_cache(cv))
             kc, vc = ck, cv
             if attn_len is not None and attn_len < kc.shape[2]:
                 kc = lax.slice_in_dim(kc, 0, attn_len, axis=2)
@@ -607,6 +619,10 @@ class DecoderLM(ServedModel):
 
         cfg = self.cfg
         dt = jnp.dtype(cfg.dtype)
+        # serving-mesh entry gather: the scan body below must be the
+        # byte-identical single-device program (see set_serving_mesh)
+        params = self._tp_gather(params)
+        slab = self._tp_gather(slab)
         B, C = tokens.shape
         start_pos = jnp.asarray(start_pos, jnp.int32)
         positions = start_pos + jnp.arange(C, dtype=jnp.int32)[None, :]  # [1, C]
@@ -638,7 +654,8 @@ class DecoderLM(ServedModel):
         x, (nk, nv) = lax.scan(
             body, x, (params["blocks"], slab["k"], slab["v"])
         )
-        new_slab = {"k": nk, "v": nv}
+        # exit reshard: the staging slab lives sharded between chunks
+        new_slab = self._tp_slab({"k": nk, "v": nv})
         if not want_logits:
             return None, new_slab
         x = _rms_norm(x, params["ln_f"].astype(dt), cfg.norm_eps)
@@ -678,6 +695,9 @@ class DecoderLM(ServedModel):
 
         cfg = self.cfg
         dt = jnp.dtype(cfg.dtype)
+        # serving-mesh entry gather (see set_serving_mesh)
+        params = self._tp_gather(params)
+        prefix_kv = self._tp_gather(prefix_kv)
         B, W = tokens.shape
         start_pos = jnp.asarray(start_pos, jnp.int32)
         positions = start_pos + jnp.arange(W, dtype=jnp.int32)[None, :]  # [1, W]
@@ -723,7 +743,7 @@ class DecoderLM(ServedModel):
         else:
             x_last = x[jnp.arange(B), jnp.asarray(last_index, jnp.int32)]
         logits = (x_last @ params["unembed"].astype(dt)).astype(jnp.float32)
-        return logits, {"k": sk, "v": sv}
+        return logits, self._tp_slab({"k": sk, "v": sv})
 
     def prefill(self, params, prompt, max_seq: int, last_index=None):
         """Batched prefill: ONE forward over the whole prompt, K/V for all
@@ -740,6 +760,7 @@ class DecoderLM(ServedModel):
 
         cfg = self.cfg
         dt = jnp.dtype(cfg.dtype)
+        params = self._tp_gather(params)  # exact serving-mesh entry gather
         B, Tp = prompt.shape
         x = params["embed"][prompt.astype(jnp.int32)].astype(dt)
         positions = jnp.arange(Tp)
@@ -783,7 +804,7 @@ class DecoderLM(ServedModel):
         else:
             x_last = x[jnp.arange(B), last_index.astype(jnp.int32)]
         logits = (x_last @ params["unembed"].astype(dt)).astype(jnp.float32)
-        return logits, {"k": ck, "v": cv}
+        return logits, self._tp_slab({"k": ck, "v": cv})
 
     def generate(self, params, prompt, max_new_tokens: int, temperature: float = 0.0, seed: int = 0):
         """Greedy/temperature sampling. prompt [B, Tp] -> [B, Tp+N]."""
@@ -904,3 +925,134 @@ class DecoderLM(ServedModel):
             return NamedSharding(mesh, P())
 
         return jax.tree_util.tree_map_with_path(spec_for, params)
+
+    def set_serving_mesh(self, mesh, shard_seq=False):
+        """Arm the sharded-STORAGE / replicated-COMPUTE serving mode
+        (the continuous batcher calls this when it puts params under
+        :meth:`param_sharding`).
+
+        Why not classic psum-TP: GSPMD left alone lowers the
+        row-parallel contractions (``wo``, ``w2``) and the head-split
+        cache attention to partial ops + all-reduce — a different
+        summation association (and different fused codegen) than the
+        single-device executable, so greedy argmax flips the moment a
+        near-tie sits inside reduction noise and the 1-vs-N
+        byte-identity contract breaks. Measured on the 8-virtual-device
+        CPU mesh: bf16 logits drift ~1e-2 and per-operand resharding
+        constraints do NOT close it (fusion still reorders reductions
+        inside ``lax.scan`` bodies).
+
+        Armed instead, every serving executable gathers its sharded
+        operands to full replication at ENTRY (:meth:`_tp_gather` — an
+        all-gather of disjoint shards, pure data movement, zero
+        arithmetic), runs the byte-identical single-device program, and
+        re-shards its cache/slab writes at EXIT (:meth:`_tp_cache` /
+        :meth:`_tp_slab` — a local slice, also exact). Params and the
+        KV cache therefore LIVE at 1/N per chip — the pod-scale
+        capacity win this mesh exists for — while the arithmetic is the
+        single-device program by construction. Compute-parallel TP
+        (psum-based) stays available via the explicit ``tp_axis``
+        shard_map path, which does not carry the identity gate."""
+        self._serving_mesh = mesh
+        self._serving_shard_seq = bool(shard_seq)
+
+    def _tp_gather(self, tree):
+        """Constrain every leaf of ``tree`` to full replication — the
+        exact entry all-gather of the serving mesh mode. No-op when no
+        serving mesh is armed."""
+        mesh = getattr(self, "_serving_mesh", None)
+        if mesh is None:
+            return tree
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def repl(x):
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(*([None] * x.ndim)))
+            )
+
+        return jax.tree_util.tree_map(repl, tree)
+
+    def _tp_cache(self, arr):
+        """Constrain a per-layer decode-cache buffer ``[S, KV, T, Dh]``
+        back to the persistent sharded layout at executable exit (a
+        local slice — exact). The value is pinned to full replication
+        FIRST: without that inner annotation GSPMD propagates the
+        sharded exit spec backward through the attention math and turns
+        the compute into partial-sum tensor parallelism, which is
+        exactly the reduction reordering this mode exists to avoid.
+        No-op unmeshed."""
+        mesh = getattr(self, "_serving_mesh", None)
+        if mesh is None:
+            return arr
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        arr = jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, P(*([None] * arr.ndim)))
+        )
+        return jax.lax.with_sharding_constraint(
+            arr,
+            self.cache_sharding(
+                mesh, shard_seq=getattr(self, "_serving_shard_seq", False)
+            ),
+        )
+
+    def _tp_slab(self, tree):
+        """Constrain a stacked K/V slab ``{"k","v"} [L, S, KV, T, Dh]``
+        back to the sharded staging layout at executable exit, pinning
+        each leaf replicated first to stop backward propagation into
+        the compute (see :meth:`_tp_cache`). No-op unmeshed."""
+        mesh = getattr(self, "_serving_mesh", None)
+        if mesh is None:
+            return tree
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = self.slab_sharding(mesh)
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.with_sharding_constraint(
+                jax.lax.with_sharding_constraint(
+                    a, NamedSharding(mesh, P(*([None] * a.ndim)))
+                ),
+                sh,
+            ),
+            tree
+        )
+
+    def cache_sharding(self, mesh, kv_heads=None, shard_seq=False):
+        """Sharding for one per-layer KV cache buffer ``[S, KV, T, Dh]``.
+
+        The KV-head axis partitions over ``model`` (it is the activation
+        counterpart of the column-parallel wk/wv layout, so attention
+        never gathers the cache), the lane axis S stays data-parallel
+        (replicated — lanes are scheduler state, not a batch collective),
+        and T optionally partitions over ``seq`` when sequence parallelism
+        is on. When the KV head count does not divide the model axis (GQA
+        targets, thin draft models) the heads replicate instead — the
+        byte-identity contract holds either way, sharding only moves
+        where the bytes live."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        kv = self.cfg.n_kv_heads if kv_heads is None else kv_heads
+        model_ax = "model" if "model" in mesh.axis_names else None
+        if model_ax and kv % mesh.shape["model"] != 0:
+            model_ax = None
+        seq_ax = None
+        if shard_seq and "seq" in mesh.axis_names and mesh.shape["seq"] > 1:
+            seq_ax = "seq"
+        return NamedSharding(mesh, P(None, model_ax, seq_ax, None))
+
+    def slab_sharding(self, mesh, kv_heads=None):
+        """Sharding for a stacked staging/transfer slab
+        ``[L, 1, KV, bucket, Dh]`` (the per-request prefill slab layout):
+        same model-axis split of the KV heads as :meth:`cache_sharding`,
+        everything else replicated. Host-side wire bytes (SKV1, tier
+        demote) always gather first, so the wire layout never sees this."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        kv = self.cfg.n_kv_heads if kv_heads is None else kv_heads
+        model_ax = "model" if "model" in mesh.axis_names else None
+        if model_ax and kv % mesh.shape["model"] != 0:
+            model_ax = None
+        return NamedSharding(mesh, P(None, None, model_ax, None, None))

@@ -16,6 +16,15 @@ import os
 from typing import Dict, Optional, Sequence
 
 
+class MeshShapeError(ValueError):
+    """A mesh shape that cannot be built or cannot shard the model.
+
+    Raised by :func:`make_mesh` / :func:`parse_mesh_shape` /
+    :func:`validate_model_dims` instead of letting XLA fail later with an
+    opaque reshape/partition error. Subclasses ``ValueError`` so existing
+    ``except ValueError`` admission paths keep refusing bad shapes."""
+
+
 def factor_devices(n: int) -> Dict[str, int]:
     """Factor n devices into (data, stage, seq, model) prioritising: tp,
     then pp, then dp, then sp. All five strategies stay *wired* at any n
@@ -23,6 +32,8 @@ def factor_devices(n: int) -> Dict[str, int]:
     out. 8 chips -> {data:2, stage:2, seq:1, model:2}; 16 -> all 2;
     32 -> model 4.
     """
+    if not isinstance(n, int) or n < 1:
+        raise MeshShapeError(f"cannot factor {n!r} devices: need a positive int")
     axes = {"data": 1, "stage": 1, "seq": 1, "model": 1}
     order = ["model", "stage", "data", "seq"]
     i = 0
@@ -50,12 +61,99 @@ def make_mesh(shape: Dict[str, int], devices=None):
     if devices is None:
         devices = jax.devices()
     total = 1
-    for s in shape.values():
+    for ax, s in shape.items():
+        if not isinstance(s, int) or s < 1:
+            raise MeshShapeError(
+                f"mesh axis {ax!r}={s!r}: sizes must be positive ints"
+            )
         total *= s
     if total > len(devices):
-        raise ValueError(f"mesh {shape} needs {total} devices, have {len(devices)}")
+        raise MeshShapeError(
+            f"mesh {shape} needs {total} devices, have {len(devices)}"
+        )
+    if len(devices) % total != 0:
+        # a non-dividing shape would silently strand the remainder chips
+        # outside the mesh while XLA still sees them via jax.devices() —
+        # surface the mistake here with the arithmetic spelled out
+        raise MeshShapeError(
+            f"mesh {shape} covers {total} of {len(devices)} devices; "
+            f"{total} does not divide {len(devices)} — the leftover "
+            f"{len(devices) % total} chip(s) would idle"
+        )
     arr = np.asarray(devices[:total]).reshape(tuple(shape.values()))
     return jax.sharding.Mesh(arr, tuple(shape.keys()))
+
+
+def parse_mesh_shape(raw: str) -> Dict[str, int]:
+    """Parse ``"data=2,model=4"`` into an ordered ``{axis: size}`` dict.
+
+    Strict by design — this is the admission-time parser behind the
+    ``seldon.io/mesh`` annotation and the ``mesh_shape`` server knob, so
+    every malformed input gets a typed :class:`MeshShapeError` naming the
+    offending fragment instead of an opaque downstream failure. Accepted
+    axis names are the house mesh axes (data/stage/seq/model); duplicate
+    axes and non-positive sizes are refused."""
+    if not isinstance(raw, str) or not raw.strip():
+        raise MeshShapeError(f"mesh shape {raw!r}: expected 'axis=N,axis=N'")
+    allowed = ("data", "stage", "seq", "model")
+    shape: Dict[str, int] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            raise MeshShapeError(f"mesh shape {raw!r}: empty segment")
+        if "=" not in part:
+            raise MeshShapeError(
+                f"mesh shape segment {part!r}: expected 'axis=N'"
+            )
+        ax, _, val = part.partition("=")
+        ax = ax.strip()
+        if ax not in allowed:
+            raise MeshShapeError(
+                f"mesh axis {ax!r}: must be one of {allowed}"
+            )
+        if ax in shape:
+            raise MeshShapeError(f"mesh axis {ax!r} given twice in {raw!r}")
+        try:
+            size = int(val.strip())
+        except ValueError:
+            raise MeshShapeError(
+                f"mesh axis {ax!r}={val.strip()!r}: size must be an int"
+            ) from None
+        if size < 1:
+            raise MeshShapeError(
+                f"mesh axis {ax!r}={size}: sizes must be positive"
+            )
+        shape[ax] = size
+    return shape
+
+
+def validate_model_dims(
+    shape: Dict[str, int],
+    n_heads: int,
+    d_ff: int,
+    n_kv_heads: Optional[int] = None,
+) -> None:
+    """Reject a mesh whose ``model`` axis cannot shard the hard-split
+    dims. Attention heads and the FFN hidden dim are partitioned (not
+    replicated) under the TP layout, so ``model`` must divide both —
+    otherwise XLA fails deep inside the first sharded dispatch with an
+    unactionable partition error. KV heads are allowed to be indivisible
+    (GQA targets / thin drafts): the cache layer replicates them instead,
+    so that is NOT an error here."""
+    tp = int(shape.get("model", 1))
+    if tp <= 1:
+        return
+    if n_heads % tp != 0:
+        raise MeshShapeError(
+            f"mesh model={tp} does not divide n_heads={n_heads}; "
+            "attention heads are hard-sharded over the model axis"
+        )
+    if d_ff % tp != 0:
+        raise MeshShapeError(
+            f"mesh model={tp} does not divide d_ff={d_ff}; "
+            "the FFN hidden dim is hard-sharded over the model axis"
+        )
+    del n_kv_heads  # indivisible KV heads replicate — see cache_sharding
 
 
 def initialize_distributed(

@@ -11,6 +11,18 @@ Model URI layout: same ``jax_config.json`` as jaxserver with
     slots            decode lanes (default 8)
     max_seq          cache length override
     shard_cache_seq  shard the KV cache length over the mesh's `seq` axis
+    mesh_shape       sharded serving: build a device mesh at load and
+                     serve ONE model partitioned across it — params take
+                     the TP layout (DecoderLM.param_sharding), every KV
+                     slab shards its heads axis over ``model`` while the
+                     lane axis stays data-parallel. ``"data=2,model=4"``
+                     (strict axis=size pairs, typed MeshShapeError on
+                     malformed/non-dividing shapes) or ``"auto"``
+                     (factor jax.device_count() into the 2D data x model
+                     serving mesh). Greedy AND seeded outputs stay
+                     byte-identical to 1-device — see docs/generate.md
+                     "Sharded serving". Ignored when an explicit ``mesh``
+                     object is injected (the engine placement path)
     steps_per_poll   decode steps fused into one device burst (default 8;
                      pow2-floored — the value actually dispatched is
                      surfaced as ``steps_per_poll_effective`` in server
@@ -217,6 +229,7 @@ class GenerateServer(SeldonComponent):
         slots: int = 8,
         max_seq: Optional[int] = None,
         shard_cache_seq: bool = False,
+        mesh_shape: Optional[str] = None,
         steps_per_poll: int = 8,
         fused_steps_per_dispatch: int = 0,
         pipeline_depth: int = 3,
@@ -299,6 +312,21 @@ class GenerateServer(SeldonComponent):
                 "(the draft cache cannot cross the KV transport)"
             )
         self._mesh = mesh
+        # sharded-serving knob: parsed STRICTLY at construction (the
+        # admission-time contract — a malformed shape must refuse here,
+        # not as an opaque XLA failure mid-load). "auto" defers the
+        # factoring to load(), when jax.device_count() is known.
+        mesh_shape = (mesh_shape or "").strip() if isinstance(
+            mesh_shape, str
+        ) else mesh_shape
+        self._mesh_shape: Optional[Any] = None
+        if mesh_shape:
+            if str(mesh_shape).lower() == "auto":
+                self._mesh_shape = "auto"
+            else:
+                from ..parallel.mesh import parse_mesh_shape
+
+                self._mesh_shape = parse_mesh_shape(str(mesh_shape))
         self._slots = int(slots)
         self._max_seq = int(max_seq) if max_seq else None
         self._shard_cache_seq = bool(shard_cache_seq) if not isinstance(
@@ -374,6 +402,37 @@ class GenerateServer(SeldonComponent):
             raise RuntimeError(
                 f"model family {getattr(self._model, '__class__', None)} "
                 "does not support generate(); use family 'llm'"
+            )
+        if self._mesh is None and self._mesh_shape is not None:
+            # build the serving mesh from the knob: an injected mesh
+            # object (the engine placement path) always wins, so a
+            # reconciler-placed member never double-builds
+            import jax
+
+            from ..parallel.mesh import (
+                factor_devices, make_mesh, validate_model_dims,
+            )
+
+            if self._mesh_shape == "auto":
+                f = factor_devices(jax.device_count())
+                # collapse to the 2D data x model serving mesh: generate
+                # serving runs no pipeline axis, and the seq axis only
+                # pays with shard_cache_seq (opt-in, explicit shapes)
+                shape = {
+                    "data": f["data"] * f["stage"] * f["seq"],
+                    "model": f["model"],
+                }
+            else:
+                shape = dict(self._mesh_shape)
+            cfg = self._model.cfg
+            validate_model_dims(
+                shape, int(cfg.n_heads), int(cfg.d_ff),
+                n_kv_heads=int(getattr(cfg, "n_kv_heads", 0) or 0),
+            )
+            self._mesh = make_mesh(shape)
+            logger.info(
+                "generateserver: sharded serving mesh %s over %d device(s)",
+                shape, self._mesh.devices.size,
             )
         draft_model = None
         draft_params = None
@@ -1454,6 +1513,24 @@ class GenerateServer(SeldonComponent):
             "type": "GAUGE", "key": "gen_batcher_healthy",
             "value": 1.0 if self.batcher.health == "serving" else 0.0,
         })
+        if self.batcher.mesh is not None:
+            # sharded serving: mesh shape + the per-chip footprint levels
+            # (engine_metrics maps these to the first-class
+            # seldon_engine_mesh_* gauges) — param_shard_bytes vs the
+            # global param bytes is the >1-chip-model headroom proof
+            mshape = dict(self.batcher.mesh.shape)
+            out.extend([
+                {"type": "GAUGE", "key": "gen_mesh_devices",
+                 "value": float(self.batcher.mesh.devices.size)},
+                {"type": "GAUGE", "key": "gen_mesh_data",
+                 "value": float(mshape.get("data", 1))},
+                {"type": "GAUGE", "key": "gen_mesh_model",
+                 "value": float(mshape.get("model", 1))},
+                {"type": "GAUGE", "key": "gen_mesh_param_shard_bytes",
+                 "value": float(self.batcher._param_shard_bytes)},
+                {"type": "GAUGE", "key": "gen_mesh_kv_shard",
+                 "value": float(self.batcher._kv_shard)},
+            ])
         if s.get("batcher_restarts"):
             out.append(delta("gen_batcher_restarts", s["batcher_restarts"]))
         if s.get("peer_ejections"):

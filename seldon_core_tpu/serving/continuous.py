@@ -653,9 +653,16 @@ class ContinuousBatcher:
             """Per-layer cache [S, KV, T, Dh]: KV heads over `model` (tp),
             cache length over `seq` (long context spans ICI). KV head
             counts that don't divide the model axis (GQA targets, thin
-            drafts) replicate the KV dim instead of failing device_put."""
+            drafts) replicate the KV dim instead of failing device_put.
+            The layout itself lives on the model (DecoderLM.cache_sharding)
+            so it stays next to param_sharding; this closure only binds
+            the mesh + seq knob for the supervisor's crash-restart."""
             if mesh is None:
                 return None
+            if hasattr(model, "cache_sharding"):
+                return model.cache_sharding(
+                    mesh, kv_heads=kv_heads, shard_seq=shard_cache_seq
+                )
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             model_ax = "model" if "model" in mesh.axis_names else None
@@ -710,6 +717,13 @@ class ContinuousBatcher:
 
         params = serving_cast(model, params)
         if mesh is not None:
+            if hasattr(model, "set_serving_mesh"):
+                # arm sharded-STORAGE / replicated-COMPUTE serving BEFORE
+                # any executable traces: every entry gathers params/cache
+                # to full replication (exact all-gather, no arithmetic) so
+                # the math is the byte-identical 1-device program, and
+                # every exit re-shards cache writes (models/llm.py)
+                model.set_serving_mesh(mesh, shard_seq=shard_cache_seq)
             params = jax.device_put(params, model.param_sharding(mesh, params))
         self.params = params
         # the cast memo pins the boot params' cast leaves; a weight swap
@@ -720,11 +734,38 @@ class ContinuousBatcher:
         # constructor used, params untouched
         self._cache_sharding_for = cache_sharding_for
         self._unstack_cache = unstack_cache
+        # staging/transfer slab layout [L, 1, KV, bucket, Dh]: every
+        # host->device slab upload (remote admit, tier promote, copy-back
+        # resume, fresh chunked-prefill slab) lands pre-sharded through
+        # _upload_slab so the insert/splice executables never reshard
+        self._slab_sharding = (
+            model.slab_sharding(mesh)
+            if mesh is not None and hasattr(model, "slab_sharding")
+            else None
+        )
+        # per-shard split factors for the pressure ledger: how many ways
+        # the persistent cache's bytes divide across chips (model axis,
+        # plus seq when the cache length is sharded) — 1 when unmeshed
+        # or when indivisible KV heads forced replication
+        self._kv_model_shard = 1
+        self._kv_seq_shard = 1
+        if mesh is not None:
+            mshape = dict(mesh.shape)
+            tp = int(mshape.get("model", 1))
+            kvh = int(getattr(model.cfg, "n_kv_heads", 0) or 0)
+            if tp > 1 and kvh and kvh % tp == 0:
+                self._kv_model_shard = tp
+            sq = int(mshape.get("seq", 1))
+            if shard_cache_seq and sq > 1:
+                self._kv_seq_shard = sq
+        self._kv_shard = self._kv_model_shard * self._kv_seq_shard
         self._draft_params = None
         self._draft_cache = None
         if self.speculate_tokens > 0:
             dp = serving_cast(draft_model, draft_params)
             if mesh is not None:
+                if hasattr(draft_model, "set_serving_mesh"):
+                    draft_model.set_serving_mesh(mesh)
                 dp = jax.device_put(dp, draft_model.param_sharding(mesh, dp))
             self._draft_params = dp
         self._alloc_device_state()
@@ -1218,6 +1259,25 @@ class ContinuousBatcher:
         )
         self._param_bytes = sum(
             leaf.nbytes
+            for leaf in jax.tree_util.tree_leaves(self.params)
+            if hasattr(leaf, "nbytes")
+        )
+
+        # per-chip param footprint under the mesh layout: each leaf's
+        # shard shape is pure sharding metadata (no device sync), so this
+        # is exact even for the mixed partitioned/replicated TP layout.
+        # Equal to _param_bytes when unmeshed/fully replicated.
+        def _leaf_shard_bytes(leaf):
+            sh = getattr(leaf, "sharding", None)
+            if sh is None or not hasattr(sh, "shard_shape"):
+                return leaf.nbytes
+            n = leaf.dtype.itemsize
+            for d in sh.shard_shape(leaf.shape):
+                n *= d
+            return n
+
+        self._param_shard_bytes = sum(
+            _leaf_shard_bytes(leaf)
             for leaf in jax.tree_util.tree_leaves(self.params)
             if hasattr(leaf, "nbytes")
         )
@@ -1931,9 +1991,11 @@ class ContinuousBatcher:
             if parent is not None and parent.trace_id != "0":
                 req.trace = (parent.trace_id, parent.span_id)
         # device upload happens HERE, on the caller thread: the H2D copy
-        # overlaps whatever burst the scheduler is running
+        # overlaps whatever burst the scheduler is running (pre-sharded
+        # under a mesh — wire bytes stay layout-independent, the shards
+        # form on upload)
         req.remote = {
-            "slab": {"k": jnp.asarray(k), "v": jnp.asarray(v)},
+            "slab": self._upload_slab({"k": k, "v": v}),
             "first": int(meta["first_token"]),
             "key": jnp.asarray(key_arr),
             "covered": covered,
@@ -2770,6 +2832,29 @@ class ContinuousBatcher:
                 "(k=%s x attn=%s x group_sizes=%s)",
                 compiled, fks, attn_lens, gbs or [self.slots],
             )
+        if self.mesh is not None:
+            # sharded-serving census, same PR-13 contract as the fused
+            # line: every executable above just compiled against the
+            # MESH layouts, so a partitioned-leaf or per-shard-byte jump
+            # between runs means a layout change moved bytes across
+            # chips. One designed sync makes the census report compiled
+            # executables, not queued ones.
+            self._cache["k"][0].block_until_ready()  # seldon-lint: disable=host-sync-hot-path (sharded warm census: intentional sync while the loop is idle so the census reports compiled sharded executables)
+            leaves = [
+                leaf for leaf in jax.tree_util.tree_leaves(self.params)
+                if hasattr(leaf, "sharding")
+            ]
+            partitioned = sum(
+                1 for leaf in leaves
+                if not leaf.sharding.is_fully_replicated
+            )
+            logger.info(
+                "warm: sharded serving census: mesh=%s devices=%d "
+                "partitioned_params=%d/%d param_shard_bytes=%d kv_shard=%d",
+                dict(self.mesh.shape), self.mesh.devices.size,
+                partitioned, len(leaves), self._param_shard_bytes,
+                self._kv_shard,
+            )
         # warm left garbage in cur_tok/pos; reset the host-visible lane
         # state so the first admissions start from a clean slate (the
         # device cache needs no scrub — see residue invariant above)
@@ -3037,13 +3122,40 @@ class ContinuousBatcher:
 
     def _new_slab(self, bucket: int):
         """Fresh staging slab in the cache_one layout the lane insert
-        consumes: ``{"k","v"}`` of ``[L, 1, KV, bucket, Dh]``."""
+        consumes: ``{"k","v"}`` of ``[L, 1, KV, bucket, Dh]`` — allocated
+        pre-sharded under a mesh so chunked prefill writes shards in
+        place instead of resharding on the first chunk."""
+        import jax
         import jax.numpy as jnp
 
         cfg = self.model.cfg
         shape = (cfg.n_layers, 1, cfg.n_kv_heads, bucket, cfg.head_dim)
         dt = jnp.dtype(getattr(self.model, "compute_dtype", cfg.dtype))
-        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        slab = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        if self._slab_sharding is not None:
+            slab = {
+                name: jax.device_put(a, self._slab_sharding)
+                for name, a in slab.items()
+            }
+        return slab
+
+    def _upload_slab(self, host: Dict[str, Any]) -> Dict[str, Any]:
+        """Host->device K/V slab upload (``[L, 1, KV, T, Dh]``) honoring
+        the mesh slab layout. Every wire/tier slab arrives as contiguous
+        host bytes (SKV1 and the host tier are layout-independent by
+        contract); under a mesh the upload scatters each chip's KV-head
+        shard directly so the downstream insert/splice executables see
+        the same layout the persistent cache uses. Unmeshed this is the
+        plain ``jnp.asarray`` H2D copy it always was."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._slab_sharding is None:
+            return {"k": jnp.asarray(host["k"]), "v": jnp.asarray(host["v"])}
+        return {
+            "k": jax.device_put(host["k"], self._slab_sharding),
+            "v": jax.device_put(host["v"], self._slab_sharding),
+        }
 
     @scheduler_only
     def _start_chunked(self, slot: int, req: GenRequest, hit=None,
@@ -3452,8 +3564,6 @@ class ContinuousBatcher:
         that costs a PCIe copy instead of a re-prefill. None on miss,
         corruption (entry already dropped), or when the usability caps
         say the splice would not win (see :meth:`tier_prefix_lookup`)."""
-        import jax.numpy as jnp
-
         from .disagg import prompt_hash
 
         idx = self._prefix_index
@@ -3464,7 +3574,7 @@ class ContinuousBatcher:
             return None
         m, meta, host = hit
         entry_tokens = [int(t) for t in meta.get("tokens") or []]
-        slab_dev = {"k": jnp.asarray(host["k"]), "v": jnp.asarray(host["v"])}
+        slab_dev = self._upload_slab(host)
         nbytes = int(host["k"].nbytes) + int(host["v"].nbytes)
         self.stats["prefix_evicted"] += idx.insert(
             entry_tokens, slab_dev, nbytes
@@ -3511,8 +3621,6 @@ class ContinuousBatcher:
         exactly like a remote admit's slab upload), so the ordinary
         match/splice machinery — and the transfer-dedup consult — serve
         it from here on. Returns the entry's token count."""
-        import jax.numpy as jnp
-
         from .disagg import prompt_hash
 
         idx = self._prefix_index
@@ -3521,7 +3629,7 @@ class ContinuousBatcher:
         entry_tokens = [int(t) for t in meta.get("tokens") or []]
         if not entry_tokens:
             return 0
-        slab_dev = {"k": jnp.asarray(host["k"]), "v": jnp.asarray(host["v"])}
+        slab_dev = self._upload_slab(host)
         nbytes = int(host["k"].nbytes) + int(host["v"].nbytes)
         evicted = idx.insert(entry_tokens, slab_dev, nbytes)
         with self._export_lock:
@@ -3589,11 +3697,17 @@ class ContinuousBatcher:
 
     def pressure_summary(self) -> Optional[Dict[str, Any]]:
         """Ledger snapshot for metrics/flight dumps; None when the
-        pressure subsystem is off (budget 0)."""
+        pressure subsystem is off (budget 0). Under a mesh the snapshot
+        also carries the shard factors the ledger divided by, so an
+        operator reading used_bytes knows it is PER-CHIP occupancy."""
         pc = self._pressure
         if pc.budget_bytes <= 0 and not pc.stats["budget_changes"]:
             return None
-        return pc.summary()
+        out = pc.summary()
+        if self.mesh is not None:
+            out["kv_shard"] = self._kv_shard
+            out["param_shard_bytes"] = self._param_shard_bytes
+        return out
 
     def _spec_active(self) -> bool:
         """Speculation is configured AND not cancelled by the pressure
@@ -3608,22 +3722,34 @@ class ContinuousBatcher:
         resident), chunked-prefill staging slabs, the radix prefix
         cache's published bytes, and a staged hot-swap's double-buffered
         params. Pure host arithmetic over at most ``slots`` entries —
-        cheap enough to run every poll."""
+        cheap enough to run every poll.
+
+        Under a mesh every component is priced **per shard**: array
+        ``.nbytes`` is the GLOBAL byte count of a sharded buffer, but the
+        watermark guards a single chip's HBM, so KV components divide by
+        the cache's shard factor (model axis x seq when sharded — same
+        factor for staging/prefix slabs, which carry the model-axis split)
+        and a staged swap scales by the param layout's per-shard fraction.
+        Unmeshed, every factor is 1 and the arithmetic is unchanged."""
         per_tok = self._kv_key_bytes
         if self.speculate_tokens > 0 and not self._spec_suppressed:
             per_tok += self._draft_kv_key_bytes
         decode = sum(
             self._attn_need(pos) for pos in self._pos_host.values()
-        ) * per_tok
+        ) * per_tok // self._kv_shard
         staging = sum(
             job.bucket for job in self._chunked.values()
-        ) * self._kv_key_bytes
+        ) * self._kv_key_bytes // self._kv_model_shard
         prefix = (
             self._prefix_index.total_bytes
             if self._prefix_index is not None else 0
-        )
+        ) // self._kv_model_shard
         swap = self._pending_swap
         swap_bytes = getattr(swap, "nbytes", 0) if swap is not None else 0
+        if swap_bytes and self._param_bytes:
+            swap_bytes = (
+                swap_bytes * self._param_shard_bytes // self._param_bytes
+            )
         return {
             "decode": decode, "staging": staging,
             "prefix": prefix, "swap": swap_bytes,
@@ -4002,8 +4128,6 @@ class ContinuousBatcher:
         fallback (entry evicted, stale version, or corrupt — the tier
         already dropped a corrupt entry, typed, before any lane state
         was touched)."""
-        import jax.numpy as jnp
-
         from ..tracing import device_trace
         from .disagg import DisaggError, prompt_hash
 
@@ -4023,7 +4147,7 @@ class ContinuousBatcher:
                 meta.get("pos"), end_pos,
             )
             return False
-        slab_dev = {"k": jnp.asarray(host["k"]), "v": jnp.asarray(host["v"])}
+        slab_dev = self._upload_slab(host)
         with device_trace("gen.lane_insert"):
             self._cache, self._cur_tok, self._pos, self._keys = (
                 self._insert_fn(

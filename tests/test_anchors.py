@@ -219,6 +219,29 @@ def test_bench_migration_scenario_anchor():
     assert "llm_1b_migration" in gen_src
 
 
+def test_bench_sharded_scenario_anchor():
+    """The ``llm_1b_sharded`` bench scenario is an acceptance artifact
+    (one checkpoint served 1-device vs mesh-sharded with params + KV
+    resident at 1/N per chip: greedy AND seeded byte-identity probes,
+    sharded vs plain tokens/s and p50 side-by-side with the no-slower
+    verdict, and the per-shard HBM ledger bytes — all read from its
+    entry): it must stay wired through BOTH model tiers, and the
+    numbers-table generator must know its key."""
+    import seldon_core_tpu.modelbench as modelbench
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    mb_src = open(modelbench.__file__).read()
+    assert mb_src.count('results["llm_1b_sharded"]') >= 2  # tiny + chip
+    assert hasattr(modelbench, "bench_sharded")
+    # the entry asserts the acceptance bits like prior scenarios
+    assert '"greedy_identical": greedy_identical' in mb_src
+    assert '"sampled_identical": sampled_identical' in mb_src
+    assert '"p50_no_slower"' in mb_src
+    assert '"param_shard_bytes": param_shard_bytes' in mb_src
+    gen_src = open(os.path.join(root, "tools", "gen_arch_numbers.py")).read()
+    assert "llm_1b_sharded" in gen_src
+
+
 def test_bench_kvtier_scenario_anchor():
     """The ``llm_1b_kvtier`` bench scenario is an acceptance artifact
     (the spill-vs-destroy proof: tier-off resumes replay tokens, tier-on

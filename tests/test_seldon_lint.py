@@ -460,6 +460,50 @@ def test_host_sync_not_flagging_cold_paths_or_metadata(tmp_path):
     assert res.findings == []
 
 
+def test_host_sync_not_flagging_sharding_layout_metadata(tmp_path):
+    """The sharded-serving must-not-flag twin: ``.sharding`` layout
+    reads off a jitted result (is_fully_replicated / shard_shape — the
+    warm census and the per-shard ledger arithmetic) are pure metadata,
+    exempt exactly like .nbytes/.shape."""
+    res = lint(tmp_path, JIT_PREAMBLE + """
+        def _loop(self):
+            slab = self._burst_fn(self.params, self.cache, 8)
+            replicated = int(slab.sharding.is_fully_replicated)
+            parts = int(slab.sharding.shard_shape(slab.shape)[0])
+            return replicated, parts
+    """, rules=["host-sync-hot-path"])
+    assert res.findings == []
+
+
+def test_host_sync_flags_unjustified_sharded_census_sync(tmp_path):
+    """The sharded-serving must-flag twin: the census's
+    block_until_ready on a scheduler-reachable path WITHOUT a justified
+    suppression stays a finding — the .sharding metadata exemption must
+    not swallow the real sync next to it."""
+    res = lint(tmp_path, JIT_PREAMBLE + """
+        def _loop(self):
+            self._census()
+
+        def _census(self):
+            slab = self._burst_fn(self.params, self.cache, 8)
+            slab.block_until_ready()  # unjustified sync
+            return int(slab.sharding.is_fully_replicated)
+    """, rules=["host-sync-hot-path"])
+    assert rules_of(res) == ["host-sync-hot-path"]
+    assert "_census" in res.findings[0].message
+
+
+def test_host_sync_repo_sharded_warm_census_carries_suppression():
+    """The sharded warm census in serving/continuous.py performs one
+    designed sync so it reports COMPILED executables; it must keep its
+    justified suppression (dropping it fails the CI lint gate — this
+    pins the contract in the suite too)."""
+    src = open(os.path.join(
+        REPO, "seldon_core_tpu", "serving", "continuous.py"
+    )).read()
+    assert ("disable=host-sync-hot-path (sharded warm census" in src)
+
+
 # -- retrace-hazard ---------------------------------------------------------
 
 

@@ -1,0 +1,593 @@
+"""Pod-scale sharded generate serving: one model across many chips.
+
+The house gate, at mesh scale: greedy AND seeded-sampling outputs are
+byte-identical between a 1-device (unmeshed) server and an N-device
+server whose params AND KV cache are sharded over a 2D data x model
+mesh — across plain decode, prefix splice, chunked prefill, fused
+multi-step decode, and a pressure preemption/resume cycle. Plus the
+typed-refusal contract (``MeshShapeError`` at construction, never an
+opaque XLA failure mid-load), the ``seldon.io/mesh`` annotation
+round-trip (apply -> reconciler -> engine mesh), and per-shard HBM
+ledger accounting on a 2x2 mesh.
+
+Runs on the 8-virtual-device CPU backend forced by conftest.py
+(``--xla_force_host_platform_device_count=8``).
+"""
+
+import json
+import time
+
+import jax
+import pytest
+
+from seldon_core_tpu.models.llm import DecoderLM
+from seldon_core_tpu.parallel.mesh import (
+    MeshShapeError,
+    factor_devices,
+    make_mesh,
+    parse_mesh_shape,
+    validate_model_dims,
+)
+from seldon_core_tpu.resilience.faults import FaultInjector
+from seldon_core_tpu.servers.generateserver import GenerateServer
+from seldon_core_tpu.serving.continuous import ContinuousBatcher
+
+LLM_TINY = {
+    "vocab_size": 64,
+    "d_model": 32,
+    "n_layers": 2,
+    "n_heads": 4,
+    "n_kv_heads": 4,
+    "d_ff": 64,
+    "max_seq": 64,
+}
+
+MESH_SHAPE = "data=2,model=4"
+
+PROMPTS = [[3, 17, 42, 11, 7], [1, 2, 3], [9, 8, 7, 6], [5, 5, 5, 5, 5, 5]]
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("llm")
+    (d / "jax_config.json").write_text(
+        json.dumps({"family": "llm", "config": LLM_TINY})
+    )
+    return str(d)
+
+
+def gen(server, prompt, n, temperature=0.0, seed=0):
+    out = server.predict({
+        "prompt_tokens": [list(prompt)],
+        "max_new_tokens": n,
+        "temperature": temperature,
+        "seed": seed,
+    }, [])
+    return out["tokens"][0]
+
+
+def twin_servers(model_dir, **kw):
+    """The 1-vs-N probe pair: identical knobs, identical checkpoint,
+    the only difference is the serving mesh."""
+    kw.setdefault("slots", 4)
+    kw.setdefault("steps_per_poll", 2)
+    plain = GenerateServer(model_uri=model_dir, **kw)
+    shard = GenerateServer(model_uri=model_dir, mesh_shape=MESH_SHAPE, **kw)
+    plain.load()
+    shard.load()
+    return plain, shard
+
+
+def close_pair(plain, shard):
+    plain.batcher.close()
+    shard.batcher.close()
+
+
+# -- mesh.py hardening: typed refusals, not opaque XLA failures --------------
+
+
+def test_mesh_shape_error_is_a_value_error():
+    # existing `except ValueError` admission paths keep catching it
+    assert issubclass(MeshShapeError, ValueError)
+
+
+def test_factor_devices_rejects_nonpositive():
+    with pytest.raises(MeshShapeError):
+        factor_devices(0)
+    with pytest.raises(MeshShapeError):
+        factor_devices(-4)
+    with pytest.raises(MeshShapeError):
+        factor_devices("8")
+
+
+def test_make_mesh_rejects_bad_axis_sizes():
+    with pytest.raises(MeshShapeError):
+        make_mesh({"model": 0})
+    with pytest.raises(MeshShapeError):
+        make_mesh({"data": -2})
+    with pytest.raises(MeshShapeError):
+        make_mesh({"model": "4"})
+
+
+def test_make_mesh_rejects_oversubscription():
+    n = jax.device_count()
+    with pytest.raises(MeshShapeError):
+        make_mesh({"data": n * 2})
+
+
+def test_make_mesh_rejects_stranded_chips():
+    # 3 of 8: the leftover chips would idle silently — refuse with a
+    # message that says so instead of an opaque reshape failure
+    assert jax.device_count() == 8
+    with pytest.raises(MeshShapeError, match="divide"):
+        make_mesh({"data": 3})
+    with pytest.raises(MeshShapeError):
+        make_mesh({"data": 5, "model": 1})
+
+
+def test_make_mesh_accepts_dividing_sub_block():
+    mesh = make_mesh({"data": 2, "model": 2})
+    assert mesh.devices.size == 4
+    assert dict(mesh.shape) == {"data": 2, "model": 2}
+
+
+def test_parse_mesh_shape_good():
+    assert parse_mesh_shape("data=2,model=4") == {"data": 2, "model": 4}
+    assert parse_mesh_shape(" model=8 ") == {"model": 8}
+    assert parse_mesh_shape("data=1,stage=2,seq=1,model=4") == {
+        "data": 1, "stage": 2, "seq": 1, "model": 4,
+    }
+
+
+@pytest.mark.parametrize("raw", [
+    "",                    # empty
+    "data",                # missing =
+    "data=",               # missing size
+    "data=x",              # non-int
+    "data=0",              # non-positive
+    "data=-2",             # non-positive
+    "data=2,data=4",       # duplicate axis
+    "rows=2",              # unknown axis
+    "data=2,,model=4",     # empty segment
+    "data=2.5",            # non-int
+])
+def test_parse_mesh_shape_refuses_malformed(raw):
+    with pytest.raises(MeshShapeError):
+        parse_mesh_shape(raw)
+
+
+def test_validate_model_dims():
+    validate_model_dims({"data": 2, "model": 4}, 4, 64)
+    with pytest.raises(MeshShapeError, match="n_heads"):
+        validate_model_dims({"model": 8}, 4, 64)
+    with pytest.raises(MeshShapeError, match="d_ff"):
+        validate_model_dims({"model": 4}, 4, 66)
+    # indivisible KV heads are NOT an error: the cache replicates on the
+    # model axis (GQA fallback) while attention heads still shard
+    validate_model_dims({"model": 4}, 4, 64, n_kv_heads=2)
+
+
+def test_cache_sharding_gqa_replication_fallback():
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh({"data": 2, "model": 4})
+    cfg = dict(LLM_TINY)
+    cfg["n_kv_heads"] = 2  # 2 % 4 != 0 -> the KV cache must replicate
+    model = DecoderLM(**cfg)
+    assert tuple(model.cache_sharding(mesh).spec) == (None, None, None, None)
+    assert tuple(model.slab_sharding(mesh).spec) == (
+        None, None, None, None, None,
+    )
+    # with divisible KV heads the heads axis genuinely shards
+    full = DecoderLM(**LLM_TINY)
+    assert tuple(full.cache_sharding(mesh).spec) == (
+        None, "model", None, None,
+    )
+    assert tuple(full.slab_sharding(mesh).spec) == (
+        None, None, "model", None, None,
+    )
+
+
+# -- the mesh_shape knob: strict at construction -----------------------------
+
+
+def test_mesh_shape_malformed_refused_at_construction(model_dir):
+    with pytest.raises(MeshShapeError):
+        GenerateServer(model_uri=model_dir, mesh_shape="rows=2")
+    with pytest.raises(MeshShapeError):
+        GenerateServer(model_uri=model_dir, mesh_shape="data=0")
+
+
+def test_mesh_shape_model_indivisible_refused_at_load(model_dir):
+    # n_heads=4 cannot shard over model=8: typed refusal at load, before
+    # any executable is built
+    s = GenerateServer(model_uri=model_dir, slots=2, mesh_shape="model=8")
+    with pytest.raises(MeshShapeError, match="n_heads"):
+        s.load()
+
+
+def test_mesh_shape_auto_builds_data_model_mesh(model_dir):
+    s = GenerateServer(model_uri=model_dir, slots=2, steps_per_poll=2,
+                       mesh_shape="auto")
+    s.load()
+    try:
+        # factor_devices(8) collapsed to the 2D serving mesh
+        assert dict(s.batcher.mesh.shape) == {"data": 4, "model": 2}
+        out = gen(s, [1, 2, 3], 4)
+        assert len(out) == 3 + 4
+    finally:
+        s.batcher.close()
+
+
+# -- byte-identity: 1-device vs N-device -------------------------------------
+
+
+def test_plain_decode_byte_identity(model_dir):
+    plain, shard = twin_servers(model_dir)
+    try:
+        assert plain.batcher.mesh is None
+        assert dict(shard.batcher.mesh.shape) == {"data": 2, "model": 4}
+        # the served params are REALLY sharded over all 8 devices
+        leaves = jax.tree_util.tree_leaves(shard.batcher.params)
+        partitioned = [
+            leaf for leaf in leaves
+            if len(leaf.sharding.device_set) == 8
+            and not leaf.sharding.is_fully_replicated
+        ]
+        assert partitioned, "no param leaf is sharded over the mesh"
+        # ... and so is the KV cache (heads axis on 'model')
+        k0 = shard.batcher._cache["k"][0]
+        assert not k0.sharding.is_fully_replicated
+        for p in PROMPTS:
+            assert gen(plain, p, 16) == gen(shard, p, 16)
+        for i, p in enumerate(PROMPTS):
+            a = gen(plain, p, 12, temperature=0.8, seed=11 + i)
+            b = gen(shard, p, 12, temperature=0.8, seed=11 + i)
+            assert a == b
+    finally:
+        close_pair(plain, shard)
+
+
+def test_prefix_splice_byte_identity(model_dir):
+    plain, shard = twin_servers(
+        model_dir,
+        prefix_cache_hbm_bytes=1 << 20,
+        prefix_cache_min_tokens=4,
+    )
+    try:
+        stem = [7, 3, 9, 4, 1, 8, 2, 6]
+        first = [gen(s, stem, 12) for s in (plain, shard)]
+        assert first[0] == first[1]
+        # second pass splices the published prefix on BOTH servers; the
+        # sharded splice uploads the host slab through _upload_slab with
+        # the mesh layout and must not perturb a single token
+        tails = [stem + [5], stem + [9, 9]]
+        for tail in tails:
+            assert gen(plain, tail, 12) == gen(shard, tail, 12)
+        assert shard.batcher.stats["prefix_hits"] >= 1
+        assert plain.batcher.stats["prefix_hits"] >= 1
+    finally:
+        close_pair(plain, shard)
+
+
+def test_chunked_prefill_byte_identity(model_dir):
+    plain, shard = twin_servers(model_dir, prefill_chunk=8)
+    try:
+        long_prompt = [(i * 7 + 3) % 61 for i in range(30)]
+        assert gen(plain, long_prompt, 16) == gen(shard, long_prompt, 16)
+        a = gen(plain, long_prompt, 10, temperature=0.8, seed=5)
+        b = gen(shard, long_prompt, 10, temperature=0.8, seed=5)
+        assert a == b
+    finally:
+        close_pair(plain, shard)
+
+
+def test_fused_decode_byte_identity(model_dir):
+    plain, shard = twin_servers(model_dir, fused_steps_per_dispatch=4)
+    try:
+        for p in PROMPTS[:2]:
+            assert gen(plain, p, 16) == gen(shard, p, 16)
+        a = gen(plain, PROMPTS[0], 12, temperature=0.8, seed=3)
+        b = gen(shard, PROMPTS[0], 12, temperature=0.8, seed=3)
+        assert a == b
+    finally:
+        close_pair(plain, shard)
+
+
+def test_pressure_preemption_resume_byte_identity(model_dir):
+    """A preempt/recompute-resume cycle ON THE SHARDED server: the
+    preempted lane's checkpoint and resume path run against the meshed
+    cache, and outputs still match the unpressured 1-device run."""
+    plain, shard = twin_servers(model_dir, hbm_ledger_bytes=1 << 40)
+    try:
+        refs = [gen(plain, p, 24) for p in PROMPTS]
+        b = shard.batcher
+        futs = [b.submit(p, max_new_tokens=24, temperature=0.0)
+                for p in PROMPTS]
+        deadline = time.monotonic() + 60
+        while (len(b._active) + len(b._chunked) < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+        # arm a mid-run ledger shrink to ~1.3 live lanes via the real
+        # chaos wiring (the test_pressure.py idiom, at mesh scale). The
+        # meshed ledger accounts PER-SHARD bytes, so the lane cost the
+        # controller sees is the full-cache figure over _kv_shard.
+        shrink = int(1.3 * b._attn_need(b.max_seq) * b._kv_key_bytes
+                     / b._kv_shard)
+        inj = FaultInjector([], pressure={
+            "shrink_to_bytes": shrink,
+            "after_polls": b._work_poll_count + 1,
+            "restore_after_polls": 12,
+        })
+        b.pressure_hook = inj.pressure_hook()
+        outs = [f.result(timeout=120) for f in futs]
+        assert outs == refs
+        assert b.stats["preemptions"] >= 1
+        assert b.stats["preempt_resumes"] == b.stats["preemptions"]
+    finally:
+        close_pair(plain, shard)
+
+
+# -- observability: mesh gauges + warm census --------------------------------
+
+
+def test_mesh_gauges_exposed(model_dir):
+    s = GenerateServer(model_uri=model_dir, slots=2, steps_per_poll=2,
+                       mesh_shape=MESH_SHAPE)
+    s.load()
+    try:
+        gen(s, [1, 2, 3], 4)
+        m = {d["key"]: d["value"] for d in s.metrics()}
+        assert m["gen_mesh_devices"] == 8
+        assert m["gen_mesh_data"] == 2
+        assert m["gen_mesh_model"] == 4
+        assert m["gen_mesh_kv_shard"] == 4  # n_kv_heads=4 over model=4
+        # per-shard param bytes: strictly less than global (something is
+        # partitioned), at least the fully-sharded floor
+        shard_bytes = m["gen_mesh_param_shard_bytes"]
+        total = s.batcher._param_bytes
+        assert 0 < shard_bytes < total
+        assert shard_bytes >= total // 4
+    finally:
+        s.batcher.close()
+
+
+def test_unmeshed_server_emits_no_mesh_gauges(model_dir):
+    s = GenerateServer(model_uri=model_dir, slots=2, steps_per_poll=2)
+    s.load()
+    try:
+        gen(s, [1, 2, 3], 4)
+        keys = {d["key"] for d in s.metrics()}
+        assert not any(k.startswith("gen_mesh_") for k in keys)
+    finally:
+        s.batcher.close()
+
+
+def test_warm_census_precompiles_sharded_variants(model_dir, caplog):
+    import logging
+
+    s = GenerateServer(model_uri=model_dir, slots=2, steps_per_poll=2,
+                       mesh_shape=MESH_SHAPE,
+                       warmup_prompt_lens=[8], warmup_max_new_tokens=4)
+    with caplog.at_level(logging.INFO,
+                         logger="seldon_core_tpu.serving.continuous"):
+        s.load()
+    try:
+        census = [r for r in caplog.records
+                  if "sharded serving census" in r.getMessage()]
+        assert census, "warm() emitted no sharded compile census"
+        msg = census[-1].getMessage()
+        assert "devices=8" in msg
+        # warmed: the first admission wave hits compiled executables
+        assert gen(s, [1, 2, 3, 4, 5, 6, 7, 8], 4)
+    finally:
+        s.batcher.close()
+
+
+# -- seldon.io/mesh annotation: apply -> reconciler -> server ----------------
+
+
+def _pspec(ann=None, impl="GENERATE_SERVER", tpu_mesh=None, uri="file:///m"):
+    from seldon_core_tpu.graph.spec import PredictorSpec
+
+    d = {
+        "name": "p",
+        "annotations": ann or {},
+        "graph": {
+            "name": "gen", "type": "MODEL", "implementation": impl,
+            "modelUri": uri,
+        },
+    }
+    if tpu_mesh:
+        d["tpuMesh"] = tpu_mesh
+    return PredictorSpec.from_dict(d)
+
+
+def test_mesh_annotation_parse_and_validation():
+    from seldon_core_tpu.graph.spec import (
+        GraphSpecError,
+        parse_mesh_annotation,
+        validate_predictor,
+    )
+
+    assert parse_mesh_annotation(_pspec()) is None
+    s = _pspec({"seldon.io/mesh": "data=2,model=4"})
+    assert parse_mesh_annotation(s) == {"data": 2, "model": 4}
+    validate_predictor(s)  # strict at admission, and this one is legal
+    with pytest.raises(GraphSpecError, match="malformed"):
+        parse_mesh_annotation(_pspec({"seldon.io/mesh": "rows=2"}))
+    with pytest.raises(GraphSpecError, match="malformed"):
+        validate_predictor(_pspec({"seldon.io/mesh": "data=0"}))
+    with pytest.raises(GraphSpecError, match="GENERATE_SERVER"):
+        parse_mesh_annotation(_pspec(
+            {"seldon.io/mesh": "model=4"}, impl="SKLEARN_SERVER",
+        ))
+    # the annotation owns the shape: an explicit tpuMesh too is a typo
+    with pytest.raises(GraphSpecError, match="tpuMesh"):
+        parse_mesh_annotation(_pspec(
+            {"seldon.io/mesh": "model=4"}, tpu_mesh={"model": 4},
+        ))
+
+
+def test_reconciler_injects_mesh_into_member_spec():
+    import asyncio
+
+    from seldon_core_tpu.controlplane.reconciler import DeploymentController
+    from seldon_core_tpu.controlplane.resource import SeldonDeployment
+
+    rec = DeploymentController.__new__(DeploymentController)
+    rec._kv_ports = {}
+    rec.components = {}
+    dep = SeldonDeployment.from_dict({
+        "metadata": {"name": "d", "namespace": "ns"},
+        "spec": {"predictors": [{
+            "name": "p",
+            "annotations": {"seldon.io/mesh": "data=2,model=4"},
+            "graph": {"name": "gen", "type": "MODEL",
+                      "implementation": "GENERATE_SERVER",
+                      "modelUri": "file:///m"},
+        }]},
+    })
+    specs = asyncio.run(rec.desired_components(dep))
+    engines = [s for s in specs if s.kind == "engine"]
+    assert engines
+    for es in engines:
+        assert es.engine_spec.get("tpuMesh") == {"data": 2, "model": 4}
+        # injected as tpuMesh now: the annotation is stripped so member
+        # re-validation doesn't see two sources of truth
+        assert "seldon.io/mesh" not in (
+            es.engine_spec.get("annotations") or {}
+        )
+
+
+def test_mesh_annotation_round_trips_to_serving_engine(model_dir):
+    """The full path: apply a CR carrying ``seldon.io/mesh`` ->
+    reconciler validates + injects tpuMesh -> placement carves the block
+    -> the engine's generate server runs on the annotated mesh."""
+    import asyncio
+
+    from seldon_core_tpu.controlplane import (
+        DeploymentController,
+        Gateway,
+        ResourceStore,
+        SeldonDeployment,
+        TpuPlacement,
+    )
+    from seldon_core_tpu.controlplane.resource import STATE_AVAILABLE
+    from seldon_core_tpu.controlplane.runtime import InProcessRuntime
+
+    async def go():
+        store = ResourceStore()
+        placement = TpuPlacement(devices=jax.devices())
+        ctl = DeploymentController(
+            store,
+            runtime=InProcessRuntime(open_ports=False),
+            placement=placement,
+            gateway=Gateway(),
+        )
+        dep = SeldonDeployment.from_dict({
+            "name": "meshdep",
+            "predictors": [{
+                "name": "p0",
+                "annotations": {"seldon.io/mesh": "data=2,model=4"},
+                "graph": {
+                    "name": "g",
+                    "implementation": "GENERATE_SERVER",
+                    "modelUri": model_dir,
+                },
+            }],
+        })
+        store.apply(dep)
+        status = await ctl.reconcile(dep.clone())
+        assert status.state == STATE_AVAILABLE
+        assert placement.capacity()["used"] == 8
+
+        engines = [
+            handle for handle, _ in ctl.components.values()
+            if handle.spec.kind == "engine"
+        ]
+        assert len(engines) == 1
+        app = engines[0].app
+        assert dict(app.executor._mesh.shape) == {"data": 2, "model": 4}
+        server = app.executor.root.client.user_object
+        assert server.batcher.mesh is app.executor._mesh
+
+        out = await app.predict({
+            "jsonData": {"prompt_tokens": [[1, 2, 3]], "max_new_tokens": 4},
+        })
+        toks = out["jsonData"]["tokens"][0]
+        assert len(toks) == 3 + 4
+
+        server.batcher.close()
+        await ctl.delete(dep)
+        assert placement.capacity()["used"] == 0
+
+    asyncio.run(go())
+
+
+# -- per-shard HBM ledger accounting (2x2 mesh) ------------------------------
+
+
+def test_per_shard_ledger_accounting_2x2():
+    """PressureController must see PER-CHIP bytes: on a data=2,model=2
+    mesh with 4 KV heads, every slab holds half the heads per chip, so
+    the ledger components and the pressure summary halve relative to an
+    unmeshed batcher serving the identical state."""
+    model = DecoderLM(**LLM_TINY)
+    params = model.init_params(0)
+    kw = dict(
+        slots=2, max_seq=64, prefill_buckets=(8, 16, 32), steps_per_poll=2,
+        prefix_cache_hbm_bytes=1 << 20, prefix_cache_min_tokens=4,
+        hbm_ledger_bytes=1 << 30,
+    )
+    plain = ContinuousBatcher(model, params, **kw)
+    shard = ContinuousBatcher(model, params, mesh=make_mesh(
+        {"data": 2, "model": 2}), **kw)
+    try:
+        assert shard._kv_model_shard == 2  # 4 KV heads / model=2
+        assert shard._kv_shard == 2        # no seq sharding
+        assert plain._kv_shard == 1
+        # per-shard param bytes: partitioned leaves halve, replicated
+        # leaves (embeddings, norms) don't — strictly between half and
+        # the global total is the honest envelope
+        assert plain._param_shard_bytes == plain._param_bytes
+        assert shard._param_bytes // 2 <= shard._param_shard_bytes \
+            < shard._param_bytes
+
+        prompt = [7, 3, 9, 4, 1, 8, 2, 6]
+        out_p = plain.generate(prompt, max_new_tokens=8)
+        out_s = shard.generate(prompt, max_new_tokens=8)
+        assert out_p == out_s  # identity holds on the sub-block mesh too
+
+        # the published prefix slab lands in the ledger at PER-SHARD
+        # bytes: exactly half the unmeshed accounting for the same slab
+        # (.nbytes of a sharded buffer is GLOBAL; the watermark guards
+        # one chip). The running scheduler refreshes the controller
+        # every poll — wait on the published component, never call the
+        # @scheduler_only ledger from the test thread.
+        def wait_prefix(b, divisor):
+            deadline = time.monotonic() + 30
+            while True:
+                total = b._prefix_index.total_bytes
+                got = b._pressure.components.get("prefix", 0)
+                if total > 0 and got == total // divisor:
+                    return total, got
+                if time.monotonic() > deadline:
+                    raise AssertionError(
+                        f"prefix component never settled: total={total} "
+                        f"component={got} divisor={divisor}")
+                time.sleep(0.002)
+
+        p_total, p_bytes = wait_prefix(plain, 1)
+        s_total, s_bytes = wait_prefix(shard, 2)
+        assert p_total == s_total > 0  # same slab, same global bytes
+        assert s_bytes == p_bytes // 2
+
+        # the summary the server gauges read carries the shard factors
+        summary = shard.pressure_summary()
+        assert summary["kv_shard"] == 2
+        assert summary["param_shard_bytes"] == shard._param_shard_bytes
+        assert "kv_shard" not in plain.pressure_summary()
+    finally:
+        plain.close()
+        shard.close()

@@ -301,6 +301,30 @@ def rows_from(mt, fronts):
             + (f"; kill resumed with {gm.get('kill_retries', 0)} retry"
                if gm.get("kill_resume_identical") else ""),
         ))
+    gsh = mt.get("llm_1b_sharded") or {}
+    if gsh and not gsh.get("skipped"):
+        mbu = (
+            f", per-chip MBU {gsh['mbu_pct']}% vs {gsh.get('plain_mbu_pct', '—')}%"
+            if gsh.get("mbu_pct") is not None else ""
+        )
+        rows.append((
+            "generate(), pod-scale sharded serving",
+            f"{fmt(gsh.get('tokens_per_s'))} tok/s sharded vs "
+            f"{fmt(gsh.get('plain_tokens_per_s'))} 1-device, p50 "
+            f"{fmt(gsh.get('p50_ms'))} vs {fmt(gsh.get('plain_p50_ms'))} ms"
+            # on a host-emulated mesh the raw p50 carries the N-way
+            # timesharing of one socket; the per-chip verdict is the
+            # meaningful regression gate there (see bench_sharded)
+            + (" (per-chip no-slower)"
+               if gsh.get("p50_no_slower_per_chip")
+               and not gsh.get("p50_no_slower") else "")
+            + f"{mbu}",
+            f"mesh {gsh.get('mesh_shape', '—')}, params+KV at "
+            f"1/{gsh.get('kv_shard', '—')} per chip"
+            + ("; greedy + seeded bytes identical"
+               if gsh.get("greedy_identical") and gsh.get("sampled_identical")
+               else ""),
+        ))
     g1l = mt.get("llm_1b_long") or {}
     if g1l:
         mbu = f", MBU {g1l['mbu_pct']}%" if g1l.get("mbu_pct") is not None else ""
