@@ -22,14 +22,17 @@ from typing import Dict, List, Optional, Tuple
 from ..graph.spec import (
     ANNOTATION_KV_TIER_BYTES,
     ANNOTATION_MESH,
+    ANNOTATION_TENANTS,
     GraphSpecError,
     PREPACKAGED_SERVERS,
     PredictorSpec,
     default_predictor,
     inject_kv_tier_param,
+    inject_tenants_param,
     parse_disagg_annotations,
     parse_kv_tier_annotation,
     parse_mesh_annotation,
+    parse_tenants_annotation,
     validate_deployment,
 )
 from ..storage import Storage
@@ -242,9 +245,28 @@ class DeploymentController:
             # graph/spec.py) so placement and the engine's in-process
             # mesh build both read the same already-validated shape
             mesh_shape = parse_mesh_annotation(pspec)
+            # tenants annotation: the validated roster lands on the
+            # GENERATE_SERVER unit as the `tenants` parameter, verbatim
+            # CSV (one source of truth — the annotation; the server
+            # re-parses with the same strict grammar at construction)
+            tenants_raw = (
+                (pspec.annotations or {}).get(ANNOTATION_TENANTS)
+                if parse_tenants_annotation(pspec) is not None else None
+            )
             for replica in range(max(1, pspec.replicas)):
                 name = f"{dep.key}/{pspec.name}/{replica}/engine-{h[:8]}"
                 espec_dict = pspec.to_dict()
+                if tenants_raw is not None:
+                    espec_dict = inject_tenants_param(
+                        espec_dict, tenants_raw
+                    )
+                    espec_dict["annotations"] = {
+                        k: v
+                        for k, v in (
+                            espec_dict.get("annotations") or {}
+                        ).items()
+                        if k != ANNOTATION_TENANTS
+                    }
                 if tier_bytes is not None:
                     espec_dict = inject_kv_tier_param(espec_dict, tier_bytes)
                     # injected as a parameter now: strip the annotation

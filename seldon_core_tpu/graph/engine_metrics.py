@@ -144,6 +144,18 @@ class MetricsRegistry:
         "gen_migrations": "seldon_engine_migrations_total",
         "gen_migrated_resumes": "seldon_engine_migrations_resumed",
         "gen_swap_preemptions": "seldon_engine_swap_preemptions",
+        # multi-tenant serving: per-tenant completions (tenant label
+        # rides the tag), scheduler flips, and the weight pager's
+        # page-in/out + staging-tier housekeeping counters — the
+        # observable half of the pager contract in docs/generate.md
+        # "Multi-tenant serving"
+        "gen_tenant_requests": "seldon_engine_tenant_requests",
+        "gen_tenant_switches": "seldon_engine_tenant_switches",
+        "gen_weight_page_ins": "seldon_engine_weight_page_ins",
+        "gen_weight_page_outs": "seldon_engine_weight_page_outs",
+        "gen_weight_pager_evictions":
+            "seldon_engine_weight_pager_evictions",
+        "gen_weight_pager_refused": "seldon_engine_weight_pager_refused",
     }
 
     # first-class health gauge: 1 = the generate scheduler is serving,
@@ -171,6 +183,14 @@ class MetricsRegistry:
         "gen_mesh_param_shard_bytes":
             "seldon_engine_mesh_param_shard_bytes",
         "gen_mesh_kv_shard": "seldon_engine_mesh_kv_shard",
+        # weight pager occupancy: host-RAM staging bytes (NOT an HBM
+        # pressure gauge), the resident tenant's HBM checkpoint bytes
+        # (the ledger's `pager` component), and the staged-tenant count
+        "gen_weight_pager_host_bytes":
+            "seldon_engine_weight_pager_host_bytes",
+        "gen_weight_pager_resident_bytes":
+            "seldon_engine_weight_pager_resident_bytes",
+        "gen_tenants_registered": "seldon_engine_tenants_registered",
     }
 
     # generate SLO TIMERs (per completed request, shipped by the generate
@@ -182,6 +202,12 @@ class MetricsRegistry:
         "gen_ttft_ms": "seldon_engine_generate_ttft_seconds",
         "gen_tpot_ms": "seldon_engine_generate_tpot_seconds",
         "gen_queue_wait_ms": "seldon_engine_generate_queue_wait_seconds",
+        # per-tenant SLO split: same triple, tenant label from the tag —
+        # the TenantScheduler's feedback signal made scrapeable
+        "gen_tenant_ttft_ms": "seldon_engine_tenant_ttft_seconds",
+        "gen_tenant_tpot_ms": "seldon_engine_tenant_tpot_seconds",
+        "gen_tenant_queue_wait_ms":
+            "seldon_engine_tenant_queue_wait_seconds",
     }
 
     def record_custom(self, metrics: List[Dict], labels: Dict[str, str] | None = None):

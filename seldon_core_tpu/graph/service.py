@@ -232,6 +232,17 @@ class EngineApp:
                 f"(seldon.io/max-inflight={self.max_inflight})"
             )
         deadline = deadline_from_request(headers, self._ann)
+        # tenant routing: the Seldon-Tenant header rides the message
+        # meta to every unit (the deadline stamp_meta idiom), so a
+        # multi-tenant generate server sees the id without the HTTP
+        # layer leaking into the executor
+        if headers:
+            tenant = (headers.get("seldon-tenant")
+                      or headers.get("Seldon-Tenant"))
+            if tenant:
+                from ..serving.weightpager import stamp_tenant_meta
+
+                message = stamp_tenant_meta(message, str(tenant).strip())
         est = self._shed_wait_s(deadline)
         if est is not None:
             self.metrics.counter_inc("seldon_api_engine_server_rejected", labels)

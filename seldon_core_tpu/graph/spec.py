@@ -466,6 +466,81 @@ def inject_kv_tier_param(spec_dict: Dict, tier_bytes: int) -> Dict:
     return spec_dict
 
 
+# multi-tenant serving (docs/generate.md "Multi-tenant serving"): the
+# tenant roster a generate predictor's weight pager multiplexes —
+# name=slo_class[@model_uri] CSV, first tenant boots resident
+ANNOTATION_TENANTS = "seldon.io/tenants"
+
+
+def parse_tenants_annotation(
+    spec: PredictorSpec,
+) -> "Optional[List[tuple]]":
+    """The parsed ``seldon.io/tenants`` roster when the predictor opts
+    into multi-tenant paging, None otherwise. The ONE parser shared by
+    admission validation and the reconciler's parameter injection,
+    strict at apply time: the grammar itself is delegated to
+    ``serving.weightpager.parse_tenant_spec`` (a typo'd SLO class or a
+    duplicate tenant fails the apply, not the member boot), the graph
+    must contain a GENERATE_SERVER unit (the pager is a
+    generate-scheduler subsystem), and the graph must not also set the
+    ``tenants`` parameter by hand (the annotation owns the roster —
+    two sources of truth for one tenant list is how operators get
+    neither)."""
+    ann = spec.annotations or {}
+    raw = ann.get(ANNOTATION_TENANTS)
+    if raw is None:
+        return None
+    from ..serving.weightpager import parse_tenant_spec
+
+    try:
+        roster = parse_tenant_spec(str(raw))
+    except ValueError as e:
+        raise GraphSpecError(
+            f"predictor {spec.name!r}: malformed {ANNOTATION_TENANTS} "
+            f"annotation {raw!r}: {e}"
+        ) from e
+    gen_units = [
+        u for u in spec.graph.walk()
+        if u.implementation == "GENERATE_SERVER"
+    ]
+    if not gen_units:
+        raise GraphSpecError(
+            f"predictor {spec.name!r}: {ANNOTATION_TENANTS} needs a "
+            "GENERATE_SERVER unit (the weight pager is a "
+            "generate-scheduler subsystem)"
+        )
+    for unit in gen_units:
+        for p in unit.parameters:
+            if p.name == "tenants":
+                raise GraphSpecError(
+                    f"predictor {spec.name!r}: {ANNOTATION_TENANTS} owns "
+                    "the 'tenants' parameter — drop it from the graph "
+                    "(the reconciler injects it per member)"
+                )
+    return roster
+
+
+def inject_tenants_param(spec_dict: Dict, tenants: str) -> Dict:
+    """Append ``tenants`` to every GENERATE_SERVER node of a
+    predictor-spec dict (the reconciler's injection half of the
+    annotation contract). Mutates and returns ``spec_dict``."""
+
+    def visit(node: Dict) -> None:
+        if node.get("implementation") == "GENERATE_SERVER":
+            params = list(node.get("parameters") or [])
+            params.append({
+                "name": "tenants",
+                "value": str(tenants),
+                "type": "STRING",
+            })
+            node["parameters"] = params
+        for child in node.get("children") or []:
+            visit(child)
+
+    visit(spec_dict["graph"])
+    return spec_dict
+
+
 def validate_predictor(spec: PredictorSpec) -> None:
     """Reference checks: seldondeployment_webhook.go:388-411."""
     if spec.replicas < 0:
@@ -499,6 +574,9 @@ def validate_predictor(spec: PredictorSpec) -> None:
     # mesh annotation: strict-at-apply (a malformed shape must refuse
     # the apply, never surface as an opaque XLA failure at member boot)
     parse_mesh_annotation(spec)
+    # tenants annotation: strict-at-apply (a typo'd SLO class must not
+    # misroute a tenant's traffic at serve time)
+    parse_tenants_annotation(spec)
 
 
 def validate_deployment(predictors: List[PredictorSpec]) -> None:

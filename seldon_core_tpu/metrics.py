@@ -79,9 +79,17 @@ class CounterDeltas:
         self._lock = threading.Lock()
 
     def counter(self, key: str, total: float, tags: Dict[str, str] | None = None) -> Dict:
+        # the delta ledger is keyed by (key, tags): per-tenant counters
+        # share a key and differ only in tags, and folding the tags in
+        # keeps each series' running total independent — without this a
+        # two-tenant export would see the other tenant's total and
+        # clamp every other delta to zero
+        ledger_key = key if not tags else key + "|" + ",".join(
+            f"{k}={v}" for k, v in sorted(tags.items())
+        )
         with self._lock:
-            last = self._last.get(key, 0.0)
-            self._last[key] = float(total)
+            last = self._last.get(ledger_key, 0.0)
+            self._last[ledger_key] = float(total)
         return create_counter(key, max(0.0, float(total) - last), tags)
 
 

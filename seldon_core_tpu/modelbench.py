@@ -21,6 +21,7 @@ orchestration, not just device time.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import json
 import os
 import socket
@@ -3235,6 +3236,168 @@ def bench_sharded(
             shard.batcher.close()
 
 
+def bench_multitenant(
+    root: str,
+    seconds: float = 3.0,
+    concurrency: int = 2,
+    prompt_len: int = 6,
+    max_new_tokens: int = 12,
+    slots: int = 2,
+    steps_per_poll: int = 2,
+    zipf: Tuple[float, ...] = (0.6, 0.3, 0.1),
+    config: Optional[Dict[str, Any]] = None,
+    n_probe: int = 2,
+    label: str = "llm-multitenant",
+) -> Dict[str, Any]:
+    """Multi-tenant weight paging (generate.md §13): THREE tenants —
+    distinct checkpoints, strict/standard/best_effort SLO classes —
+    consolidated onto ONE paged server next to a dedicated server per
+    checkpoint.
+
+    The acceptance bits, in one entry: per-tenant greedy AND seeded
+    byte-identity against each tenant's dedicated server (the paged
+    probes interleave tenants, so every identity check straddles a
+    demote→promote cycle), Zipf-skewed mixed traffic's tokens/s paged
+    vs dedicated (the consolidation cost made visible — the dedicated
+    side holds N× the HBM), per-tenant TTFT p99 split by SLO class,
+    and the pager/scheduler counters (page-ins, switches, forced
+    switches) that say how hard the window actually paged."""
+    from .servers.generateserver import GenerateServer
+
+    cfg = dict(config or {})
+    cfg.setdefault("max_seq", max(64, 2 * (prompt_len + max_new_tokens)))
+    roster = [("acme", "strict"), ("globex", "standard"),
+              ("initech", "best_effort")]
+    dirs = {
+        name: write_model_dir(
+            os.path.join(root, f"mt-{name}"), "llm", {**cfg, "seed": i}
+        )
+        for i, (name, _slo) in enumerate(roster)
+    }
+    common = dict(slots=slots, steps_per_poll=steps_per_poll,
+                  warmup_prompt_lens=[prompt_len],
+                  warmup_max_new_tokens=max_new_tokens)
+    dedicated = {}
+    for name, _slo in roster:
+        s = GenerateServer(model_uri=dirs[name], **common)
+        s.load()
+        dedicated[name] = s
+    tenants_param = ",".join(
+        f"{name}={slo}" + ("" if name == roster[0][0] else f"@{dirs[name]}")
+        for name, slo in roster
+    )
+    # host staging must hold every demoted checkpoint at once; the model
+    # dirs carry only a config (weights random-init from the seed), so
+    # size the budget from the config arithmetic — fp32 upper bound
+    # (full-MHA attention, gated FFN) with 3x slack for SWP1 framing
+    vocab = int(cfg.get("vocab_size", 256))
+    d = int(cfg.get("d_model", 32))
+    n_layers = int(cfg.get("n_layers", 2))
+    d_ff = int(cfg.get("d_ff", 4 * d))
+    est = 4 * (2 * vocab * d + n_layers * (4 * d * d + 3 * d * d_ff + 6 * d))
+    multi = GenerateServer(
+        model_uri=dirs[roster[0][0]], tenants=tenants_param,
+        weight_pager_host_bytes=max(256 << 20, 3 * len(roster) * est),
+        tenant_min_resident_ms=0,
+        **common,
+    )
+    multi.load()
+
+    def ask(server, prompt, tenant=None, temperature=0.0, seed=0):
+        body = {"prompt_tokens": [prompt], "max_new_tokens": max_new_tokens,
+                "temperature": temperature, "seed": seed}
+        if tenant is not None:
+            body["tenant"] = tenant
+        return server.predict(body, [])["tokens"][0]
+
+    def probe(temperature, seed):
+        """Interleave tenants prompt-by-prompt so every paged answer
+        rides a demote→promote cycle of the two other tenants."""
+        rs = np.random.RandomState(11)
+        prompts = [rs.randint(1, vocab, max(3, prompt_len)).tolist()
+                   for _ in range(n_probe)]
+        identical = True
+        for p in prompts:
+            for name, _slo in roster:
+                ref = ask(dedicated[name], p, temperature=temperature,
+                          seed=seed)
+                got = ask(multi, p, tenant=name, temperature=temperature,
+                          seed=seed)
+                identical = identical and got == ref
+        return identical
+
+    def window(route):
+        """Closed-loop Zipf mix; ``route(tenant, prompt)`` serves one
+        request and returns the generated-token count."""
+        probs = np.array(zipf, dtype=np.float64)
+        probs = probs / probs.sum()
+        counter = itertools.count()
+
+        def make_call():
+            rs = np.random.RandomState(1000 + next(counter))
+            names = [name for name, _slo in roster]
+
+            def call() -> int:
+                name = names[int(rs.choice(len(names), p=probs))]
+                p = rs.randint(1, vocab, prompt_len).tolist()
+                return len(route(name, p)) - prompt_len
+
+            return call
+
+        return closed_loop(make_call, seconds, concurrency, warmup_calls=2)
+
+    try:
+        greedy_identical = probe(0.0, 0)
+        sampled_identical = probe(0.8, 17)
+        w_ded = window(lambda name, p: ask(dedicated[name], p))
+        switches_before = multi.tenant_scheduler.stats["switches"]
+        w_multi = window(lambda name, p: ask(multi, p, tenant=name))
+        sched = multi.tenant_scheduler.stats
+        pager = multi.tenant_pager.stats
+        ttft_p99 = {}
+        for name, _slo in roster:
+            samples = multi.batcher.tenant_slo_recent.get(name)
+            if samples:
+                ttfts = [s[1] * 1e3 for s in list(samples)]
+                ttft_p99[name] = round(float(np.percentile(ttfts, 99)), 2)
+        return {
+            "model": label,
+            "scenario": (
+                "three tenants (strict/standard/best_effort, distinct "
+                "checkpoints) on ONE paged server vs a dedicated server "
+                f"each: Zipf {tuple(zipf)} mixed traffic, per-tenant "
+                "byte-identity probes across demote→promote cycles"
+            ),
+            "transport": "in-process, continuous batching",
+            "tenants": {name: slo for name, slo in roster},
+            "zipf": list(zipf),
+            "prompt_len": prompt_len,
+            "max_new_tokens": max_new_tokens,
+            "slots": slots,
+            "greedy_identical": greedy_identical,
+            "sampled_identical": sampled_identical,
+            "tokens_per_s": w_multi["rows_per_s"],
+            "dedicated_tokens_per_s": w_ded["rows_per_s"],
+            # the price of packing N checkpoints into one HBM residency:
+            # paged throughput over dedicated (which holds N x the HBM)
+            "throughput_ratio": round(
+                w_multi["rows_per_s"] / w_ded["rows_per_s"], 4
+            ) if w_ded["rows_per_s"] else None,
+            "p50_ms": w_multi["p50_ms"],
+            "p99_ms": w_multi["p99_ms"],
+            "dedicated_p50_ms": w_ded["p50_ms"],
+            "ttft_p99_ms_by_tenant": ttft_p99,
+            "window_switches": sched["switches"] - switches_before,
+            "forced_switches": sched["forced_switches"],
+            "page_ins": pager["page_ins"],
+            "pager_host_bytes": multi.tenant_pager.host_bytes,
+        }
+    finally:
+        for s in dedicated.values():
+            s.close()
+        multi.close()
+
+
 def _ablate_generate(
     root: str,
     base_kw: Dict[str, Any],
@@ -3487,6 +3650,20 @@ def run_model_tier(
             # side-by-side, and the per-shard HBM ledger published
             # (chip scales the same harness to the 1.26B tier)
             results["llm_1b_sharded"] = bench_sharded(
+                root, seconds=min(seconds, 3.0), concurrency=2,
+                prompt_len=6, max_new_tokens=12, slots=2, steps_per_poll=2,
+                config={
+                    "vocab_size": 256, "d_model": 32, "n_layers": 2,
+                    "n_heads": 4, "n_kv_heads": 4, "d_ff": 64, "max_seq": 64,
+                },
+            )
+            # multi-tenant weight paging: three tenants (strict /
+            # standard / best_effort, distinct checkpoints) on ONE paged
+            # server vs a dedicated server per checkpoint — per-tenant
+            # byte-identity across demote→promote cycles, Zipf-mix
+            # tokens/s consolidation cost, per-tenant TTFT p99 split by
+            # SLO class, pager/switch counters (chip scales the harness)
+            results["llm_1b_multitenant"] = bench_multitenant(
                 root, seconds=min(seconds, 3.0), concurrency=2,
                 prompt_len=6, max_new_tokens=12, slots=2, steps_per_poll=2,
                 config={
@@ -3910,6 +4087,18 @@ def run_model_tier(
                 seconds=seconds, concurrency=4,
                 prompt_len=64, max_new_tokens=32,
                 slots=4, steps_per_poll=8, hbm_gb_s=hbm,
+                config={**big_cfg, "max_seq": 256},
+            )
+            # multi-tenant weight paging at flagship scale: three 1.26B
+            # checkpoints consolidated into one HBM residency — the
+            # paging cost here is a real multi-GB host→HBM transfer per
+            # flip, so the Zipf-mix throughput ratio and the per-tenant
+            # TTFT p99 split are the published consolidation trade
+            results["llm_1b_multitenant"] = bench_multitenant(
+                root, label="llm-1.26b-multitenant",
+                seconds=seconds, concurrency=4,
+                prompt_len=64, max_new_tokens=32,
+                slots=4, steps_per_poll=8,
                 config={**big_cfg, "max_seq": 256},
             )
             # RAG + graph fusion at chip scale: a real bert-base-class

@@ -220,6 +220,9 @@ class GenerateServer(SeldonComponent):
     _kv_client = None
     _resume_tokens = False
     _kv_tier_peer_lookup = False
+    _tenant_spec = None
+    tenant_pager = None
+    tenant_scheduler = None
     batcher = None
 
     def __init__(
@@ -263,6 +266,11 @@ class GenerateServer(SeldonComponent):
         swap_resume_policy: str = "resume",
         warmup_prompt_lens: Optional[Sequence[int]] = None,
         warmup_max_new_tokens: int = 0,
+        tenants: Optional[str] = None,
+        weight_pager_host_bytes: int = 0,
+        tenant_tick_ms: int = 20,
+        tenant_max_wait_polls: int = 256,
+        tenant_min_resident_ms: int = 50,
         **kwargs,
     ):
         self.model_uri = model_uri
@@ -361,6 +369,33 @@ class GenerateServer(SeldonComponent):
             ]
         self._warmup_prompt_lens = list(warmup_prompt_lens or [])
         self._warmup_max_new_tokens = int(warmup_max_new_tokens)
+        # multi-tenancy: `tenants` is the same strict grammar as the
+        # seldon.io/tenants annotation (name=slo[@model_uri] CSV) —
+        # parsed at construction so a malformed spec refuses at
+        # admission, not mid-load. The pager host budget gates the
+        # whole subsystem: 0 (default) = single-tenant, byte-identical
+        # to the pre-tenant server.
+        self._tenant_spec = None
+        if tenants:
+            from ..serving.weightpager import parse_tenant_spec
+
+            self._tenant_spec = parse_tenant_spec(str(tenants))
+        self._weight_pager_host_bytes = int(weight_pager_host_bytes)
+        if self._tenant_spec and self._weight_pager_host_bytes <= 0:
+            raise ValueError(
+                "tenants configured but weight_pager_host_bytes is 0 — "
+                "the pager's host-RAM staging budget must be set"
+            )
+        if self._tenant_spec and self._role != "unified":
+            raise ValueError(
+                "multi-tenant paging is not supported on disaggregated "
+                "roles (the KV transport assumes one weight lineage)"
+            )
+        self._tenant_tick_ms = int(tenant_tick_ms)
+        self._tenant_max_wait_polls = int(tenant_max_wait_polls)
+        self._tenant_min_resident_ms = int(tenant_min_resident_ms)
+        self.tenant_pager = None      # WeightPager, set at load
+        self.tenant_scheduler = None  # TenantScheduler, set at load
         self._extra = kwargs
         self.batcher = None
         self._model = None
@@ -518,6 +553,18 @@ class GenerateServer(SeldonComponent):
             phook = self._faults.pressure_hook()
             if phook is not None:
                 self.batcher.pressure_hook = phook
+        if self._tenant_spec:
+            # multi-tenancy: register EVERY tenant's checkpoint in the
+            # pager's host-RAM staging tier (the resident one included —
+            # its staging copy is what makes demotion a pointer flip,
+            # not an HBM download), align the batcher's weight-version
+            # lineage to the primary tenant's namespaced version BEFORE
+            # warm() so the caches never see the un-namespaced 0, and
+            # hang the SLO scheduler off the poll loop. Done before
+            # warm(): the compiled executables are shape-keyed, not
+            # weight-keyed, so one warm covers all tenants (the
+            # scale-to-zero no-recompile property).
+            self._load_tenants(params)
         if self._warmup_prompt_lens:
             # compile-before-listen: every prefill/insert/burst variant the
             # declared traffic shape needs is built here, so the first
@@ -542,11 +589,91 @@ class GenerateServer(SeldonComponent):
                 )
         else:
             self.batcher.start()
+            if self.tenant_scheduler is not None:
+                # the page-in driver blocks on scheduler progress
+                # (request_weight_swap futures), so it only starts once
+                # the poll loop is live
+                self.tenant_scheduler.start()
         if self._role == "decode" and self._peer is not None:
             self._kv_client = self._build_failover(self._peer)
         logger.info(
             "generateserver: %s ready (role=%s, slots=%d, max_seq=%d)",
             self.model_uri, self._role, self._slots, self.batcher.max_seq,
+        )
+
+    def _load_tenants(self, primary_params) -> None:
+        """Stage every declared tenant's checkpoint and align the
+        batcher's weight-version lineage to the primary tenant's
+        namespaced version. Secondary checkpoints load through the
+        hot-swap discipline: same architecture required (one warmed
+        executable set serves all tenants — THE scale-to-zero
+        property), cast to the serving dtype before staging so page-in
+        is decode+upload, never a cast."""
+        import dataclasses as _dc
+
+        import jax.numpy as jnp
+
+        from ..serving.weightpager import TenantScheduler, WeightPager
+
+        pager = WeightPager(self._weight_pager_host_bytes)
+        primary, primary_slo, primary_uri = self._tenant_spec[0]
+        if primary_uri and primary_uri != self.model_uri:
+            raise ValueError(
+                f"primary tenant {primary!r} declares model uri "
+                f"{primary_uri!r} but the server loads {self.model_uri!r} "
+                "— the first tenant boots resident on the served model"
+            )
+        v0 = pager.put(primary, primary_params, primary_slo)
+        pager.mark_resident(primary)
+        dt = jnp.dtype(getattr(self._model, "compute_dtype", "bfloat16"))
+        served_cfg = _dc.asdict(self._model.cfg)
+        served_cfg.pop("residual_scale", None)
+        for name, slo, uri in self._tenant_spec[1:]:
+            server = JAXServer(uri or self.model_uri)
+            _apply, params = server.build()
+            other = server._model
+            if other is None or not hasattr(other, "cfg"):
+                raise ValueError(
+                    f"tenant {name!r} checkpoint at {uri!r} is not an "
+                    "llm-family model dir"
+                )
+            other_cfg = _dc.asdict(other.cfg)
+            other_cfg.pop("residual_scale", None)
+            if other_cfg != served_cfg:
+                changed = sorted(
+                    k for k in set(other_cfg) | set(served_cfg)
+                    if other_cfg.get(k) != served_cfg.get(k)
+                )
+                raise ValueError(
+                    f"tenant {name!r} checkpoint architecture differs "
+                    f"from the served model ({', '.join(changed)}); "
+                    "paged tenants share one executable set"
+                )
+            if dt != jnp.float32 and isinstance(params, dict):
+                params = self._cast_params_freeing_impl(params, dt)
+            pager.put(name, params, slo)
+        b = self.batcher
+        # lineage alignment BEFORE warm()/start(): caches are empty, so
+        # adopting the namespaced version purges nothing, and the first
+        # real page-in retains this tenant's slabs by namespace
+        b.weight_version = v0
+        if b._prefix_index is not None:
+            b._prefix_index.set_version(v0)
+        if b._kv_tier is not None:
+            b._kv_tier.set_version(v0)
+        b.tenant_pager = pager
+        self.tenant_pager = pager
+        self.tenant_scheduler = TenantScheduler(
+            b, pager,
+            {name: slo for name, slo, _uri in self._tenant_spec},
+            tick_s=self._tenant_tick_ms / 1e3,
+            max_wait_polls=self._tenant_max_wait_polls,
+            min_resident_s=self._tenant_min_resident_ms / 1e3,
+        )
+        logger.info(
+            "generateserver: multi-tenant paging over %d tenant(s), "
+            "%d host-staging bytes, resident=%s",
+            len(self._tenant_spec), self._weight_pager_host_bytes, primary,
         )
 
     # -- byte-level text fallback (no tokenizer shipped in-image) ----------
@@ -1127,6 +1254,11 @@ class GenerateServer(SeldonComponent):
 
     def close(self) -> None:
         """Stop the KV transport endpoints and the scheduler."""
+        if self.tenant_scheduler is not None:
+            # before the batcher: the driver blocks on swap futures the
+            # poll loop resolves, and stop() fails queued work typed
+            self.tenant_scheduler.stop()
+            self.tenant_scheduler = None
         if self._kv_server is not None:
             self._kv_server.close()
             self._kv_server = None
@@ -1200,11 +1332,22 @@ class GenerateServer(SeldonComponent):
             return self._build_response(
                 futures, results, token_lists, text_mode, kw=kw
             )
+        submit = self.batcher.submit
+        skw = dict(kw)
+        if self.tenant_scheduler is not None:
+            # multi-tenant routing: the scheduler passes the resident
+            # tenant's work straight through and queues everyone else
+            # for a page-in; the id arrives in the message meta (engine
+            # stamps the Seldon-Tenant header) or the body (direct use)
+            from ..serving.weightpager import tenant_from_meta
+
+            submit = self.tenant_scheduler.submit
+            skw["tenant"] = body.get("tenant") or tenant_from_meta(meta)
         futures = []
         try:
             for toks in token_lists:
                 futures.append(
-                    self.batcher.submit(toks, deadline_s=deadline_s, **kw)
+                    submit(toks, deadline_s=deadline_s, **skw)
                 )
         except Exception:
             # a multi-prompt request is all-or-nothing: whatever failed a
@@ -1298,6 +1441,14 @@ class GenerateServer(SeldonComponent):
                 # for a handoff that can never lose its donor mid-stream
                 fut = self._remote_submit(toks, kw, None, covered=0,
                                           on_tokens=q.put)
+            elif self.tenant_scheduler is not None:
+                from ..serving.weightpager import tenant_from_meta
+
+                fut = self.tenant_scheduler.submit(
+                    toks, tenant=body.get("tenant")
+                    or tenant_from_meta(body.get("meta")),
+                    on_tokens=q.put, **kw,
+                )
             else:
                 fut = self.batcher.submit(toks, on_tokens=q.put, **kw)
         fut.add_done_callback(lambda _f: q.put(None))
@@ -1446,6 +1597,10 @@ class GenerateServer(SeldonComponent):
         tier = self.batcher.kv_tier_summary()
         if tier is not None:
             out["kv_tier"] = tier
+        if self.tenant_pager is not None:
+            out["weight_pager"] = self.tenant_pager.summary()
+        if self.tenant_scheduler is not None:
+            out["tenant_scheduler"] = self.tenant_scheduler.summary()
         return out
 
     def metrics(self) -> List[Dict]:
@@ -1648,4 +1803,51 @@ class GenerateServer(SeldonComponent):
             if tpot is not None:
                 out.append({"type": "TIMER", "key": "gen_tpot_ms",
                             "value": round(tpot * 1e3, 4)})
+        if self.tenant_pager is not None:
+            # multi-tenant serving: pager counters/levels plus PER-TENANT
+            # request counters and SLO timer triples, each tagged with
+            # its tenant id — engine_metrics maps them to the
+            # seldon_engine_tenant_* / seldon_engine_weight_pager_*
+            # series, and the tag becomes a label so one /metrics scrape
+            # separates every tenant's histograms
+            p = self.tenant_pager.stats
+            out.extend([
+                delta("gen_weight_page_ins", p["page_ins"]),
+                delta("gen_weight_page_outs", p["page_outs"]),
+                delta("gen_weight_pager_evictions", p["evictions"]),
+                delta("gen_weight_pager_refused", p["refused"]),
+                {"type": "GAUGE", "key": "gen_weight_pager_host_bytes",
+                 "value": float(self.tenant_pager.host_bytes)},
+                {"type": "GAUGE", "key": "gen_weight_pager_resident_bytes",
+                 "value": float(self.tenant_pager.resident_hbm_bytes)},
+                {"type": "GAUGE", "key": "gen_tenants_registered",
+                 "value": float(len(self.tenant_pager.tenants()))},
+            ])
+            if self.tenant_scheduler is not None:
+                out.append(delta(
+                    "gen_tenant_switches",
+                    self.tenant_scheduler.stats["switches"],
+                ))
+            for t, sums in list(self.batcher.tenant_slo.items()):
+                out.append(delta("gen_tenant_requests", sums["finished"],
+                                 tags={"tenant": t}))
+            for t, tp in list(self.batcher.tenant_slo_pending.items()):
+                while tp:
+                    try:
+                        queue_wait, ttft, tpot = tp.popleft()
+                    except IndexError:  # raced another exporter thread
+                        break
+                    tags = {"tenant": t}
+                    out.append({"type": "TIMER",
+                                "key": "gen_tenant_queue_wait_ms",
+                                "value": round(queue_wait * 1e3, 4),
+                                "tags": tags})
+                    out.append({"type": "TIMER", "key": "gen_tenant_ttft_ms",
+                                "value": round(ttft * 1e3, 4),
+                                "tags": tags})
+                    if tpot is not None:
+                        out.append({"type": "TIMER",
+                                    "key": "gen_tenant_tpot_ms",
+                                    "value": round(tpot * 1e3, 4),
+                                    "tags": tags})
         return out

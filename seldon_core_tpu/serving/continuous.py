@@ -174,6 +174,13 @@ class GenRequest:
     # budget — the preemption victim policy reads it (a lane that must
     # answer soon is preempted only after every deadline-free lane)
     deadline_t: Optional[float] = None
+    # multi-tenant serving (serving/weightpager.py): owning tenant id
+    # (None = single-tenant back-compat) and SLO class ("strict" |
+    # "standard" | "best_effort"). The victim policy protects a strict
+    # tenant's last live lane; _resolve splits the SLO samples per
+    # tenant so the scheduler's starvation score sees per-tenant TTFT
+    tenant: Optional[str] = None
+    slo: str = "standard"
 
 
 @dataclasses.dataclass
@@ -421,6 +428,15 @@ class ContinuousBatcher:
         # crash-recovery path (resilience.faults wires it from the
         # SELDON_FAULTS scheduler section; tests set it directly)
         self.fault_hook: Optional[Any] = None
+        # multi-tenant hook: called at the top of every poll with the
+        # poll count (after the chaos hook). The TenantScheduler wires
+        # its wake-up here — bookkeeping only, it must never block or
+        # call caller-role batcher methods (serving/weightpager.py)
+        self.tenant_hook: Optional[Any] = None
+        # the WeightPager whose resident checkpoint the pressure ledger
+        # bills as its "pager" component (set by the serving component
+        # when multi-tenancy is on; None keeps the ledger unchanged)
+        self.tenant_pager: Optional[Any] = None
         self._poll_count = 0
         # WORKING polls only (lanes live, chunked jobs pending, bursts
         # in flight, or queued work): the pressure hook's clock, so a
@@ -559,6 +575,14 @@ class ContinuousBatcher:
             "slo_samples": 0, "queue_wait_s_sum": 0.0,
             "ttft_s_sum": 0.0, "tpot_s_sum": 0.0,
         })
+        # per-tenant splits of the same samples (multi-tenant serving):
+        # keyed lazily by tenant id at _resolve time so the single-tenant
+        # path allocates nothing. tenant_slo carries cumulative sums +
+        # counts; the pending deques drain as tenant-tagged TIMERs; the
+        # recent reservoirs feed the TenantScheduler's TTFT feedback.
+        self.tenant_slo: Dict[str, Dict[str, float]] = {}
+        self.tenant_slo_pending: Dict[str, "collections.deque"] = {}
+        self.tenant_slo_recent: Dict[str, "collections.deque"] = {}
         # scheduler flight recorder: one structured record per poll (batch
         # composition, depth-group plan + cost-model verdict, chunk
         # interleave, shed events), bounded + drop-oldest, cheap enough to
@@ -1661,6 +1685,8 @@ class ContinuousBatcher:
         seed: int = 0,
         on_tokens=None,
         deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
+        slo: str = "standard",
     ) -> Future:
         self._check_alive()
         if not len(tokens):
@@ -1678,6 +1704,8 @@ class ContinuousBatcher:
             eos_id=eos_id,
             seed=int(seed),
             on_tokens=on_tokens,
+            tenant=tenant,
+            slo=str(slo or "standard"),
         )
         req.submit_t = time.monotonic()
         if deadline_s is not None:
@@ -3750,9 +3778,24 @@ class ContinuousBatcher:
             swap_bytes = (
                 swap_bytes * self._param_shard_bytes // self._param_bytes
             )
+        # multi-tenancy: the resident tenant's checkpoint occupies HBM
+        # beyond the baseline single-model params the watermark already
+        # assumes — the pager reports its residency so page-ins compete
+        # with KV growth in the same ledger. Scaled per shard exactly
+        # like a staged swap (same param layout).
+        pager_bytes = 0
+        if self.tenant_pager is not None:
+            pager_bytes = int(
+                getattr(self.tenant_pager, "resident_hbm_bytes", 0)
+            )
+            if pager_bytes and self._param_bytes:
+                pager_bytes = (
+                    pager_bytes * self._param_shard_bytes
+                    // self._param_bytes
+                )
         return {
             "decode": decode, "staging": staging,
-            "prefix": prefix, "swap": swap_bytes,
+            "prefix": prefix, "swap": swap_bytes, "pager": pager_bytes,
         }
 
     @scheduler_only
@@ -3911,12 +3954,23 @@ class ContinuousBatcher:
     def _pick_victim(self):
         """Deadline/progress-aware victim choice: chunked admissions
         first (no tokens emitted yet — preemption loses zero work and
-        frees a whole staging slab), then decode lanes — deadline-free
-        lanes before deadline-bearing ones (a lane that must answer
-        soon is spared as long as anything else can give way), most
-        remaining generation budget first within each class (the lane
-        that would hold its slot longest yields it; lanes close to done
-        are left to finish and free themselves)."""
+        frees a whole staging slab), then decode lanes — best-effort
+        SLO class before everything else (a multi-tenant server sheds
+        its cheapest tenant's work first), deadline-free lanes before
+        deadline-bearing ones (a lane that must answer soon is spared
+        as long as anything else can give way), most remaining
+        generation budget first within each class (the lane that would
+        hold its slot longest yields it; lanes close to done are left
+        to finish and free themselves).
+
+        Tenant guard (extends the never-last-lane rule): while any
+        best-effort tenant still has a preemptible lane, the ONLY live
+        lane of a ``strict`` tenant is never chosen — preempting it
+        would zero an SLO-critical tenant's progress to make room it
+        could have taken from discountable work instead. If every
+        candidate is protected (e.g. all lanes are strict singletons)
+        the guard stands down and the base policy applies: pressure
+        relief must still be possible."""
         if self._chunked:
             slot = max(
                 self._chunked, key=lambda s: self._chunked[s].bucket
@@ -3926,6 +3980,30 @@ class ContinuousBatcher:
             return None
         now = time.monotonic()
 
+        lanes_per_tenant: Dict[Optional[str], int] = {}
+        has_best_effort = False
+        for s in self._active.values():
+            req = s.request
+            if req.tenant is not None:
+                lanes_per_tenant[req.tenant] = (
+                    lanes_per_tenant.get(req.tenant, 0) + 1
+                )
+            if req.slo == "best_effort":
+                has_best_effort = True
+
+        def protected(slot: int) -> bool:
+            req = self._active[slot].request
+            return (
+                has_best_effort
+                and req.slo == "strict"
+                and req.tenant is not None
+                and lanes_per_tenant.get(req.tenant, 0) <= 1
+            )
+
+        candidates = [s for s in self._active if not protected(s)]
+        if not candidates:
+            candidates = list(self._active)
+
         def order(slot: int):
             s = self._active[slot]
             req = s.request
@@ -3933,12 +4011,16 @@ class ContinuousBatcher:
                 req.deadline_t - now if req.deadline_t is not None else None
             )
             return (
+                # best_effort sorts lowest → preempted first; the
+                # default "standard" keeps the pre-tenant ordering
+                # byte-identical for single-tenant servers
+                0 if req.slo == "best_effort" else 1,
                 0 if slack is None else 1,
                 -(slack if slack is not None else 0.0),
                 -(req.max_new_tokens - len(s.emitted)),
             )
 
-        return ("lane", min(self._active, key=order))
+        return ("lane", min(candidates, key=order))
 
     @scheduler_only
     def _preempt_chunked(self, slot: int) -> None:
@@ -4488,6 +4570,28 @@ class ContinuousBatcher:
                 self.stats["tpot_s_sum"] += tpot
             self.slo_pending.append((queue_wait, ttft, tpot))
             self.slo_recent.append((queue_wait, ttft, tpot))
+            if req.tenant is not None:
+                # per-tenant split of the same triple: the TenantScheduler
+                # reads tenant_slo_recent as its TTFT feedback signal and
+                # the server drains tenant_slo_pending into tagged TIMER
+                # metrics — one sample feeds both, recorded here so a
+                # tenant's own response carries its own numbers
+                sums = self.tenant_slo.setdefault(req.tenant, {
+                    "slo_samples": 0.0, "queue_wait_s_sum": 0.0,
+                    "ttft_s_sum": 0.0, "tpot_s_sum": 0.0, "finished": 0.0,
+                })
+                sums["slo_samples"] += 1
+                sums["finished"] += 1
+                sums["queue_wait_s_sum"] += queue_wait
+                sums["ttft_s_sum"] += ttft
+                if tpot is not None:
+                    sums["tpot_s_sum"] += tpot
+                self.tenant_slo_pending.setdefault(
+                    req.tenant, collections.deque(maxlen=1024)
+                ).append((queue_wait, ttft, tpot))
+                self.tenant_slo_recent.setdefault(
+                    req.tenant, collections.deque(maxlen=512)
+                ).append((queue_wait, ttft, tpot))
             if req.admit_t:
                 tags = {"outcome": "complete", "tokens": n_tok,
                         "ttft_ms": round(ttft * 1e3, 3)}
@@ -4770,6 +4874,11 @@ class ContinuousBatcher:
                 self._poll_count += 1
                 if self.fault_hook is not None:
                     self.fault_hook(self._poll_count)
+                # multi-tenancy: publish the poll clock to the
+                # TenantScheduler so its starvation bound is measured in
+                # scheduler polls, not wall time (weightpager.py)
+                if self.tenant_hook is not None:
+                    self.tenant_hook(self._poll_count)
                 # HBM pressure: refresh the ledger and, over the high
                 # watermark, run the reclaim ladder (may drain `pending`
                 # and preempt lanes at this poll boundary). Two attribute

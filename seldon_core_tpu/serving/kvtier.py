@@ -56,7 +56,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from .disagg import ChecksumError, DisaggError, encode_slab, decode_slab
-from .prefix_cache import RadixPrefixIndex
+from .prefix_cache import RadixPrefixIndex, version_retains
 
 __all__ = ["HostKVTier", "TierEntryCorrupt"]
 
@@ -299,10 +299,14 @@ class HostKVTier:
         :class:`TierEntryCorrupt` on a CRC failure (entry already
         popped — replay fallback again)."""
         with self._lock:
-            ent = self._ckpts.pop(key, None)
+            ent = self._ckpts.get(key)
             if ent is None or ent.version != version:
+                # version-gated WITHOUT popping: a stale-version lookup
+                # must not destroy an entry another tenant's page-back
+                # would make valid again (version_retains kept it alive)
                 self.stats["misses"] += 1
                 return None
+            del self._ckpts[key]
         # decode unlocked (the entry is already popped — no other
         # thread can observe or mutate it)
         try:
@@ -334,15 +338,26 @@ class HostKVTier:
 
     def set_version(self, version: Any) -> int:
         """Key the tier to a new weight version, purging every stored
-        entry (their K/V was computed under the OLD weights — exactly
-        the radix cache's hot-swap contract). Returns entries purged."""
+        entry the switch invalidates (K/V computed under replaced
+        weights — exactly the radix cache's hot-swap contract).
+        Namespace-aware per :func:`~.prefix_cache.version_retains`: a
+        tenant page-in (serving/weightpager.py) purges only that
+        tenant's stale entries and legacy un-namespaced ones — another
+        tenant's prefix slabs and lane checkpoints survive, unreachable
+        (every lookup gates on ``version == self.version``) until their
+        tenant pages back in. Returns entries purged."""
         with self._lock:
             if version == self.version:
                 return 0
             self.version = version
             purged = self._index.set_version(version)
-            purged += len(self._ckpts)
-            self._ckpts.clear()
+            dead = [
+                k for k, e in self._ckpts.items()
+                if not version_retains(e.version, version)
+            ]
+            for k in dead:
+                del self._ckpts[k]
+            purged += len(dead)
             self.stats["evictions"] += purged
             return purged
 

@@ -33,6 +33,40 @@ import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 
+def version_namespace(version) -> Optional[str]:
+    """Tenant namespace of a weight version, or None for the legacy
+    single-lineage form. Multi-tenant serving (serving/weightpager.py)
+    keys versions as the STRING ``"{tenant}@{seq}"`` — strings, not
+    tuples, because versions ride JSON metas (SKV1/SGC1) where a tuple
+    round-trips as a list and silently breaks every equality check.
+    ``rsplit`` so a tenant name may not, but a future seq scheme may,
+    contain ``@``."""
+    if isinstance(version, str) and "@" in version:
+        return version.rsplit("@", 1)[0]
+    return None
+
+
+def version_retains(entry_version, new_version) -> bool:
+    """Whether an entry keyed ``entry_version`` SURVIVES a switch to
+    ``new_version`` — the one purge rule the radix index, the host KV
+    tier, and any future version-keyed store share:
+
+    * same version — the weights did not change (a tenant paging back
+      in): the entry is valid again, keep it;
+    * both namespaced, different tenants — the OTHER tenant's weights
+      did not change either; keep it (per-entry version checks make it
+      unmatchable while its tenant is not resident), so a page-in of
+      tenant B never invalidates tenant A's cache;
+    * anything else — same tenant's new weights, or a legacy
+      un-namespaced lineage on either side: purge (the pre-multi-tenant
+      hot-swap contract, unchanged)."""
+    if entry_version == new_version:
+        return True
+    ns_new = version_namespace(new_version)
+    ns_old = version_namespace(entry_version)
+    return ns_new is not None and ns_old is not None and ns_old != ns_new
+
+
 @dataclasses.dataclass
 class _Node:
     """One radix edge: ``edge`` tokens leading from the parent. A node
@@ -315,8 +349,13 @@ class RadixPrefixIndex:
 
     def _set_version_locked(self, version) -> int:
         """Key the pool to a new weight version, purging every stored
-        slab (their K/V was computed under the OLD weights — serving one
-        into a new-weights prefill would splice numerically wrong cache).
+        slab the switch invalidates (K/V computed under replaced weights
+        — serving one into a new-weights prefill would splice
+        numerically wrong cache). Namespace-aware per
+        :func:`version_retains`: a tenant page-in purges only that
+        tenant's stale slabs and legacy un-namespaced ones; other
+        tenants' slabs survive, invisible (``_slab_node`` requires
+        ``node.version == self.version``) until their tenant pages back.
         Returns the number of slabs purged. No-op when the version is
         unchanged."""
         if version == self.version:
@@ -324,6 +363,8 @@ class RadixPrefixIndex:
         self.version = version
         purged = 0
         for node in self._slab_nodes():
+            if version_retains(node.version, version):
+                continue
             self.total_bytes -= node.slab_bytes
             node.slab = None
             node.slab_bytes = 0

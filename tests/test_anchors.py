@@ -301,3 +301,27 @@ def test_bench_rag_scenario_anchor():
     assert '"single_dispatch_per_segment": single_dispatch' in mb_src
     gen_src = open(os.path.join(root, "tools", "gen_arch_numbers.py")).read()
     assert "llm_rag" in gen_src
+
+
+def test_bench_multitenant_scenario_anchor():
+    """The ``llm_1b_multitenant`` bench scenario is an acceptance
+    artifact (three tenants with distinct checkpoints and SLO classes
+    consolidated onto ONE paged server vs a dedicated server each:
+    per-tenant greedy AND seeded byte-identity probes across
+    demote→promote cycles, Zipf-mix paged-vs-dedicated tokens/s, the
+    per-tenant TTFT p99 split, and the pager/switch counters are read
+    from its entry): it must stay wired through BOTH model tiers, and
+    the numbers-table generator must know its key."""
+    import seldon_core_tpu.modelbench as modelbench
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    mb_src = open(modelbench.__file__).read()
+    assert mb_src.count('results["llm_1b_multitenant"]') >= 2  # tiny + chip
+    assert hasattr(modelbench, "bench_multitenant")
+    # the entry asserts the acceptance bits like prior scenarios
+    assert '"greedy_identical": greedy_identical' in mb_src
+    assert '"sampled_identical": sampled_identical' in mb_src
+    assert '"ttft_p99_ms_by_tenant": ttft_p99' in mb_src
+    assert '"page_ins": pager["page_ins"]' in mb_src
+    gen_src = open(os.path.join(root, "tools", "gen_arch_numbers.py")).read()
+    assert "llm_1b_multitenant" in gen_src

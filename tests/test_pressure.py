@@ -606,7 +606,7 @@ def test_ledger_components_track_live_state(model_and_params):
         # before the scheduler runs, the ledger is empty (direct call is
         # legal: no scheduler thread is alive yet)
         assert b._ledger_components() == {
-            "decode": 0, "staging": 0, "prefix": 0, "swap": 0,
+            "decode": 0, "staging": 0, "prefix": 0, "swap": 0, "pager": 0,
         }
         b.generate(PROMPTS[0], max_new_tokens=8)
         # the running scheduler refreshes the controller every poll;
@@ -623,7 +623,7 @@ def test_ledger_components_track_live_state(model_and_params):
         assert summary["budget_bytes"] == 1 << 30
         # metrics surface: the server-side gauges read this summary
         assert set(summary["components"]) == {
-            "decode", "staging", "prefix", "swap",
+            "decode", "staging", "prefix", "swap", "pager",
         }
     finally:
         b.close()

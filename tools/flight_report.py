@@ -337,6 +337,71 @@ def _migration_lines(
     return lines
 
 
+def _pager_lines(
+    page_ins: List[Dict[str, Any]],
+    page_outs: List[Dict[str, Any]],
+    switches: List[Dict[str, Any]],
+    pager: Dict[str, Any],
+    sched: Dict[str, Any],
+) -> List[str]:
+    """Multi-tenant weight-pager records (generate.md §13): page-in /
+    page-out cycles and tenant switches, plus a THRASH diagnosis when
+    tenants keep displacing each other inside one ring window — every
+    such cycle pays a host→HBM upload + swap drain that a longer
+    residency would have amortized."""
+    lines: List[str] = []
+    if switches:
+        forced = [s for s in switches if s.get("forced")]
+        costs = [s["cost_ms"] for s in switches if "cost_ms" in s]
+        avg_cost = sum(costs) / len(costs) if costs else 0.0
+        lines.append(
+            f"tenant switches: {len(switches)} flip(s) "
+            f"({len(forced)} forced by the starvation bound), "
+            f"avg page-in cost {avg_cost:.1f}ms"
+        )
+    if page_ins or page_outs:
+        lines.append(
+            f"weight pager: {len(page_ins)} page-in(s), "
+            f"{len(page_outs)} page-out(s) in the recorded window"
+        )
+    # thrash: two or more tenants each paged IN repeatedly inside one
+    # ring window — the working set is alternating faster than
+    # residency amortizes, so throughput tracks page-in bandwidth
+    per_tenant: Dict[str, int] = {}
+    for p in page_ins:
+        t = p.get("tenant")
+        if t:
+            per_tenant[t] = per_tenant.get(t, 0) + 1
+    cyclers = {t: n for t, n in per_tenant.items() if n >= 2}
+    if len(cyclers) >= 2:
+        worst = max(cyclers.items(), key=lambda kv: kv[1])
+        lines.append(
+            f"DIAGNOSIS: weight pager THRASH — {len(cyclers)} tenant(s) "
+            f"paged in repeatedly (worst {worst[0]!r}: {worst[1]} "
+            "page-ins in one ring window); each cycle pays drain + "
+            "host→HBM upload — raise tenant_min_resident_ms so the "
+            "batch-deeper rule amortizes residency, or give hot "
+            "tenants a dedicated member"
+        )
+    if pager:
+        lines.append(
+            f"weight pager staging: {pager.get('host_bytes', 0) / 1e6:.2f} "
+            f"of {pager.get('budget_bytes', 0) / 1e6:.2f} MB host RAM "
+            f"({len(pager.get('tenants') or [])} tenant(s), resident "
+            f"{pager.get('resident')!r}; {pager.get('evictions', 0)} "
+            f"eviction(s), {pager.get('refused', 0)} refusal(s), "
+            f"{pager.get('corrupt_dropped', 0)} corrupt drop(s))"
+        )
+    if sched:
+        queued = sched.get("queued") or {}
+        if queued:
+            lines.append(
+                "tenant queues at dump time: "
+                + ", ".join(f"{t}={n}" for t, n in sorted(queued.items()))
+            )
+    return lines
+
+
 def _fusion_lines(
     dispatches: List[Dict[str, Any]],
     fallbacks: List[Dict[str, Any]],
@@ -443,6 +508,11 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
     ]
     fused_disp = [e for e in entries if e.get("type") == "fused_dispatch"]
     fused_fb = [e for e in entries if e.get("type") == "fusion_fallback"]
+    page_ins = [e for e in entries if e.get("type") == "weight_page_in"]
+    page_outs = [e for e in entries if e.get("type") == "weight_page_out"]
+    tenant_switches = [
+        e for e in entries if e.get("type") == "tenant_switch"
+    ]
     lines.append(
         f"recorded {dump.get('recorded_total', len(entries))} records "
         f"(ring holds {len(entries)}, dropped "
@@ -504,6 +574,11 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
         lines.extend(_kv_lines(kv_exports, kv_inserts))
         lines.extend(_tier_lines(
             kv_demotes, kv_promotes, tier_hits, dump.get("kv_tier") or {}
+        ))
+        lines.extend(_pager_lines(
+            page_ins, page_outs, tenant_switches,
+            dump.get("weight_pager") or {},
+            dump.get("tenant_scheduler") or {},
         ))
         lines.extend(_fault_lines(restarts, ejects, readmits, degraded))
         lines.extend(_pressure_lines(
@@ -610,6 +685,13 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
     # -- tiered KV memory (host-RAM spill tier) -------------------------------
     lines.extend(_tier_lines(
         kv_demotes, kv_promotes, tier_hits, dump.get("kv_tier") or {}
+    ))
+
+    # -- multi-tenant weight paging -------------------------------------------
+    lines.extend(_pager_lines(
+        page_ins, page_outs, tenant_switches,
+        dump.get("weight_pager") or {},
+        dump.get("tenant_scheduler") or {},
     ))
 
     # -- fault tolerance (supervision, peer failover, degradation) -----------

@@ -325,6 +325,26 @@ def rows_from(mt, fronts):
                if gsh.get("greedy_identical") and gsh.get("sampled_identical")
                else ""),
         ))
+    gmt = mt.get("llm_1b_multitenant") or {}
+    if gmt:
+        ttfts = gmt.get("ttft_p99_ms_by_tenant") or {}
+        ttft_bit = ", ".join(
+            f"{t} {fmt(v, 2)}" for t, v in ttfts.items()
+        )
+        rows.append((
+            "generate(), multi-tenant weight paging "
+            f"({len(gmt.get('tenants') or {})} checkpoints, 1 server)",
+            f"{fmt(gmt.get('tokens_per_s'))} tok/s paged vs "
+            f"{fmt(gmt.get('dedicated_tokens_per_s'))} dedicated "
+            f"({gmt.get('throughput_ratio', '—')}x), "
+            f"{fmt(gmt.get('page_ins'))} page-in(s)"
+            + (f"; TTFT p99 ms by tenant: {ttft_bit}" if ttft_bit else ""),
+            f"Zipf {tuple(gmt.get('zipf') or ())} mix, "
+            "strict/standard/best_effort SLO classes"
+            + ("; greedy + seeded bytes identical across paging"
+               if gmt.get("greedy_identical") and gmt.get("sampled_identical")
+               else ""),
+        ))
     g1l = mt.get("llm_1b_long") or {}
     if g1l:
         mbu = f", MBU {g1l['mbu_pct']}%" if g1l.get("mbu_pct") is not None else ""
