@@ -4,7 +4,7 @@
 places that historically drifted independently: the registry's mapping
 tables in ``graph/engine_metrics.py`` (``_STEP_PHASES``,
 ``_KV_TRANSFER``, ``_RECOVERY``, ``_RECOVERY_GAUGES``, ``_SLO_TIMERS``,
-``_FUSED``),
+``_FUSED``, ``_DEVICE``, ``_DEVICE_GAUGES``, ``_SLO_BURN``),
 the servers that emit the ``gen_*`` keys those tables consume, the
 tools that parse the published series (``flight_report``,
 ``gen_arch_numbers``), and the operator docs. The rule re-derives the
@@ -53,7 +53,7 @@ __all__ = [
 
 _MAP_NAMES = {
     "_STEP_PHASES", "_KV_TRANSFER", "_RECOVERY", "_RECOVERY_GAUGES",
-    "_SLO_TIMERS", "_FUSED",
+    "_SLO_TIMERS", "_FUSED", "_DEVICE", "_DEVICE_GAUGES", "_SLO_BURN",
 }
 # built by concatenation so these source files never match their own
 # scanning patterns

@@ -92,6 +92,18 @@ class DeploymentController:
         # so a decode-pool scale event keeps pointing at live prefill
         # listeners instead of re-rolling every peer address.
         self._kv_ports: Dict[Tuple[str, str, int], int] = {}
+        # fleet telemetry plane: a deployment-scope metrics registry every
+        # member's /fleet snapshot merges into (per-member deltas, so a
+        # member restart resets cleanly), the previous snapshot per member
+        # the delta is diffed against, and the latest SLO burn verdicts
+        # per (dep.key, predictor) — the autoscaler's page-veto signal
+        from ..graph.engine_metrics import MetricsRegistry
+
+        self.fleet_metrics = MetricsRegistry()
+        self.fleet_period_s = 5.0
+        self._fleet_prev: Dict[str, Dict] = {}
+        self._fleet_units: Dict[str, Dict] = {}
+        self._burn_verdicts: Dict[Tuple[str, str], List[Dict]] = {}
 
     # -- desired state ------------------------------------------------------
 
@@ -900,6 +912,14 @@ class DeploymentController:
             total = sum(known)
             desired = min(hi, max(lo, math.ceil(total / target)))
             streak_key = (dep.key, pspec.name)
+            if self._worst_burn(dep.key, pspec.name) == "page":
+                # a paging SLO burn verdict (fast AND slow windows above
+                # the page rate) overrides the load signal: tenants are
+                # burning error budget even if per-replica load looks
+                # fine, so veto any scale-down streak and apply one
+                # replica of upward pressure (clamped to hi/placement)
+                self._scale_down_streak.pop(streak_key, None)
+                desired = min(hi, max(desired, current + 1))
             if desired > current:
                 self._scale_down_streak.pop(streak_key, None)
                 new_replicas[pspec.name] = desired
@@ -931,6 +951,82 @@ class DeploymentController:
             logger.info("autoscale %s/%s -> %d replicas", dep.key, name, n)
         return changes
 
+    # -- fleet telemetry scrape ---------------------------------------------
+
+    async def fleet_scrape_once(self) -> Dict[str, Dict]:
+        """Pull every member's /fleet payload, diff it against the
+        member's previous snapshot, and merge the deltas into the
+        deployment-scope ``fleet_metrics`` registry with
+        member/predictor/deployment labels — the one pane of glass for
+        disagg/sharded/multi-tenant deployments. Also refreshes the SLO
+        burn verdict feed the autoscaler consumes. Returns the latest
+        unit summaries per member (tools/smoke assert on them)."""
+        from ..graph.engine_metrics import diff_fleet_snapshot
+
+        live = set()
+        # verdicts accumulate across a predictor's MEMBERS (an idle
+        # member's empty list must not mask a hot member's page)
+        burn: Dict[Tuple[str, str], List[Dict]] = {}
+        for name, (handle, _) in list(self.components.items()):
+            snap = await handle.fleet()
+            if snap is None:
+                continue
+            live.add(name)
+            labels = {
+                "deployment": handle.spec.deployment,
+                "predictor": handle.spec.predictor,
+                "member": name,
+            }
+            metrics = snap.get("metrics") or {}
+            self.fleet_metrics.ingest_fleet(
+                diff_fleet_snapshot(self._fleet_prev.get(name), metrics),
+                labels,
+            )
+            self._fleet_prev[name] = metrics
+            units = snap.get("units") or {}
+            self._fleet_units[name] = units
+            burn.setdefault(
+                (handle.spec.deployment, handle.spec.predictor), []
+            ).extend(
+                v
+                for unit in units.values()
+                for v in (unit.get("slo_burn") or {}).get("verdicts", [])
+            )
+        self._burn_verdicts = burn
+        # members torn down since the last scrape must not leave stale
+        # snapshots (a re-created member under the same name would diff
+        # against its predecessor's totals)
+        for name in list(self._fleet_prev):
+            if name not in live:
+                del self._fleet_prev[name]
+                self._fleet_units.pop(name, None)
+        return dict(self._fleet_units)
+
+    def fleet_summary(self) -> Dict[str, Dict]:
+        """Deployment-level rollup: the merged metric plane plus the
+        latest per-member unit summaries and burn verdicts."""
+        return {
+            "metrics": self.fleet_metrics.fleet_snapshot(),
+            "members": dict(self._fleet_units),
+            "burn_verdicts": {
+                f"{dep}/{pred}": v
+                for (dep, pred), v in self._burn_verdicts.items()
+            },
+        }
+
+    def _worst_burn(self, dep_key: str, predictor: str) -> str:
+        """Worst burn severity across a predictor's members (``ok`` when
+        no verdicts have been scraped)."""
+        from ..serving.slo_burn import SEVERITIES
+
+        worst = 0
+        for v in self._burn_verdicts.get((dep_key, predictor), []):
+            try:
+                worst = max(worst, SEVERITIES.index(v.get("severity")))
+            except ValueError:
+                continue
+        return SEVERITIES[worst]
+
     async def run(self, stop_event: Optional[asyncio.Event] = None) -> None:
         """Consume store events forever (controller-runtime manager parity,
         reference: operator/main.go:49-93). The autoscaler evaluates every
@@ -942,6 +1038,7 @@ class DeploymentController:
         loop = asyncio.get_running_loop()
         next_autoscale = loop.time() + self.autoscale_period_s
         next_rollout = loop.time() + self.rollout_period_s
+        next_fleet = loop.time() + self.fleet_period_s
         try:
             while stop_event is None or not stop_event.is_set():
                 if loop.time() >= next_autoscale:
@@ -951,6 +1048,13 @@ class DeploymentController:
                     except Exception:  # noqa: BLE001 - probe hiccups must
                         # not kill the manager loop
                         logger.exception("autoscale pass failed")
+                if loop.time() >= next_fleet:
+                    next_fleet = loop.time() + self.fleet_period_s
+                    try:
+                        await self.fleet_scrape_once()
+                    except Exception:  # noqa: BLE001 - a slow/dead member's
+                        # scrape must not kill the manager loop
+                        logger.exception("fleet scrape failed")
                 if loop.time() >= next_rollout:
                     next_rollout = loop.time() + self.rollout_period_s
                     try:

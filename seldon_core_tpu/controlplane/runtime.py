@@ -106,6 +106,12 @@ class ComponentHandle:
         this component kind has no load probe."""
         return None
 
+    async def fleet(self) -> Optional[dict]:
+        """This member's /fleet telemetry payload (metric snapshot +
+        profiler/burn summaries); None when the component kind has no
+        fleet scrape."""
+        return None
+
 
 class _InProcessHandle(ComponentHandle):
     def __init__(
@@ -137,6 +143,15 @@ class _InProcessHandle(ComponentHandle):
         if self.app is None:
             return None
         return float(getattr(self.app, "inflight", 0))
+
+    async def fleet(self) -> Optional[dict]:
+        fn = getattr(self.app, "fleet_summary", None)
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception:  # noqa: BLE001 - telemetry must not fail ops
+            return None
 
     async def stop(self) -> None:
         # graceful drain before teardown (reference preStop idiom:
@@ -302,6 +317,21 @@ class _SubprocessHandle(ComponentHandle):
             None, self._probe_inflight
         )
         return None if out is None else out
+
+    async def fleet(self) -> Optional[dict]:
+        if self.proc.poll() is not None:
+            return None
+
+        def probe() -> Optional[dict]:
+            try:
+                with urllib.request.urlopen(
+                    f"{self.url}/fleet", timeout=2.0
+                ) as r:
+                    return json.loads(r.read())
+            except Exception:
+                return None
+
+        return await asyncio.get_running_loop().run_in_executor(None, probe)
 
     async def stop(self) -> None:
         # graceful drain first (reference preStop: curl /pause; sleep —

@@ -193,6 +193,41 @@ class MetricsRegistry:
         "gen_tenants_registered": "seldon_engine_tenants_registered",
     }
 
+    # device-time ledger (serving/profiler.py): per-executable dispatch
+    # attribution — seconds/dispatches/bytes with (kind, variant[,
+    # tenant]) labels. rate(seldon_engine_device_time_seconds) by kind
+    # is the live answer to "which executable burns the accelerator",
+    # the question the offline modelbench roofline could only answer
+    # per-capture. gen_device_time_ms ships as ms (CounterDeltas keeps
+    # integers honest) and lands in seconds here, matching every other
+    # *_seconds series.
+    _DEVICE = {
+        "gen_device_time_ms": "seldon_engine_device_time_seconds",
+        "gen_device_dispatches": "seldon_engine_device_dispatches",
+        "gen_device_bytes": "seldon_engine_device_bytes",
+    }
+
+    # SLO burn-rate verdict evaluations per (slo, severity[, tenant]) —
+    # rate of {severity="page"} is the alert feed
+    _SLO_BURN = {
+        "gen_slo_verdicts": "seldon_engine_slo_burn_verdicts",
+    }
+
+    # live derived gauges over the ledger's sliding window: fraction of
+    # wall time spent in measured dispatches, live MBU (bytes-read rate
+    # over the measured HBM bandwidth), and how much of wall time the
+    # measured per-dispatch floor alone would consume at the observed
+    # dispatch rate — plus the burn engine's per-(tenant, slo) burn
+    # rates and remaining error budget
+    _DEVICE_GAUGES = {
+        "gen_device_busy_frac": "seldon_engine_device_busy_frac",
+        "gen_mbu_pct": "seldon_engine_mbu_pct",
+        "gen_dispatch_floor_pct": "seldon_engine_dispatch_floor_pct",
+        "gen_slo_burn_rate": "seldon_engine_slo_burn_rate",
+        "gen_slo_budget_remaining":
+            "seldon_engine_slo_budget_remaining",
+    }
+
     # generate SLO TIMERs (per completed request, shipped by the generate
     # server's metrics() hook) additionally land in first-class latency
     # histograms per graph node: TTFT, TPOT/inter-token latency, and
@@ -239,11 +274,25 @@ class MetricsRegistry:
                 fused = self._FUSED.get(key)
                 if fused is not None:
                     self.counter_inc(fused, tags, val)
+                dev = self._DEVICE.get(key)
+                if dev is not None:
+                    # ms on the wire -> seconds in the series (bytes and
+                    # dispatch counts pass through unscaled)
+                    self.counter_inc(
+                        dev, tags,
+                        val / 1000.0 if key == "gen_device_time_ms" else val,
+                    )
+                burn = self._SLO_BURN.get(key)
+                if burn is not None:
+                    self.counter_inc(burn, tags, val)
             elif mtype == "GAUGE":
                 self.gauge_set(f"seldon_custom_{key}", val, tags)
                 rg = self._RECOVERY_GAUGES.get(key)
                 if rg is not None:
                     self.gauge_set(rg, val, tags)
+                dg = self._DEVICE_GAUGES.get(key)
+                if dg is not None:
+                    self.gauge_set(dg, val, tags)
             elif mtype == "TIMER":
                 self.observe(f"seldon_custom_{key}", val / 1000.0, tags)
                 slo = self._SLO_TIMERS.get(key)
@@ -299,6 +348,77 @@ class MetricsRegistry:
                 prev = b
             return prev
 
+    # -- fleet plane (cross-member aggregation) -----------------------------
+
+    def fleet_snapshot(self) -> Dict[str, Dict]:
+        """JSON-safe dump of every series — counters/gauges with their
+        label sets, histograms with full bucket arrays — the ``/fleet``
+        endpoint ships so a scraper can MERGE members instead of
+        re-deriving quantiles from quantiles (bucket counts add; p99s
+        don't)."""
+        def pack(series):
+            return [
+                {"labels": dict(key), "value": v}
+                for key, v in series.items()
+            ]
+
+        with self._lock:
+            return {
+                "counters": {
+                    n: pack(s) for n, s in self._counters.items()
+                },
+                "gauges": {n: pack(s) for n, s in self._gauges.items()},
+                "histograms": {
+                    n: [
+                        {"labels": dict(key), "h": list(h)}
+                        for key, h in s.items()
+                    ]
+                    for n, s in self._histograms.items()
+                },
+                "buckets": list(_BUCKETS),
+            }
+
+    def ingest_fleet(self, snapshot: Dict[str, Dict],
+                     extra_labels: Dict[str, str] | None = None) -> None:
+        """Merge one member's :meth:`fleet_snapshot` into THIS registry
+        (the reconciler's deployment-scope registry): counters and
+        histogram buckets ADD, gauges overwrite per label set. The
+        caller is responsible for diffing snapshots between scrapes
+        (counters here are cumulative totals) — the reconciler ships
+        deltas, so a member restart resets cleanly instead of
+        double-counting. ``extra_labels`` (member/deployment/pool) keeps
+        per-member series distinguishable after the merge."""
+        extra = extra_labels or {}
+        snap_buckets = snapshot.get("buckets")
+        if snap_buckets is not None and list(snap_buckets) != list(_BUCKETS):
+            # a member on a different histogram grid cannot merge — skip
+            # its histograms rather than silently misbinning
+            snapshot = {**snapshot, "histograms": {}}
+        for name, series in (snapshot.get("counters") or {}).items():
+            for ent in series:
+                self.counter_inc(
+                    name, {**ent["labels"], **extra},
+                    float(ent["value"]),
+                )
+        for name, series in (snapshot.get("gauges") or {}).items():
+            for ent in series:
+                self.gauge_set(
+                    name, float(ent["value"]), {**ent["labels"], **extra},
+                )
+        with self._lock:
+            for name, series in (snapshot.get("histograms") or {}).items():
+                for ent in series:
+                    key = _labels_key({**ent["labels"], **extra})
+                    src = [float(x) for x in ent["h"]]
+                    if len(src) != len(_BUCKETS) + 2:
+                        continue
+                    h = self._histograms[name].get(key)
+                    if h is None:
+                        self._histograms[name][key] = src
+                    else:
+                        for i, x in enumerate(src):
+                            h[i] += x
+
     def expose(self) -> str:
         lines: List[str] = []
         with self._lock:
@@ -321,6 +441,62 @@ class MetricsRegistry:
                     lines.append(f"{name}_sum{_fmt_labels(key)} {h[-2]}")
                     lines.append(f"{name}_count{_fmt_labels(key)} {h[-1]}")
         return "\n".join(lines) + "\n"
+
+
+def diff_fleet_snapshot(prev: Dict | None, cur: Dict) -> Dict:
+    """Per-member delta between two :meth:`MetricsRegistry.fleet_snapshot`
+    captures — what the reconciler feeds :meth:`ingest_fleet` so the
+    deployment-scope registry accumulates honestly across scrapes.
+    Counters and histogram buckets diff elementwise; a negative delta
+    (member restarted, totals reset) falls back to the current total —
+    count the fresh life rather than losing it. Gauges are levels and
+    pass straight through."""
+    if not prev:
+        return cur
+
+    def key(ent):
+        return tuple(sorted(ent["labels"].items()))
+
+    out: Dict[str, Dict] = {
+        "counters": {},
+        "gauges": cur.get("gauges") or {},
+        "histograms": {},
+        "buckets": cur.get("buckets"),
+    }
+    for name, series in (cur.get("counters") or {}).items():
+        pmap = {
+            key(e): float(e["value"])
+            for e in (prev.get("counters") or {}).get(name, [])
+        }
+        ents = []
+        for e in series:
+            d = float(e["value"]) - pmap.get(key(e), 0.0)
+            if d < 0:
+                d = float(e["value"])
+            if d:
+                ents.append({"labels": e["labels"], "value": d})
+        if ents:
+            out["counters"][name] = ents
+    for name, series in (cur.get("histograms") or {}).items():
+        pmap = {
+            key(e): e["h"]
+            for e in (prev.get("histograms") or {}).get(name, [])
+        }
+        ents = []
+        for e in series:
+            h = [float(x) for x in e["h"]]
+            ph = pmap.get(key(e))
+            if ph is not None and len(ph) == len(h):
+                dh = [a - float(b) for a, b in zip(h, ph)]
+                if any(x < 0 for x in dh):
+                    dh = h
+            else:
+                dh = h
+            if any(dh):
+                ents.append({"labels": e["labels"], "h": dh})
+        if ents:
+            out["histograms"][name] = ents
+    return out
 
 
 REGISTRY = MetricsRegistry()

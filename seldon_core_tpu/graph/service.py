@@ -166,6 +166,32 @@ class EngineApp:
         except Exception:  # noqa: BLE001 - half-built graph during teardown
             return
 
+    def fleet_summary(self) -> Dict[str, Any]:
+        """The ``/fleet`` scrape payload: this member's FULL metric
+        state (counters/gauges/histogram bucket arrays — mergeable,
+        unlike quantiles) plus every unit's device-time profiler summary
+        and SLO burn-rate verdict feed. The reconciler's fleet loop
+        pulls this from every member, delta-diffs it, and merges into
+        deployment-level series (engine_metrics.ingest_fleet). Before
+        snapshotting, each unit's pending metrics() deltas are flushed
+        so a scrape between requests still sees fresh ledger/burn state."""
+        units: Dict[str, Any] = {}
+        for name, target in self.units_with("metrics"):
+            self._flush_unit_metrics(target)
+        for name, target in self.units_with("profiler"):
+            prof = target.profiler
+            if prof is not None and prof.enabled:
+                units.setdefault(name, {})["profiler"] = prof.summary()
+        for name, target in self.units_with("slo_burn"):
+            burn = target.slo_burn
+            if burn is not None:
+                units.setdefault(name, {})["slo_burn"] = burn.summary()
+        return {
+            "predictor": self.spec.name,
+            "metrics": self.metrics.fleet_snapshot(),
+            "units": units,
+        }
+
     def _flush_unit_metrics(self, unit) -> None:
         """Fold one in-process unit's ``metrics()`` deltas into the
         registry outside the response path — for events (drain,
@@ -548,6 +574,9 @@ class EngineApp:
                 )
             return Response({"units": units})
 
+        async def fleet(req: Request) -> Response:
+            return Response(self.fleet_summary())
+
         app.add_route("/api/v0.1/predictions", predictions)
         app.add_route("/api/v1.0/predictions", predictions)
         app.add_route("/predict", predictions)
@@ -816,6 +845,7 @@ class EngineApp:
         app.add_route("/prometheus", prometheus)
         app.add_route("/traces", traces)
         app.add_route("/flightrecorder", flightrecorder)
+        app.add_route("/fleet", fleet)
         return app
 
     # -- gRPC front ---------------------------------------------------------

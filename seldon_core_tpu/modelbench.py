@@ -791,6 +791,7 @@ def bench_generate(
     recorder_probe: bool = False,
     fused_steps_per_dispatch: int = 0,
     fused_probe: bool = False,
+    profiler_probe: bool = False,
 ) -> Dict[str, Any]:
     """DecoderLM generate() through engine REST + continuous batcher.
 
@@ -820,7 +821,15 @@ def bench_generate(
     flight-recorder overhead guard: two same-session windows with the
     scheduler flight recorder ON vs OFF plus a greedy byte-identity
     check — the published ``flight_recorder_probe.overhead_pct`` is what
-    the <=2% leave-it-on budget is audited against."""
+    the <=2% leave-it-on budget is audited against. ``profiler_probe``
+    runs the same guard for the device-time ledger
+    (``serving/profiler.py``): the server is built with the profiler ON,
+    two same-session windows toggle it, and the published
+    ``profiler_probe`` entry carries ``overhead_pct`` (same <=2% budget),
+    greedy byte-identity across the toggle, the cumulative per-kind
+    device-time breakdown, and the live MBU / busy-fraction gauges the
+    ledger derives over its sliding window (MBU only when ``hbm_gb_s``
+    supplies the denominator)."""
     import http.client
 
     from .servers.generateserver import GenerateServer
@@ -844,7 +853,14 @@ def bench_generate(
     )
     component = GenerateServer(
         depth_groups=depth_groups, prefill_chunk=prefill_chunk,
-        fused_steps_per_dispatch=fused_steps_per_dispatch, **server_kw
+        fused_steps_per_dispatch=fused_steps_per_dispatch,
+        # the probe audits the leave-it-on budget, so the measured server
+        # boots with the ledger ON in its default (shallow) mode; the
+        # measured HBM roofline doubles as the live-MBU denominator
+        **({"profiler": 1,
+            **({"profiler_hbm_gb_s": hbm_gb_s} if hbm_gb_s else {})}
+           if profiler_probe else {}),
+        **server_kw
     )
     component.load()
     greedy_identical = None
@@ -905,6 +921,7 @@ def bench_generate(
     k_burst = component.batcher._k
     recorder_stats: Optional[Dict[str, Any]] = None
     fused_stats: Optional[Dict[str, Any]] = None
+    profiler_stats: Optional[Dict[str, Any]] = None
     try:
         for _ in range(max(1, runs)):
             bstats0: Dict[str, Any] = {}
@@ -1009,6 +1026,55 @@ def bench_generate(
                 "sampled_identical": on_s == off_s,
                 "seconds_per_mode": round(probe_s, 2),
             }
+        if profiler_probe and component.profiler.enabled:
+            # device-time ledger leave-it-on guard: ON vs OFF windows on
+            # the SAME loaded server (same session, same compile caches)
+            # plus greedy byte-identity across the toggle — the hooks
+            # wrap dispatches without touching arguments or results, and
+            # this probe is where that claim is priced: overhead_pct is
+            # audited against the same <=2% budget as the flight
+            # recorder. The ledger summary is read right after the ON
+            # window so the sliding-window gauges (MBU, busy fraction)
+            # reflect the measured traffic, not a drained pipeline.
+            led = component.profiler
+            probe_body = {"prompt_tokens": [prompt],
+                          "max_new_tokens": max_new_tokens,
+                          "temperature": 0.0}
+            probe_s = max(1.0, seconds / 2.0)
+            prof_ref_on = component.predict(dict(probe_body), [])["tokens"][0]
+            w_prof_on = closed_loop(
+                make_call, probe_s, concurrency, warmup_calls=1
+            )
+            led_summary = led.summary()
+            led.enabled = False
+            try:
+                prof_ref_off = component.predict(
+                    dict(probe_body), [])["tokens"][0]
+                w_prof_off = closed_loop(
+                    make_call, probe_s, concurrency, warmup_calls=1
+                )
+            finally:
+                led.enabled = True
+            profiler_stats = {
+                "profiler_on_tokens_per_s": w_prof_on["rows_per_s"],
+                "profiler_off_tokens_per_s": w_prof_off["rows_per_s"],
+                "overhead_pct": round(
+                    100.0
+                    * (w_prof_off["rows_per_s"] - w_prof_on["rows_per_s"])
+                    / max(w_prof_off["rows_per_s"], 1e-9),
+                    2,
+                ),
+                "greedy_identical": prof_ref_on == prof_ref_off,
+                "seconds_per_mode": round(probe_s, 2),
+                "device_time_s": led_summary["device_time_s"],
+                "by_kind": led_summary["by_kind"],
+                **{
+                    k: led_summary[k]
+                    for k in ("device_busy_frac", "mbu_pct",
+                              "dispatch_floor_pct")
+                    if k in led_summary
+                },
+            }
     finally:
         harness.stop()
         if component.batcher is not None:
@@ -1084,6 +1150,8 @@ def bench_generate(
         stats["greedy_probe"] = len(probe_prompts)
     if recorder_stats is not None:
         stats["flight_recorder_probe"] = recorder_stats
+    if profiler_stats is not None:
+        stats["profiler_probe"] = profiler_stats
     if dispatch_floor:
         # dispatch-floor roofline (VERDICT r5 #2/#6): a burst can never
         # beat one host round trip, so tokens/s <= slots x k / floor.
@@ -3554,6 +3622,11 @@ def run_model_tier(
                 peak=peak,
                 dispatch_floor=True,
                 recorder_probe=True,
+                profiler_probe=True,
+                # small-buffer roofline: the tiny tier only needs an
+                # honest denominator for the probe's live-MBU gauge, not
+                # a publication-grade bandwidth number
+                hbm_gb_s=measure_hbm_gb_s(nbytes=16 << 20, n_lo=5, n_hi=30),
             )
             # degraded-mode harness proof (chip runs the llm_1b variant)
             results["llm_degraded"] = bench_degraded(

@@ -140,6 +140,55 @@ class DecoderLM(ServedModel):
         cache_read = cfg.n_layers * kv_bytes_per_tok_layer * context_len
         return self.n_params() * param_bytes / max(1, batch) + cache_read
 
+    def kv_bytes_per_token(self) -> int:
+        """K+V bytes ONE cached position occupies across every layer
+        (bf16) — the per-(row, position) unit every read model below is
+        priced in, and the closed-form twin of the batcher's
+        ``_kv_key_bytes`` (which reads the live cache's dtypes/shapes)."""
+        cfg = self.cfg
+        return cfg.n_layers * 2 * cfg.n_kv_heads * cfg.head_dim * 2
+
+    def dispatch_read_bytes(
+        self,
+        kind: str,
+        *,
+        rows: int = 1,
+        k: int = 1,
+        bucket: int = 0,
+        tokens: int = 0,
+        param_bytes: float = None,
+        kv_row_bytes: float = None,
+    ) -> float:
+        """Modeled HBM bytes READ by ONE warmed-executable dispatch of the
+        given kind — the static cost model the serving-time device-time
+        ledger attributes MBU with (``serving/profiler.py``), shared with
+        modelbench's offline MBU so live and bench numbers use one basis.
+
+        ``param_bytes``/``kv_row_bytes`` default to the unsharded bf16
+        closed forms; the batcher passes its live (shard-aware) values.
+        Decode-family bursts read the params once per step plus each
+        row's bucketed KV columns; prefill-family dispatches read the
+        params once and write (not read) their KV, so params dominate;
+        splice/extract move ``tokens`` cache positions; a swap cast
+        touches every param byte once."""
+        if param_bytes is None:
+            param_bytes = self.n_params() * 2.0
+        if kv_row_bytes is None:
+            kv_row_bytes = float(self.kv_bytes_per_token())
+        if kind in ("decode_burst", "fused_burst", "group_burst"):
+            return k * (param_bytes + rows * bucket * kv_row_bytes)
+        if kind == "spec_burst":
+            # verify chunk: one full forward over gamma+1 positions per
+            # lane; drafts are priced by the caller (their params differ)
+            return k * (param_bytes + rows * bucket * kv_row_bytes)
+        if kind in ("prefill", "chunk_prefill", "replay"):
+            return param_bytes + tokens * kv_row_bytes
+        if kind in ("splice", "insert", "extract"):
+            return tokens * kv_row_bytes
+        if kind == "swap_cast":
+            return param_bytes
+        return 0.0
+
     # ------------------------------------------------------------------
     # params
     # ------------------------------------------------------------------

@@ -122,8 +122,11 @@ class RolloutState:
     events: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
 
     def event(self, kind: str, **fields) -> None:
-        # seldon-lint: disable=wall-clock (operator-facing event-trail stamp)
-        entry = {"t": time.time(), "event": kind, **fields}
+        from ..tracing import wall_us
+
+        # monotonic-anchored wall stamp: an NTP step mid-rollout must not
+        # reorder the event trail the analysis windows are read against
+        entry = {"t": wall_us() / 1e6, "event": kind, **fields}
         self.events.append(entry)
         if len(self.events) > MAX_EVENTS:
             del self.events[: len(self.events) - MAX_EVENTS]

@@ -25,7 +25,6 @@ from __future__ import annotations
 import asyncio
 import collections
 import logging
-import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .differ import diff_responses
@@ -124,9 +123,12 @@ class ShadowMirror:
             self._count(
                 "diverged", 1, predictor=name, kind=verdict.get("kind", "opaque")
             )
+            from ..tracing import wall_us
+
             self.recent.append(
-                # seldon-lint: disable=wall-clock (divergence-trail stamp)
-                {"t": time.time(), "predictor": name, **verdict}
+                # monotonic-anchored stamp keeps the divergence trail
+                # ordered through NTP steps
+                {"t": wall_us() / 1e6, "predictor": name, **verdict}
             )
 
     # -- accounting ----------------------------------------------------------

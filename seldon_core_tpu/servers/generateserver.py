@@ -224,6 +224,8 @@ class GenerateServer(SeldonComponent):
     tenant_pager = None
     tenant_scheduler = None
     batcher = None
+    profiler = None
+    slo_burn = None
 
     def __init__(
         self,
@@ -271,6 +273,13 @@ class GenerateServer(SeldonComponent):
         tenant_tick_ms: int = 20,
         tenant_max_wait_polls: int = 256,
         tenant_min_resident_ms: int = 50,
+        profiler: int = 0,
+        profiler_deep_every: int = 0,
+        profiler_hbm_gb_s: float = 0.0,
+        profiler_dispatch_floor_us: float = 0.0,
+        slo_objectives: Optional[str] = None,
+        slo_fast_window_s: float = 60.0,
+        slo_slow_window_s: float = 3600.0,
         **kwargs,
     ):
         self.model_uri = model_uri
@@ -396,6 +405,48 @@ class GenerateServer(SeldonComponent):
         self._tenant_min_resident_ms = int(tenant_min_resident_ms)
         self.tenant_pager = None      # WeightPager, set at load
         self.tenant_scheduler = None  # TenantScheduler, set at load
+        # device-time profiler (serving/profiler.py): off by default —
+        # the ledger is a shared no-op then, and the identity/overhead
+        # gates in tests/test_profiler.py hold it to byte-identical
+        # output. The MBU / dispatch-floor denominators are knobs so
+        # the live gauges use MEASURED numbers (modelbench publishes
+        # them) — 0 omits the gauge rather than publishing a guess.
+        from ..serving.profiler import DeviceTimeLedger
+
+        self.profiler = DeviceTimeLedger(
+            enabled=bool(int(profiler)),
+            deep_every=int(profiler_deep_every),
+            hbm_gb_s=float(profiler_hbm_gb_s),
+            dispatch_floor_us=float(profiler_dispatch_floor_us),
+        )
+        # SLO burn-rate engine (serving/slo_burn.py), fed by the same
+        # completed-request TTFT/TPOT/queue-wait drain /metrics exports.
+        # Grammar: "slo:threshold_ms:target" CSV, e.g.
+        # "ttft:200:0.99,queue_wait:50:0.999" — strict parse at
+        # construction, same contract as the tenants spec.
+        self.slo_burn = None
+        if slo_objectives:
+            from ..serving.slo_burn import SloBurnEngine, SloObjective
+
+            objs = []
+            for ent in str(slo_objectives).split(","):
+                ent = ent.strip()
+                if not ent:
+                    continue
+                parts = ent.split(":")
+                if len(parts) != 3:
+                    raise ValueError(
+                        "slo_objectives entries are slo:threshold_ms:target "
+                        f"(e.g. ttft:200:0.99), got {ent!r}"
+                    )
+                objs.append(SloObjective(
+                    parts[0].strip(), float(parts[1]) * 1e-3, float(parts[2])
+                ))
+            self.slo_burn = SloBurnEngine(
+                objs,
+                fast_window_s=float(slo_fast_window_s),
+                slow_window_s=float(slo_slow_window_s),
+            )
         self._extra = kwargs
         self.batcher = None
         self._model = None
@@ -538,6 +589,7 @@ class GenerateServer(SeldonComponent):
             kv_tier_promote_min_tokens=self._kv_tier_promote_min_tokens,
             swap_drain_ms=self._swap_drain_ms,
             swap_resume_policy=self._swap_resume_policy,
+            profiler=self.profiler,
         )
         # chaos harness (off without SELDON_FAULTS): the scheduler
         # section wires induced poll death onto the batcher's fault
@@ -1601,6 +1653,10 @@ class GenerateServer(SeldonComponent):
             out["weight_pager"] = self.tenant_pager.summary()
         if self.tenant_scheduler is not None:
             out["tenant_scheduler"] = self.tenant_scheduler.summary()
+        if self.profiler is not None and self.profiler.enabled:
+            out["profiler"] = self.profiler.summary()
+        if self.slo_burn is not None:
+            out["slo_burn"] = self.slo_burn.summary()
         return out
 
     def metrics(self) -> List[Dict]:
@@ -1803,6 +1859,12 @@ class GenerateServer(SeldonComponent):
             if tpot is not None:
                 out.append({"type": "TIMER", "key": "gen_tpot_ms",
                             "value": round(tpot * 1e3, 4)})
+            if self.slo_burn is not None:
+                # the burn engine rides the SAME drain: one sample feed,
+                # two consumers (histograms + error budgets)
+                self.slo_burn.observe("queue_wait", queue_wait)
+                self.slo_burn.observe("ttft", ttft)
+                self.slo_burn.observe("tpot", tpot)
         if self.tenant_pager is not None:
             # multi-tenant serving: pager counters/levels plus PER-TENANT
             # request counters and SLO timer triples, each tagged with
@@ -1837,6 +1899,10 @@ class GenerateServer(SeldonComponent):
                         queue_wait, ttft, tpot = tp.popleft()
                     except IndexError:  # raced another exporter thread
                         break
+                    if self.slo_burn is not None:
+                        self.slo_burn.observe("queue_wait", queue_wait, t)
+                        self.slo_burn.observe("ttft", ttft, t)
+                        self.slo_burn.observe("tpot", tpot, t)
                     tags = {"tenant": t}
                     out.append({"type": "TIMER",
                                 "key": "gen_tenant_queue_wait_ms",
@@ -1850,4 +1916,55 @@ class GenerateServer(SeldonComponent):
                                     "key": "gen_tenant_tpot_ms",
                                     "value": round(tpot * 1e3, 4),
                                     "tags": tags})
+        if self.profiler is not None and self.profiler.enabled:
+            # device-time ledger: cumulative per-(kind, variant, tenant)
+            # buckets ship as COUNTER deltas — engine_metrics maps them
+            # to the seldon_engine_device_* series with the attribution
+            # as labels — plus the live gauges the sliding window backs
+            for (kind, variant, tenant), (secs, n, nbytes, _toks) in sorted(
+                self.profiler.buckets().items()
+            ):
+                tags = {"kind": kind, "variant": variant}
+                if tenant:
+                    tags["tenant"] = tenant
+                out.append(delta(
+                    "gen_device_time_ms",
+                    round(secs * 1e3, 3), tags=tags,
+                ))
+                out.append(delta("gen_device_dispatches", n, tags=tags))
+                out.append(delta("gen_device_bytes", nbytes, tags=tags))
+            live = self.profiler.gauges()
+            for key, name in (("device_busy_frac", "gen_device_busy_frac"),
+                              ("mbu_pct", "gen_mbu_pct"),
+                              ("dispatch_floor_pct",
+                               "gen_dispatch_floor_pct")):
+                val = live.get(key)
+                if val is not None:
+                    out.append({"type": "GAUGE", "key": name,
+                                "value": float(val)})
+        if self.slo_burn is not None:
+            # burn-rate verdicts: per-(tenant, slo) gauges + a severity
+            # counter — the fleet scrape and the reconciler's scale
+            # signals read the same feed via slo_verdicts()
+            for v in self.slo_burn.verdicts():
+                tags = {"slo": v["slo"], "window": "fast"}
+                if v["tenant"]:
+                    tags["tenant"] = v["tenant"]
+                out.append({"type": "GAUGE", "key": "gen_slo_burn_rate",
+                            "value": v["fast_burn"], "tags": dict(tags)})
+                tags["window"] = "slow"
+                out.append({"type": "GAUGE", "key": "gen_slo_burn_rate",
+                            "value": v["slow_burn"], "tags": dict(tags)})
+                del tags["window"]
+                out.append({"type": "GAUGE",
+                            "key": "gen_slo_budget_remaining",
+                            "value": v["budget_remaining"],
+                            "tags": dict(tags)})
+            for (t, slo, sev), n in sorted(
+                self.slo_burn.verdict_counts().items()
+            ):
+                tags = {"slo": slo, "severity": sev}
+                if t:
+                    tags["tenant"] = t
+                out.append(delta("gen_slo_verdicts", n, tags=tags))
         return out

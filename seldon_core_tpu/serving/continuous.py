@@ -307,6 +307,7 @@ class ContinuousBatcher:
         kv_tier_promote_min_tokens: int = 0,
         swap_drain_ms: int = 0,
         swap_resume_policy: str = "resume",
+        profiler=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -593,6 +594,18 @@ class ContinuousBatcher:
             FlightRecorder(flight_recorder_capacity)
             if int(flight_recorder_capacity) > 0
             else None
+        )
+        # device-time ledger (serving/profiler.py): every warmed-
+        # executable dispatch below runs inside ``self._prof.measure``.
+        # A disabled ledger's measure() is a shared no-op — the hooks
+        # cost one attribute check — and the hooks never touch the
+        # dispatched computation, so profiler on vs off is byte-
+        # identical and compiles nothing new (tests/test_profiler.py
+        # pins both).
+        from .profiler import DeviceTimeLedger
+
+        self._prof = (
+            profiler if profiler is not None else DeviceTimeLedger()
         )
         # test/debug hook: set to a list and every dispatched decode
         # (sub)burst appends {"lanes", "attn_len", "need"} — the
@@ -1815,13 +1828,18 @@ class ContinuousBatcher:
                 buf = np.zeros((1, C), np.int32)
                 buf[0, : end - s] = tokens[s:end]
                 attn_len = min(bucket, self._attn_need(s + C))
-                with device_trace("gen.prefill_chunk"):
+                with self._prof.measure(
+                    "chunk_prefill", variant=f"b{bucket}",
+                    bytes_read=self._param_bytes + C * self._kv_key_bytes,
+                    tokens=C,
+                ) as _m, device_trace("gen.prefill_chunk"):
                     slab, first, key = self._chunk_fn(
                         self.params, slab, jnp.asarray(buf),
                         jnp.int32(s), jnp.int32(n - 1 - s),
                         jnp.int32(seed), jnp.float32(temperature),
                         attn_len, is_last,
                     )
+                    _m.sync(slab)
                 chunks += 1
                 if is_last:
                     break
@@ -1830,12 +1848,17 @@ class ContinuousBatcher:
         else:
             prompt = np.zeros((1, bucket), np.int32)
             prompt[0, :n] = tokens
-            with device_trace("gen.prefill"):
+            with self._prof.measure(
+                "prefill", variant=f"p{bucket}",
+                bytes_read=self._param_bytes + bucket * self._kv_key_bytes,
+                tokens=bucket,
+            ) as _m, device_trace("gen.prefill"):
                 first, cache_one, key = self._prefill_fn(
                     self.params, jnp.asarray(prompt),
                     jnp.asarray([n - 1], jnp.int32),
                     jnp.int32(seed), jnp.float32(temperature),
                 )
+                _m.sync(cache_one)
             first_tok = first[0]
         # host pull IS the export (the slab must cross a transport);
         # suffix-only when the decode side already holds the prefix
@@ -2236,12 +2259,17 @@ class ContinuousBatcher:
             )
         dt = jnp.dtype(getattr(self.model, "compute_dtype", "bfloat16"))
         if dt != jnp.float32:
-            params = jax.tree_util.tree_map(
-                lambda a: a.astype(dt)
-                if hasattr(a, "dtype") and a.dtype == jnp.float32
-                else a,
-                params,
-            )
+            with self._prof.measure(
+                "swap_cast", variant=str(dt),
+                bytes_read=self._param_bytes,
+            ) as _m:
+                params = jax.tree_util.tree_map(
+                    lambda a: a.astype(dt)
+                    if hasattr(a, "dtype") and a.dtype == jnp.float32
+                    else a,
+                    params,
+                )
+                _m.sync(params)
         from ..models.llm import DecoderLM
 
         check = getattr(self.model, "params_swappable", None)
@@ -3211,7 +3239,14 @@ class ContinuousBatcher:
             m, donor = hit
             start = (m // self.prefill_chunk) * self.prefill_chunk
             if start > 0:
-                slab = self._splice_fn(slab, donor)
+                with self._prof.measure(
+                    "splice", variant=f"b{bucket}",
+                    tenant=req.tenant or "",
+                    bytes_read=start * self._kv_key_bytes,
+                    tokens=start,
+                ) as _m:
+                    slab = self._splice_fn(slab, donor)
+                    _m.sync(slab)
             req.cache_hit_tokens = start
             self.stats["prefix_hits"] += 1
             self.stats["prefix_tokens_saved"] += start
@@ -3261,13 +3296,19 @@ class ContinuousBatcher:
             try:
                 from ..tracing import device_trace
 
-                with device_trace("gen.prefill_chunk"):
+                with self._prof.measure(
+                    "chunk_prefill", variant=f"b{job.bucket}",
+                    tenant=req.tenant or "",
+                    bytes_read=self._param_bytes + C * self._kv_key_bytes,
+                    tokens=C,
+                ) as _m, device_trace("gen.prefill_chunk"):
                     job.slab, first, lane_key = self._chunk_fn(
                         self.params, job.slab, jnp.asarray(buf),
                         jnp.int32(start), jnp.int32(n - 1 - start),
                         jnp.int32(req.seed), jnp.float32(req.temperature),
                         attn_len, is_last,
                     )
+                    _m.sync(job.slab)
                 if is_last:
                     if job.resume is not None:
                         # recompute-resume: the checkpointed continuation
@@ -3280,7 +3321,12 @@ class ContinuousBatcher:
                         insert_pos = n + len(emitted_r) - 1
                     else:
                         insert_pos = n
-                    with device_trace("gen.lane_insert"):
+                    with self._prof.measure(
+                        "insert", variant=f"b{job.bucket}",
+                        tenant=req.tenant or "",
+                        bytes_read=job.bucket * self._kv_key_bytes,
+                        tokens=insert_pos,
+                    ) as _m, device_trace("gen.lane_insert"):
                         self._cache, self._cur_tok, self._pos, self._keys = (
                             self._insert_fn(
                                 self._cache, job.slab, slot, first,
@@ -3288,6 +3334,7 @@ class ContinuousBatcher:
                                 self._cur_tok, self._pos, self._keys,
                             )
                         )
+                        _m.sync(self._cur_tok)
             except Exception as e:  # noqa: BLE001 - bad request/device state
                 logger.exception("chunked prefill failed")
                 del self._chunked[slot]
@@ -3385,7 +3432,14 @@ class ContinuousBatcher:
             return
         if idx.covered_len(toks) >= n:
             return
-        slab = self._extract_fn(self._cache, slot, self._bucket(n))
+        _b = self._bucket(n)
+        with self._prof.measure(
+            "extract", variant=f"b{_b}",
+            tenant=s.request.tenant or "",
+            bytes_read=_b * self._kv_key_bytes, tokens=_b,
+        ) as _m:
+            slab = self._extract_fn(self._cache, slot, _b)
+            _m.sync(slab)
         nbytes = int(slab["k"].nbytes) + int(slab["v"].nbytes)
         self.stats["prefix_evicted"] += idx.insert(toks, slab, nbytes)
         self.stats["prefix_cache_bytes"] = idx.total_bytes
@@ -3425,7 +3479,11 @@ class ContinuousBatcher:
                     f"assumes {covered} — donor evicted mid-handoff; "
                     "re-request with covered_len=0"
                 )
-            with device_trace("gen.lane_insert"):
+            with self._prof.measure(
+                "insert", variant=f"px{self._bucket(n)}",
+                tenant=req.tenant or "",
+                bytes_read=self._bucket(n) * self._kv_key_bytes, tokens=n,
+            ) as _m, device_trace("gen.lane_insert"):
                 self._cache, self._cur_tok, self._pos, self._keys = (
                     self._insert_prefix_fn(
                         self._cache, donor, r["slab"], slot,
@@ -3433,16 +3491,22 @@ class ContinuousBatcher:
                         r["key"], self._cur_tok, self._pos, self._keys,
                     )
                 )
+                _m.sync(self._cur_tok)
             self.stats["prefix_hits"] += 1
             self.stats["prefix_tokens_saved"] += covered
         else:
-            with device_trace("gen.lane_insert"):
+            with self._prof.measure(
+                "insert", variant=f"b{self._bucket(n)}",
+                tenant=req.tenant or "",
+                bytes_read=self._bucket(n) * self._kv_key_bytes, tokens=n,
+            ) as _m, device_trace("gen.lane_insert"):
                 self._cache, self._cur_tok, self._pos, self._keys = (
                     self._insert_fn(
                         self._cache, r["slab"], slot, jnp.int32(r["first"]),
                         n, r["key"], self._cur_tok, self._pos, self._keys,
                     )
                 )
+                _m.sync(self._cur_tok)
             if self._prefix_index is not None:
                 self.stats["prefix_misses"] += 1
         t_inserted = time.monotonic()
@@ -3693,7 +3757,12 @@ class ContinuousBatcher:
         n = len(req.tokens)
         pos = n + len(emitted) - 1
         width = self._attn_need(pos)
-        slab = self._extract_fn(self._cache, slot, width)
+        with self._prof.measure(
+            "extract", variant="preempt", tenant=req.tenant or "",
+            bytes_read=width * self._kv_key_bytes, tokens=width,
+        ) as _m:
+            slab = self._extract_fn(self._cache, slot, width)
+            _m.sync(slab)
         host = {
             "k": jax.device_get(slab["k"]),  # seldon-lint: disable=host-sync-hot-path (tier checkpoint: poll-boundary pull of a preempted lane's K/V — pipeline already drained; this copy replaces the resume's whole recompute+replay)
             "v": jax.device_get(slab["v"]),  # seldon-lint: disable=host-sync-hot-path (tier checkpoint: second half of the same poll-boundary lane pull)
@@ -3741,6 +3810,23 @@ class ContinuousBatcher:
         """Speculation is configured AND not cancelled by the pressure
         ladder's rung 2."""
         return self._spec_burst_fn is not None and not self._spec_suppressed
+
+    def _burst_tenant(self) -> str:
+        """Tenant label for a whole-batch dispatch: the single tenant
+        every active lane belongs to, or "" when mixed/untenanted (the
+        weight pager serves one resident tenant at a time, so decode
+        bursts are single-tenant in practice; attribution degrades to
+        unlabeled rather than lying when lanes ever mix)."""
+        tenant = ""
+        for s in self._active.values():
+            t = s.request.tenant
+            if t is None:
+                return ""
+            if not tenant:
+                tenant = t
+            elif t != tenant:
+                return ""
+        return tenant
 
     @scheduler_only
     def _ledger_components(self) -> Dict[str, int]:
@@ -4157,10 +4243,17 @@ class ContinuousBatcher:
             toks[: len(chunk)] = chunk
             act = np.zeros((k,), bool)
             act[: len(chunk)] = True
-            self._cache = self._replay_fn(
-                self.params, self._cache, lane_ix, jnp.asarray(toks),
-                jnp.asarray(act), jnp.int32(start_pos + off), attn_len,
-            )
+            with self._prof.measure(
+                "replay", variant=f"k{k}b{attn_len}", tenant="",
+                bytes_read=self._param_bytes
+                + len(chunk) * self._kv_key_bytes,
+                tokens=len(chunk),
+            ) as _m:
+                self._cache = self._replay_fn(
+                    self.params, self._cache, lane_ix, jnp.asarray(toks),
+                    jnp.asarray(act), jnp.int32(start_pos + off), attn_len,
+                )
+                _m.sync(self._cache["k"])
         self.stats["steps"] += -(-len(replay_toks) // k) * k
         self.stats["lane_steps"] += -(-len(replay_toks) // k) * k
 
@@ -4230,13 +4323,18 @@ class ContinuousBatcher:
             )
             return False
         slab_dev = self._upload_slab(host)
-        with device_trace("gen.lane_insert"):
+        with self._prof.measure(
+            "insert", variant="tier", tenant=req.tenant or "",
+            bytes_read=int(meta.get("width", 0)) * self._kv_key_bytes,
+            tokens=end_pos,
+        ) as _m, device_trace("gen.lane_insert"):
             self._cache, self._cur_tok, self._pos, self._keys = (
                 self._insert_fn(
                     self._cache, slab_dev, slot, first_tok, end_pos,
                     lane_key, self._cur_tok, self._pos, self._keys,
                 )
             )
+            _m.sync(self._cur_tok)
         self.stats["kv_tier_promotions"] += 1
         if self.flight is not None and self.flight.enabled:
             self.flight.record({
@@ -4327,13 +4425,21 @@ class ContinuousBatcher:
             wb = self._bucket(n - m)
             suffix = np.zeros((1, wb), np.int32)
             suffix[0, : n - m] = req.tokens[m:]
-            with device_trace("gen.prefill"):
+            with self._prof.measure(
+                "prefill", variant=f"px{wb}", tenant=req.tenant or "",
+                bytes_read=self._param_bytes + wb * self._kv_key_bytes,
+                tokens=wb,
+            ) as _m, device_trace("gen.prefill"):
                 _f, suffix_slab, _k = self._prefix_prefill_fn(
                     self.params, slab, jnp.asarray(suffix), jnp.int32(m),
                     jnp.asarray([n - 1 - m], jnp.int32),
                     jnp.int32(req.seed), jnp.float32(req.temperature),
                 )
-            with device_trace("gen.lane_insert"):
+                _m.sync(suffix_slab)
+            with self._prof.measure(
+                "insert", variant=f"px{wb}", tenant=req.tenant or "",
+                bytes_read=(m + wb) * self._kv_key_bytes, tokens=end_pos,
+            ) as _m, device_trace("gen.lane_insert"):
                 self._cache, self._cur_tok, self._pos, self._keys = (
                     self._insert_prefix_fn(
                         self._cache, slab, suffix_slab, slot, jnp.int32(m),
@@ -4341,6 +4447,7 @@ class ContinuousBatcher:
                         self._cur_tok, self._pos, self._keys,
                     )
                 )
+                _m.sync(self._cur_tok)
             req.cache_hit_tokens = m
             self.stats["prefix_hits"] += 1
             self.stats["prefix_tokens_saved"] += m
@@ -4350,19 +4457,28 @@ class ContinuousBatcher:
             bucket = self._bucket(n)
             prompt = np.zeros((1, bucket), np.int32)
             prompt[0, :n] = req.tokens
-            with device_trace("gen.prefill"):
+            with self._prof.measure(
+                "prefill", variant=f"p{bucket}", tenant=req.tenant or "",
+                bytes_read=self._param_bytes + bucket * self._kv_key_bytes,
+                tokens=bucket,
+            ) as _m, device_trace("gen.prefill"):
                 _f, cache_one, _k = self._prefill_fn(
                     self.params, jnp.asarray(prompt),
                     jnp.asarray([n - 1], jnp.int32),
                     jnp.int32(req.seed), jnp.float32(req.temperature),
                 )
-            with device_trace("gen.lane_insert"):
+                _m.sync(cache_one)
+            with self._prof.measure(
+                "insert", variant=f"b{bucket}", tenant=req.tenant or "",
+                bytes_read=bucket * self._kv_key_bytes, tokens=end_pos,
+            ) as _m, device_trace("gen.lane_insert"):
                 self._cache, self._cur_tok, self._pos, self._keys = (
                     self._insert_fn(
                         self._cache, cache_one, slot, first_tok, end_pos,
                         lane_key, self._cur_tok, self._pos, self._keys,
                     )
                 )
+                _m.sync(self._cur_tok)
             if self._prefix_index is not None:
                 self.stats["prefix_misses"] += 1
             self.stats["prefill_steps"] += 1
@@ -4395,7 +4511,11 @@ class ContinuousBatcher:
             wb = self._bucket(n - m)
             suffix = np.zeros((1, wb), np.int32)
             suffix[0, : n - m] = req.tokens[m:]
-            with device_trace("gen.prefill"):
+            with self._prof.measure(
+                "prefill", variant=f"px{wb}", tenant=req.tenant or "",
+                bytes_read=self._param_bytes + wb * self._kv_key_bytes,
+                tokens=wb,
+            ) as _m, device_trace("gen.prefill"):
                 first, suffix_slab, lane_key = self._prefix_prefill_fn(
                     self.params,
                     slab,
@@ -4405,8 +4525,12 @@ class ContinuousBatcher:
                     jnp.int32(req.seed),
                     jnp.float32(req.temperature),
                 )
+                _m.sync(suffix_slab)
             t_insert = time.monotonic()
-            with device_trace("gen.lane_insert"):
+            with self._prof.measure(
+                "insert", variant=f"px{wb}", tenant=req.tenant or "",
+                bytes_read=(m + wb) * self._kv_key_bytes, tokens=n,
+            ) as _m, device_trace("gen.lane_insert"):
                 self._cache, self._cur_tok, self._pos, self._keys = (
                     self._insert_prefix_fn(
                         self._cache, slab, suffix_slab, slot, jnp.int32(m),
@@ -4414,6 +4538,7 @@ class ContinuousBatcher:
                         self._cur_tok, self._pos, self._keys,
                     )
                 )
+                _m.sync(self._cur_tok)
             req.cache_hit_tokens = m
             self.stats["prefix_hits"] += 1
             self.stats["prefix_tokens_saved"] += m
@@ -4428,7 +4553,11 @@ class ContinuousBatcher:
             bucket = self._bucket(n)
             prompt = np.zeros((1, bucket), np.int32)
             prompt[0, :n] = req.tokens
-            with device_trace("gen.prefill"):
+            with self._prof.measure(
+                "prefill", variant=f"p{bucket}", tenant=req.tenant or "",
+                bytes_read=self._param_bytes + bucket * self._kv_key_bytes,
+                tokens=bucket,
+            ) as _m, device_trace("gen.prefill"):
                 first, cache_one, lane_key = self._prefill_fn(
                     self.params,
                     jnp.asarray(prompt),
@@ -4436,12 +4565,17 @@ class ContinuousBatcher:
                     jnp.int32(req.seed),
                     jnp.float32(req.temperature),
                 )
+                _m.sync(cache_one)
             t_insert = time.monotonic()
-            with device_trace("gen.lane_insert"):
+            with self._prof.measure(
+                "insert", variant=f"b{bucket}", tenant=req.tenant or "",
+                bytes_read=bucket * self._kv_key_bytes, tokens=n,
+            ) as _m, device_trace("gen.lane_insert"):
                 self._cache, self._cur_tok, self._pos, self._keys = self._insert_fn(
                     self._cache, cache_one, slot, first[0], n, lane_key,
                     self._cur_tok, self._pos, self._keys,
                 )
+                _m.sync(self._cur_tok)
             if self._prefix_index is not None:
                 self.stats["prefix_misses"] += 1
             self.stats["prefill_steps"] += 1
@@ -4493,17 +4627,31 @@ class ContinuousBatcher:
             last[i] = n - 1
             seeds[i] = req.seed
             temps[i] = req.temperature
-        with device_trace("gen.prefill"):
+        _wave_tenant = ""
+        if self._prof.enabled:
+            _ts = {req.tenant for req in reqs}
+            if len(_ts) == 1 and None not in _ts:
+                _wave_tenant = _ts.pop() or ""
+        with self._prof.measure(
+            "prefill", variant=f"m{m}p{bucket}", tenant=_wave_tenant,
+            bytes_read=self._param_bytes + m * bucket * self._kv_key_bytes,
+            tokens=m * bucket,
+        ) as _pm, device_trace("gen.prefill"):
             firsts, slab, lane_keys = self._prefill_many_fn(
                 self.params, jnp.asarray(prompts), jnp.asarray(last),
                 jnp.asarray(seeds), jnp.asarray(temps),
             )
-        with device_trace("gen.lane_insert"):
+            _pm.sync(slab)
+        with self._prof.measure(
+            "insert", variant=f"m{m}b{bucket}", tenant=_wave_tenant,
+            bytes_read=m * bucket * self._kv_key_bytes, tokens=m * bucket,
+        ) as _im, device_trace("gen.lane_insert"):
             self._cache, self._cur_tok, self._pos, self._keys = self._insert_many_fn(
                 self._cache, slab, jnp.asarray(np.asarray(slots, np.int32)),
                 firsts, jnp.asarray(last + 1), lane_keys,
                 self._cur_tok, self._pos, self._keys,
             )
+            _im.sync(self._cur_tok)
         t_inserted = time.monotonic()
         for slot, req in zip(slots, reqs):
             req.admit_t = t_admit
@@ -5212,7 +5360,17 @@ class ContinuousBatcher:
                             "dk": self._draft_cache["k"],
                             "dv": self._draft_cache["v"],
                         }
-                        with device_trace("gen.decode_burst"):
+                        with self._prof.measure(
+                            "spec_burst",
+                            variant=f"g{self.speculate_tokens}b{attn_len}",
+                            tenant=self._burst_tenant()
+                            if self._prof.enabled else "",
+                            bytes_read=k * (
+                                self._param_bytes
+                                + self.slots * attn_len * self._kv_key_bytes
+                            ),
+                            tokens=k * self.slots,
+                        ) as _m, device_trace("gen.decode_burst"):
                             (
                                 start_tok, toks, counts, self._cur_tok,
                                 self._pos, self._keys, nc,
@@ -5221,6 +5379,7 @@ class ContinuousBatcher:
                                 self._cur_tok, self._pos, active_dev, temps_dev,
                                 self._keys, k, attn_len, self._any_stoch,
                             )
+                            _m.sync(toks)
                         if flight is not None:
                             poll_plan = {
                                 "mode": "spec", "k": k, "attn_len": attn_len,
@@ -5264,6 +5423,10 @@ class ContinuousBatcher:
                         # per-lane bookkeeping happens per SUB-burst: a
                         # lane's tokens are credited against the column it
                         # occupied in the burst that decoded it
+                        burst_tenant = (
+                            self._burst_tenant() if self._prof.enabled
+                            else ""
+                        )
                         for lanes, g_bucket in groups:
                             snapshot = {}
                             for col, slot in enumerate(lanes):
@@ -5285,7 +5448,19 @@ class ContinuousBatcher:
                                     )
                                 rows = self.slots
                                 if use_fused:
-                                    with device_trace("gen.decode_burst"):
+                                    with self._prof.measure(
+                                        "fused_burst",
+                                        variant=f"k{k}b{g_bucket}",
+                                        tenant=burst_tenant,
+                                        bytes_read=k * (
+                                            self._param_bytes
+                                            + rows * g_bucket
+                                            * self._kv_key_bytes
+                                        ),
+                                        tokens=k * rows,
+                                    ) as _m, device_trace(
+                                        "gen.decode_burst"
+                                    ):
                                         (
                                             toks, counts, done_bits,
                                             self._cur_tok, self._pos,
@@ -5298,8 +5473,21 @@ class ContinuousBatcher:
                                             self._keys, self._stops_dev,
                                             self._budget_dev, k, g_bucket,
                                         )
+                                        _m.sync(toks)
                                 else:
-                                    with device_trace("gen.decode_burst"):
+                                    with self._prof.measure(
+                                        "decode_burst",
+                                        variant=f"b{g_bucket}",
+                                        tenant=burst_tenant,
+                                        bytes_read=k * (
+                                            self._param_bytes
+                                            + rows * g_bucket
+                                            * self._kv_key_bytes
+                                        ),
+                                        tokens=k * rows,
+                                    ) as _m, device_trace(
+                                        "gen.decode_burst"
+                                    ):
                                         toks, self._cur_tok, self._pos, self._cache, self._keys = (
                                             self._burst_fn(
                                                 self.params, self._cache,
@@ -5308,6 +5496,7 @@ class ContinuousBatcher:
                                                 k, g_bucket,
                                             )
                                         )
+                                        _m.sync(toks)
                             else:
                                 gb = self._group_size_bucket(len(lanes))
                                 pads = [
@@ -5319,7 +5508,19 @@ class ContinuousBatcher:
                                 )
                                 rows = gb
                                 if use_fused:
-                                    with device_trace("gen.decode_burst"):
+                                    with self._prof.measure(
+                                        "group_burst",
+                                        variant=f"k{k}r{gb}b{g_bucket}",
+                                        tenant=burst_tenant,
+                                        bytes_read=k * (
+                                            self._param_bytes
+                                            + rows * g_bucket
+                                            * self._kv_key_bytes
+                                        ),
+                                        tokens=k * len(lanes),
+                                    ) as _m, device_trace(
+                                        "gen.decode_burst"
+                                    ):
                                         (
                                             toks, counts, done_bits,
                                             self._cur_tok, self._pos,
@@ -5333,8 +5534,21 @@ class ContinuousBatcher:
                                             self._budget_dev, lane_ix,
                                             len(lanes), k, g_bucket,
                                         )
+                                        _m.sync(toks)
                                 else:
-                                    with device_trace("gen.decode_burst"):
+                                    with self._prof.measure(
+                                        "group_burst",
+                                        variant=f"r{gb}b{g_bucket}",
+                                        tenant=burst_tenant,
+                                        bytes_read=k * (
+                                            self._param_bytes
+                                            + rows * g_bucket
+                                            * self._kv_key_bytes
+                                        ),
+                                        tokens=k * len(lanes),
+                                    ) as _m, device_trace(
+                                        "gen.decode_burst"
+                                    ):
                                         toks, self._cur_tok, self._pos, self._cache, self._keys = (
                                             self._group_burst_fn(
                                                 self.params, self._cache,
@@ -5343,6 +5557,7 @@ class ContinuousBatcher:
                                                 len(lanes), k, g_bucket,
                                             )
                                         )
+                                        _m.sync(toks)
                                 self.stats["group_bursts"] += 1
                                 self.stats["group_lanes"] += len(lanes)
                                 self.stats["group_pad_lanes"] += gb - len(lanes)
@@ -5430,6 +5645,13 @@ class ContinuousBatcher:
                             entry["prefix_evicted"] = evicted
                         if poll_plan is not None:
                             entry["plan"] = poll_plan
+                        if self._prof.enabled:
+                            # per-poll device-time ledger deltas ride the
+                            # poll record; quiet-poll leftovers roll into
+                            # the next recorded poll (flush clears)
+                            dt_rows = self._prof.poll_flush()
+                            if dt_rows:
+                                entry["device_time"] = dt_rows
                         flight.record(entry)
                 # read bursts oldest-first: always when the pipeline is full
                 # (or nothing is left to dispatch) — and OPPORTUNISTICALLY

@@ -686,6 +686,25 @@ def test_wall_clock_allows_anchors_and_monotonic(tmp_path):
     assert res.findings == []
 
 
+def test_wall_clock_flags_event_trail_dict_stamps(tmp_path):
+    """The two rollout event-trail shapes that used to ship under
+    suppressions: a ``time.time()`` stamp inside a dict literal is NOT a
+    named wall anchor (the assignment target carries no ``wall``), so
+    both must flag — event stamps go through tracing.wall_us()."""
+    res = lint(tmp_path, """
+    import time
+
+    class Trail:
+        def event(self, kind):
+            entry = {"t": time.time(), "event": kind}
+            self.events.append(entry)
+
+        def diverge(self, name, verdict):
+            self.recent.append({"t": time.time(), "predictor": name})
+    """, rules=["wall-clock"])
+    assert rules_of(res) == ["wall-clock"] * 2
+
+
 # -- suppression + baseline semantics ---------------------------------------
 
 

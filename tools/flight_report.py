@@ -13,6 +13,8 @@ Usage::
 
     curl -s localhost:8000/flightrecorder | python tools/flight_report.py -
     python tools/flight_report.py dump.json
+    python tools/flight_report.py --json dump.json   # machine-readable:
+    # {unit: {"lines": [...], "diagnosis": [DIAGNOSIS subset]}}
 """
 
 from __future__ import annotations
@@ -476,6 +478,103 @@ def _fusion_lines(
     return lines
 
 
+def _device_time_lines(
+    polls: List[Dict[str, Any]],
+    profiler: Dict[str, Any],
+    slo_burn: Dict[str, Any],
+) -> List[str]:
+    """Device-time ledger + SLO burn records (operate.md §4): per-poll
+    ``device_time`` rows aggregated by executable kind over the recorded
+    window, the cumulative ledger summary with its live gauges, and the
+    burn-rate verdicts — with a DIAGNOSIS when one executable kind
+    dominates >80% of the window's attributed device time."""
+    lines: List[str] = []
+    # window view: the per-poll deltas that rode the ring
+    by_kind: Dict[str, List[float]] = {}
+    for p in polls:
+        for row in p.get("device_time") or []:
+            agg = by_kind.setdefault(row.get("kind", "?"), [0.0, 0.0, 0.0])
+            agg[0] += row.get("s", 0.0)
+            agg[1] += row.get("n", 0)
+            agg[2] += row.get("bytes", 0)
+    total_s = sum(v[0] for v in by_kind.values())
+    if by_kind:
+        parts = ", ".join(
+            f"{k} {_pct(v[0], total_s):.0f}% ({int(v[1])} disp)"
+            for k, v in sorted(
+                by_kind.items(), key=lambda kv: -kv[1][0]
+            )
+        )
+        lines.append(
+            f"device-time window: {total_s * 1e3:.1f} ms attributed "
+            f"across {len(by_kind)} kind(s) — {parts}"
+        )
+        dominant, agg = max(by_kind.items(), key=lambda kv: kv[1][0])
+        share = _pct(agg[0], total_s)
+        if share > 80.0 and len(by_kind) > 1:
+            hint = {
+                "prefill": "admissions dominate — look at chunked "
+                "prefill / prefix caching to take prompt work off the "
+                "serving path",
+                "decode_burst": "plain decode bursts dominate — fused "
+                "decode (decode_fuse_steps) cuts their dispatch floor",
+                "fused_burst": "expected shape for a healthy fused "
+                "decode workload",
+                "swap_cast": "weight swaps dominate — space rollouts "
+                "out; each cast walks every parameter",
+                "splice": "KV splices dominate — prefix-cache hit "
+                "tokens are being re-spliced every admit; check hit "
+                "lengths vs prompt lengths",
+            }.get(dominant, "see the kind's dispatch sites in "
+                  "serving/continuous.py")
+        elif share > 80.0:
+            hint = "single-kind window (one-shape workload)"
+        if share > 80.0:
+            lines.append(
+                f"DIAGNOSIS: executable kind '{dominant}' consumed "
+                f"{share:.0f}% of attributed device time this window — "
+                f"{hint}"
+            )
+    if profiler:
+        gauges = []
+        if "device_busy_frac" in profiler:
+            gauges.append(f"busy {profiler['device_busy_frac'] * 100:.1f}%")
+        if "mbu_pct" in profiler:
+            gauges.append(f"MBU {profiler['mbu_pct']:.1f}%")
+        if "dispatch_floor_pct" in profiler:
+            gauges.append(
+                f"dispatch floor {profiler['dispatch_floor_pct']:.1f}%"
+            )
+        lines.append(
+            f"device-time ledger (cumulative): "
+            f"{profiler.get('device_time_s', 0.0) * 1e3:.1f} ms over "
+            f"{len(profiler.get('buckets') or {})} (kind,variant,tenant) "
+            f"bucket(s), {profiler.get('deep_samples', 0)} deep sample(s)"
+            + ("; " + ", ".join(gauges) if gauges else "")
+        )
+    if slo_burn:
+        for v in slo_burn.get("verdicts") or []:
+            if v.get("severity") in ("warn", "page"):
+                who = f" tenant {v['tenant']!r}" if v.get("tenant") else ""
+                lines.append(
+                    f"SLO burn {v['severity'].upper()}:{who} "
+                    f"{v.get('slo')} burning "
+                    f"{v.get('fast_burn', 0):.1f}x budget (fast) / "
+                    f"{v.get('slow_burn', 0):.1f}x (slow), "
+                    f"{v.get('budget_remaining', 0) * 100:.0f}% of the "
+                    "error budget left"
+                )
+                if v["severity"] == "page":
+                    lines.append(
+                        "DIAGNOSIS: both burn windows exceed the page "
+                        "rate — the error budget will exhaust within "
+                        "hours at this rate; the deployment controller "
+                        "is already vetoing scale-down and applying "
+                        "scale-up pressure"
+                    )
+    return lines
+
+
 def diagnose(dump: Dict[str, Any]) -> List[str]:
     """Report lines for one unit's flight-recorder dump."""
     lines: List[str] = []
@@ -583,6 +682,9 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
         lines.extend(_fault_lines(restarts, ejects, readmits, degraded))
         lines.extend(_pressure_lines(
             preempts, resumes, reclaims, budgets, dump.get("pressure") or {}
+        ))
+        lines.extend(_device_time_lines(
+            polls, dump.get("profiler") or {}, dump.get("slo_burn") or {}
         ))
         return lines
 
@@ -702,6 +804,11 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
         preempts, resumes, reclaims, budgets, dump.get("pressure") or {}
     ))
 
+    # -- device-time ledger + SLO burn ----------------------------------------
+    lines.extend(_device_time_lines(
+        polls, dump.get("profiler") or {}, dump.get("slo_burn") or {}
+    ))
+
     # -- prefix cache ---------------------------------------------------------
     hits = sum(p.get("prefix_hits", 0) for p in polls)
     evicted = sum(p.get("prefix_evicted", 0) for p in polls)
@@ -728,23 +835,42 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
     return lines
 
 
-def render(payload: Dict[str, Any]) -> str:
+def report(payload: Dict[str, Any]) -> Dict[str, Dict[str, List[str]]]:
+    """Per-unit structured report: every narrative line plus the
+    DIAGNOSIS subset broken out (dashboards key alerts off it)."""
     units = payload.get("units")
     if units is None:
         units = {"(batcher)": payload}
-    out: List[str] = []
+    out: Dict[str, Dict[str, List[str]]] = {}
     for name, dump in units.items():
+        lines = diagnose(dump)
+        out[name] = {
+            "lines": lines,
+            "diagnosis": [l for l in lines if l.startswith("DIAGNOSIS")],
+        }
+    return out
+
+
+def render(payload: Dict[str, Any]) -> str:
+    out: List[str] = []
+    for name, unit in report(payload).items():
         out.append(f"=== flight report: {name} ===")
-        out.extend("  " + line for line in diagnose(dump))
+        out.extend("  " + line for line in unit["lines"])
     return "\n".join(out)
 
 
 def main(argv: List[str]) -> int:
-    if len(argv) != 2 or argv[1] in ("-h", "--help"):
+    args = [a for a in argv[1:] if a != "--json"]
+    as_json = "--json" in argv[1:]
+    if len(args) != 1 or args[0] in ("-h", "--help"):
         print(__doc__, file=sys.stderr)
         return 2
-    raw = sys.stdin.read() if argv[1] == "-" else open(argv[1]).read()
-    print(render(json.loads(raw)))
+    raw = sys.stdin.read() if args[0] == "-" else open(args[0]).read()
+    payload = json.loads(raw)
+    if as_json:
+        print(json.dumps(report(payload), indent=2, sort_keys=True))
+    else:
+        print(render(payload))
     return 0
 
 
