@@ -17,7 +17,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Dict, List, Optional, Tuple
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..graph.spec import (
     ANNOTATION_KV_TIER_BYTES,
@@ -104,6 +105,25 @@ class DeploymentController:
         self._fleet_prev: Dict[str, Dict] = {}
         self._fleet_units: Dict[str, Dict] = {}
         self._burn_verdicts: Dict[Tuple[str, str], List[Dict]] = {}
+        # autonomic planning (planning/planner.py): one decision table
+        # per (dep.key, predictor) that opted in via seldon.io/planner,
+        # ticked next to the fleet scrape. Decisions actuate ONLY
+        # through safe paths — handle.retune() lands at a poll
+        # boundary, scale decisions rewrite replicas through the same
+        # clamped spec path the HPA uses — and precedence with the
+        # autoscaler is deterministic: a burn-verdict page VETOES any
+        # same-tick scale-down (counted below), and any planner scale
+        # change resets the autoscaler's stabilization streak so the
+        # two controllers share ONE hysteresis window (docs/operate.md
+        # §"Autonomic planning").
+        self.planner_period_s = 5.0
+        self._planners: Dict[Tuple[str, str], Any] = {}
+        self._planner_profiles: Dict[str, Any] = {}  # path -> CostModel|None
+        self._planner_events: deque = deque(maxlen=256)
+        self.planner_stats = {
+            "ticks": 0, "retunes": 0, "retunes_refused": 0,
+            "scale_ups": 0, "scale_downs": 0, "vetoes": 0, "holds": 0,
+        }
 
     # -- desired state ------------------------------------------------------
 
@@ -1012,6 +1032,10 @@ class DeploymentController:
                 f"{dep}/{pred}": v
                 for (dep, pred), v in self._burn_verdicts.items()
             },
+            "planner": {
+                "stats": dict(self.planner_stats),
+                "recent": list(self._planner_events)[-20:],
+            },
         }
 
     def _worst_burn(self, dep_key: str, predictor: str) -> str:
@@ -1027,6 +1051,234 @@ class DeploymentController:
                 continue
         return SEVERITIES[worst]
 
+    # -- autonomic planner tick ---------------------------------------------
+
+    def _planner_for(self, key: Tuple[str, str], cfg: Dict[str, Any]):
+        """The (dep, predictor)'s decision table, created on first tick.
+        The planner shares the HPA's ``scale_down_ticks`` stabilization
+        window — ONE hysteresis constant for both controllers. A
+        profile artifact that fails to decode disables the cost model
+        (typed refusal logged once per path), never the planner: the
+        burn/pressure/idle rules need no profile."""
+        planner = self._planners.get(key)
+        if planner is not None:
+            return planner
+        from ..planning import CostModel, ServingPlanner, read_profile
+
+        cost_model = None
+        path = cfg.get("profile")
+        if path:
+            if path not in self._planner_profiles:
+                try:
+                    self._planner_profiles[path] = CostModel(
+                        read_profile(path)
+                    )
+                except Exception:  # noqa: BLE001 - corrupt/missing SPF1
+                    # refuses typed; the planner runs model-less
+                    logger.exception(
+                        "planner: profile %s unusable, running without "
+                        "a cost model", path,
+                    )
+                    self._planner_profiles[path] = None
+            cost_model = self._planner_profiles[path]
+        planner = ServingPlanner(
+            cost_model=cost_model,
+            scale_down_ticks=self.scale_down_ticks,
+        )
+        self._planners[key] = planner
+        return planner
+
+    def _planner_telemetry(self, dep, pspec):
+        """(gauges, counter_totals, current_config, census) for one
+        predictor, all harvested from the LAST fleet scrape — the
+        planner consumes the same telemetry plane operators see, never
+        a private side channel."""
+        busy: List[float] = []
+        config = census = None
+        for name, (handle, _) in self.components.items():
+            if (
+                handle.spec.deployment != dep.key
+                or handle.spec.predictor != pspec.name
+            ):
+                continue
+            for unit in (self._fleet_units.get(name) or {}).values():
+                prof = unit.get("profiler") or {}
+                if "device_busy_frac" in prof:
+                    busy.append(float(prof["device_busy_frac"]))
+                plan = unit.get("planning") or {}
+                if config is None and plan.get("config"):
+                    config = plan["config"]
+                    census = plan.get("census")
+        gauges: Dict[str, float] = {}
+        if busy:
+            gauges["device_busy_frac"] = sum(busy) / len(busy)
+        want = {"deployment": dep.key, "predictor": pspec.name}
+        totals = {
+            "sheds": self.fleet_metrics.counter_total(
+                "seldon_engine_pressure_sheds", want
+            ),
+            "preemptions": self.fleet_metrics.counter_total(
+                "seldon_engine_preemptions", want
+            ),
+        }
+        return gauges, totals, config, census
+
+    async def planner_tick_once(self) -> Dict[str, Dict[str, Any]]:
+        """One planner pass over every predictor that opted in via
+        ``seldon.io/planner``. Returns the decision event per
+        ``<dep.key>/<predictor>`` (tools/planner_smoke asserts on
+        them)."""
+        from ..graph.spec import parse_planner_annotations
+
+        results: Dict[str, Dict[str, Any]] = {}
+        live = set()
+        for dep in self.store.list():
+            for pspec in dep.predictors:
+                try:
+                    cfg = parse_planner_annotations(pspec)
+                except GraphSpecError as e:
+                    logger.warning(
+                        "planner: %s/%s annotations unusable: %s",
+                        dep.key, pspec.name, e,
+                    )
+                    continue
+                if not cfg or not cfg["enabled"]:
+                    continue
+                key = (dep.key, pspec.name)
+                live.add(key)
+                try:
+                    results[f"{dep.key}/{pspec.name}"] = (
+                        await self._planner_tick_one(
+                            dep, pspec, self._planner_for(key, cfg)
+                        )
+                    )
+                except Exception:  # noqa: BLE001 - one predictor's tick
+                    # must not stop planning the others
+                    logger.exception("planner tick %s failed", key)
+        # predictors that dropped the annotation (or the deployment)
+        # must not keep stale streak/cooldown state around
+        for key in [k for k in self._planners if k not in live]:
+            del self._planners[key]
+        return results
+
+    async def _planner_tick_one(self, dep, pspec, planner) -> Dict[str, Any]:
+        self.planner_stats["ticks"] += 1
+        gauges, totals, config, census = self._planner_telemetry(dep, pspec)
+        verdicts = self._burn_verdicts.get((dep.key, pspec.name), [])
+        decision = planner.tick(
+            verdicts=verdicts, gauges=gauges, counter_totals=totals,
+            current_config=config, census=census,
+        )
+        outcome = await self._planner_actuate(dep, pspec, decision)
+        event = {
+            "deployment": dep.key, "predictor": pspec.name,
+            "action": decision.action, "rank": decision.rank,
+            "reason": decision.reason, "knobs": dict(decision.knobs),
+            **outcome,
+        }
+        self._planner_events.append(event)
+        if decision.action != "hold" or outcome.get("vetoed"):
+            logger.info(
+                "planner %s/%s: %s (%s)%s", dep.key, pspec.name,
+                decision.action, decision.reason,
+                " [VETOED by burn page]" if outcome.get("vetoed") else "",
+            )
+        return event
+
+    async def _planner_actuate(self, dep, pspec, decision) -> Dict[str, Any]:
+        """Actuate one decision through the safe paths ONLY. The
+        planner/autoscaler precedence rule lives here, at the last
+        writer, so it holds even when verdicts changed between the
+        planner's tick and this actuation: a page-severity burn verdict
+        VETOES any scale-down in the same tick (counted, logged) —
+        exactly the autoscaler's own page veto, so the two controllers
+        resolve every same-tick conflict the same way (the table in
+        docs/operate.md §"Autonomic planning")."""
+        streak_key = (dep.key, pspec.name)
+        if decision.action == "hold":
+            self.planner_stats["holds"] += 1
+            return {}
+        if decision.action == "retune":
+            from ..serving.continuous import RetuneError
+
+            handles = [
+                handle for handle, _ in self.components.values()
+                if handle.spec.deployment == dep.key
+                and handle.spec.predictor == pspec.name
+            ]
+            applied = refused = 0
+            for handle in handles:
+                try:
+                    out = await handle.retune(
+                        dict(decision.knobs), origin="planner"
+                    )
+                except RetuneError as e:
+                    # out-of-census knobs refuse typed and change
+                    # NOTHING — never half-applied across members
+                    refused += 1
+                    logger.warning(
+                        "planner: retune refused by %s: %s",
+                        handle.spec.name, e,
+                    )
+                    continue
+                except Exception:  # noqa: BLE001 - a dead member must
+                    # not stop the rest of the pool retuning
+                    refused += 1
+                    logger.exception(
+                        "planner: retune failed on %s", handle.spec.name
+                    )
+                    continue
+                if out is not None:
+                    applied += 1
+            self.planner_stats["retunes"] += applied
+            self.planner_stats["retunes_refused"] += refused
+            return {"retuned": applied, "refused": refused}
+        # scale decisions rewrite replicas through the same clamped
+        # spec path the HPA uses (store.apply -> reconcile)
+        current = max(1, pspec.replicas)
+        lo, hi = 1, current + 1
+        if pspec.hpa_spec:
+            from ..graph.spec import parse_hpa_spec
+
+            lo, hi, _target = parse_hpa_spec(
+                pspec.hpa_spec, who=f"{dep.key}/{pspec.name}"
+            )
+        if decision.action == "scale_down":
+            if self._worst_burn(dep.key, pspec.name) == "page":
+                self.planner_stats["vetoes"] += 1
+                self._scale_down_streak.pop(streak_key, None)
+                return {"vetoed": True}
+            desired = max(lo, current - 1)
+        else:
+            desired = min(hi, current + 1)
+            if self.placement is not None and pspec.tpu_mesh:
+                # same never-past-the-chips clamp as the autoscaler
+                per_replica = 1
+                for v in pspec.tpu_mesh.values():
+                    per_replica *= int(v)
+                desired = min(
+                    desired,
+                    current + self.placement.capacity()["free"] // per_replica,
+                )
+        if desired == current:
+            return {"replicas": current, "clamped": True}
+        updated = dep.clone()
+        for p in updated.predictors:
+            if p.name == pspec.name:
+                p.replicas = desired
+        self.store.apply(updated)  # generation bump -> reconcile
+        # shared hysteresis: a planner scale event restarts the HPA's
+        # stabilization window (the autoscaler pops the same streak on
+        # its own scale events) — neither controller can saw against
+        # the other's fresh decision
+        self._scale_down_streak.pop(streak_key, None)
+        key = "scale_ups" if decision.action == "scale_up" else "scale_downs"
+        self.planner_stats[key] += 1
+        logger.info(
+            "planner %s/%s -> %d replicas", dep.key, pspec.name, desired
+        )
+        return {"replicas": desired}
+
     async def run(self, stop_event: Optional[asyncio.Event] = None) -> None:
         """Consume store events forever (controller-runtime manager parity,
         reference: operator/main.go:49-93). The autoscaler evaluates every
@@ -1039,6 +1291,7 @@ class DeploymentController:
         next_autoscale = loop.time() + self.autoscale_period_s
         next_rollout = loop.time() + self.rollout_period_s
         next_fleet = loop.time() + self.fleet_period_s
+        next_planner = loop.time() + self.planner_period_s
         try:
             while stop_event is None or not stop_event.is_set():
                 if loop.time() >= next_autoscale:
@@ -1055,6 +1308,13 @@ class DeploymentController:
                     except Exception:  # noqa: BLE001 - a slow/dead member's
                         # scrape must not kill the manager loop
                         logger.exception("fleet scrape failed")
+                if loop.time() >= next_planner:
+                    next_planner = loop.time() + self.planner_period_s
+                    try:
+                        await self.planner_tick_once()
+                    except Exception:  # noqa: BLE001 - a bad profile or
+                        # dead member must not kill the manager loop
+                        logger.exception("planner pass failed")
                 if loop.time() >= next_rollout:
                     next_rollout = loop.time() + self.rollout_period_s
                     try:

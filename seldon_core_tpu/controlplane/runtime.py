@@ -112,6 +112,18 @@ class ComponentHandle:
         fleet scrape."""
         return None
 
+    async def retune(
+        self, knobs: dict, origin: str = "planner"
+    ) -> Optional[dict]:
+        """Actuate a live scheduler retune on this member through the
+        safe path (the engine's POST /retune — staged, validated against
+        the boot compile census, applied at a poll boundary). Returns
+        the per-unit ``{"changed": ...}`` payload, or None when the
+        component kind has no retune surface. Out-of-census refusals
+        raise (typed RetuneError in-process, HTTP 409 over the wire) —
+        the planner prunes those configs instead of retrying."""
+        return None
+
 
 class _InProcessHandle(ComponentHandle):
     def __init__(
@@ -152,6 +164,27 @@ class _InProcessHandle(ComponentHandle):
             return fn()
         except Exception:  # noqa: BLE001 - telemetry must not fail ops
             return None
+
+    async def retune(
+        self, knobs: dict, origin: str = "planner"
+    ) -> Optional[dict]:
+        walk = getattr(self.app, "units_with", None)
+        if walk is None:
+            return None
+        targets = list(walk("retune"))
+        if not targets:
+            return None
+        loop = asyncio.get_running_loop()
+        units: dict = {}
+        for name, target in targets:
+            # blocking until the scheduler's poll boundary: off the
+            # event loop (same discipline as the /retune route).
+            # RetuneError propagates — an out-of-census config is the
+            # planner's signal to prune, not a fault to swallow.
+            units[name] = await loop.run_in_executor(
+                None, lambda f=target.retune: f(knobs, origin)
+            )
+        return {"units": units}
 
     async def stop(self) -> None:
         # graceful drain before teardown (reference preStop idiom:
@@ -332,6 +365,39 @@ class _SubprocessHandle(ComponentHandle):
                 return None
 
         return await asyncio.get_running_loop().run_in_executor(None, probe)
+
+    async def retune(
+        self, knobs: dict, origin: str = "planner"
+    ) -> Optional[dict]:
+        if self.proc.poll() is not None:
+            return None
+
+        def post() -> Optional[dict]:
+            body = json.dumps(
+                {"knobs": knobs, "origin": origin}
+            ).encode()
+            req = urllib.request.Request(
+                f"{self.url}/retune", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=15.0) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                if e.code == 409:
+                    # out-of-census refusal: surface it typed so the
+                    # planner prunes the config (parity with the
+                    # in-process RetuneError path)
+                    from ..serving.continuous import RetuneError
+
+                    raise RetuneError(e.read().decode()) from None
+                if e.code == 501:
+                    return None  # member has no retune surface
+                raise
+            except Exception:  # noqa: BLE001 - member mid-restart
+                return None
+
+        return await asyncio.get_running_loop().run_in_executor(None, post)
 
     async def stop(self) -> None:
         # graceful drain first (reference preStop: curl /pause; sleep —

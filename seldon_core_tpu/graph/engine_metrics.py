@@ -156,6 +156,12 @@ class MetricsRegistry:
         "gen_weight_pager_evictions":
             "seldon_engine_weight_pager_evictions",
         "gen_weight_pager_refused": "seldon_engine_weight_pager_refused",
+        # autonomic planning: retunes the scheduler APPLIED at a poll
+        # boundary (staged-but-refused proposals never reach the stats
+        # dict) — rate of this series is the planner's actuation
+        # cadence, the observable half of the closed loop in
+        # docs/operate.md "Autonomic planning"
+        "gen_planner_retunes": "seldon_engine_planner_retunes",
     }
 
     # first-class health gauge: 1 = the generate scheduler is serving,

@@ -61,11 +61,25 @@ served fused dispatches in ``seldon_engine_fused_segments{unit}``; each
 fused dispatch emits a ``gen.fused_segment`` trace span carrying the
 per-stage names and a ``fused_dispatch`` flight record (rendered by
 tools/flight_report.py with a fallback-rate DIAGNOSIS).
+
+Segment compilation is additionally COST-GATED when a gate is supplied
+(planning's SPF1 profile prices it via ``CostModel.fusion_gate()``, or
+the ``SELDON_FUSION_COST_GATE`` env JSON): a candidate only compiles
+when its dispatch savings — ``(stages - 1)`` eliminated dispatch
+floors amortized over the expected dispatch count — exceed the
+profile's per-executable compile cost. A gated-out segment serves
+hop-by-hop and counts ``seldon_engine_fusion_skipped{unit,
+reason="cost"}`` (plus a ``fusion_skipped`` flight record), so a graph
+that fuses nothing after a profile update is a diagnosis, not a
+mystery. No gate means everything eligible compiles, exactly as
+before.
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -84,6 +98,46 @@ logger = logging.getLogger(__name__)
 # acceptance contract); plain ineligibility (non-jittable component) is
 # logged at debug but not counted — it is structure, not semantics
 _SEMANTIC_PLAN_REASONS = ("remote", "faults", "microbatch", "hedge")
+
+
+def segment_worth_compiling(n_stages: int, gate: Dict[str, Any]) -> bool:
+    """The fusion cost gate: compile only when the dispatch savings a
+    segment buys — one eliminated per-dispatch floor per interior hop,
+    amortized over the expected dispatch count — exceed the compile
+    cost the profile measured per executable variant. An unpriced gate
+    (no dispatch floor / no expected volume) gates nothing: fusing is
+    the measured-good default, the gate only prunes provably-bad
+    compiles."""
+    try:
+        floor_us = float(gate.get("dispatch_floor_us", 0.0))
+        compile_s = float(gate.get("compile_cost_s", 0.0))
+        dispatches = float(gate.get("expected_dispatches", 0.0))
+    except (TypeError, ValueError, AttributeError):
+        return True
+    if floor_us <= 0 or dispatches <= 0:
+        return True
+    savings_s = max(0, int(n_stages) - 1) * floor_us * 1e-6 * dispatches
+    return savings_s >= compile_s
+
+
+def _gate_from_env() -> Optional[Dict[str, Any]]:
+    """``SELDON_FUSION_COST_GATE`` env JSON (same keys as
+    ``CostModel.fusion_gate()``) — the deploy-time escape hatch when no
+    reconciler is injecting a profile-priced gate."""
+    raw = os.environ.get("SELDON_FUSION_COST_GATE")
+    if not raw:
+        return None
+    try:
+        gate = json.loads(raw)
+        if not isinstance(gate, dict):
+            raise ValueError("must be a JSON object")
+        return gate
+    except ValueError as e:
+        logger.warning(
+            "fusion: SELDON_FUSION_COST_GATE unparseable (%s): %r — "
+            "gating nothing", e, raw,
+        )
+        return None
 
 
 class _Stage:
@@ -374,9 +428,18 @@ class FusionPlan:
 
     RING = 512
 
-    def __init__(self, executor, warm: bool = True):
+    def __init__(
+        self,
+        executor,
+        warm: bool = True,
+        cost_gate: Optional[Dict[str, Any]] = None,
+    ):
         self.executor = executor
         self.metrics = executor._metrics
+        # compile cost gate (module docstring): explicit gate wins
+        # (the planner prices one off the SPF1 profile), else the env
+        # escape hatch, else gate nothing — today's behavior
+        self.cost_gate = cost_gate if cost_gate is not None else _gate_from_env()
         self.segments: Dict[str, FusedSegment] = {}  # head unit name -> segment
         self._records: deque = deque(maxlen=self.RING)
         self._recorded_total = 0
@@ -591,23 +654,54 @@ class FusionPlan:
     def _plan(self, rt) -> None:
         """Pre-order sweep: at each uncovered node try a subtree
         segment, then a linear-prefix segment; recurse past whatever
-        was (or wasn't) fused."""
+        was (or wasn't) fused. Candidates that fail the compile cost
+        gate are counted and served hop-by-hop — never compiled."""
         if self._subtree_fusable(rt):
             n_units = sum(1 for _ in self._walk(rt))
             if n_units >= 2:
-                self._compile_subtree(rt)
+                if self._gate_allows(rt.name, n_units):
+                    self._compile_subtree(rt)
                 return
             # a single-unit "segment" has no fusion win; leave it alone
             return
         chain = self._linear_prefix(rt)
         if len(chain) >= 2:
-            self._compile_prefix(chain)
+            if self._gate_allows(chain[0].name, len(chain)):
+                self._compile_prefix(chain)
             tail = chain[-1]
             if tail.children:
                 self._plan(tail.children[0])
             return
         for c in rt.children:
             self._plan(c)
+
+    def _gate_allows(self, unit: str, n_stages: int) -> bool:
+        if not self.cost_gate or segment_worth_compiling(
+            n_stages, self.cost_gate
+        ):
+            return True
+        self.count_skip(unit, n_stages)
+        return False
+
+    def count_skip(self, unit: str, n_stages: int) -> None:
+        """A segment the cost gate pruned: compile cost exceeds its
+        dispatch savings. Counted (``seldon_engine_fusion_skipped``,
+        reason="cost") + one flight record, so the absent executable is
+        a diagnosis instead of a silent fusion no-op."""
+        if self.metrics is not None:
+            self.metrics.counter_inc(
+                "seldon_engine_fusion_skipped",
+                self._labels({"unit": unit, "reason": "cost"}),
+            )
+        logger.info(
+            "fusion: segment at %s (%d stages) not compiled "
+            "(reason=cost: gate %s prices compile above dispatch "
+            "savings)", unit, n_stages, self.cost_gate,
+        )
+        self._record({
+            "type": "fusion_skipped", "segment": unit,
+            "stages": n_stages, "reason": "cost",
+        })
 
     def _walk(self, rt):
         yield rt

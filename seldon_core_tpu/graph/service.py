@@ -186,6 +186,16 @@ class EngineApp:
             burn = target.slo_burn
             if burn is not None:
                 units.setdefault(name, {})["slo_burn"] = burn.summary()
+        # planning block: the CURRENT knob values + boot compile census
+        # the reconciler's planner tick diffs the cost model against
+        # (docs/operate.md "Autonomic planning")
+        for name, target in self.units_with("serving_config"):
+            cfg = target.serving_config()
+            if cfg is not None:
+                units.setdefault(name, {})["planning"] = {
+                    "config": cfg,
+                    "census": target.retune_census(),
+                }
         return {
             "predictor": self.spec.name,
             "metrics": self.metrics.fleet_snapshot(),
@@ -833,10 +843,55 @@ class EngineApp:
                 )
             return Response({"units": units})
 
+        async def retune(req: Request) -> Response:
+            # autonomic-planner actuation (units exposing the generate
+            # retune surface): POST {"knobs": {...}, "origin": "..."}
+            # stages a validated live knob change the scheduler applies
+            # at a poll boundary. Out-of-census configs come back as a
+            # typed 409 (RetuneError) — the planner treats that as
+            # "prune this config", never as a retryable fault.
+            body = req.json() or {}
+            knobs = body.get("knobs")
+            if not isinstance(knobs, dict) or not knobs:
+                return Response(
+                    error_body(400, "need 'knobs' (non-empty object)"),
+                    400,
+                )
+            origin = str(body.get("origin", "planner"))
+            wait_s = float(body.get("wait_s", 10.0))
+            loop = asyncio.get_running_loop()
+            from ..serving.continuous import RetuneError
+
+            units: Dict[str, Any] = {}
+            for name, target in self.units_with("retune"):
+                fn = target.retune
+                try:
+                    # future.result() blocks until the poll boundary:
+                    # off the event loop so serving never stalls
+                    units[name] = await loop.run_in_executor(
+                        None, lambda f=fn: f(knobs, origin, wait_s)
+                    )
+                    self._flush_unit_metrics(target)
+                except RetuneError as e:
+                    return Response(
+                        error_body(409, f"{name}: {e}"), 409
+                    )
+                except Exception as e:  # noqa: BLE001 - apply failed
+                    status = getattr(e, "status", None) or 502
+                    return Response(
+                        error_body(status, f"{name}: {e}"), status
+                    )
+            if not units:
+                return Response(
+                    error_body(501, "no unit supports retune"), 501
+                )
+            return Response({"units": units})
+
         app.add_route("/pause", pause)
         app.add_route("/unpause", unpause)
         app.add_route("/weights/swap", weights_swap)
         app.add_route("/drain", drain)
+        app.add_route("/retune", retune)
         app.add_route("/inflight", inflight)
         app.add_route("/openapi.json", openapi)
         app.add_route("/api/v0.1/generate", generate_stream)

@@ -520,6 +520,63 @@ def parse_tenants_annotation(
     return roster
 
 
+# autonomic planning (docs/operate.md "Autonomic planning"): opt the
+# predictor into the reconciler's planner tick, optionally pointing it
+# at an SPF1 serving-profile artifact for the cost model
+ANNOTATION_PLANNER = "seldon.io/planner"
+ANNOTATION_PLANNER_PROFILE = "seldon.io/planner-profile"
+
+
+def parse_planner_annotations(
+    spec: PredictorSpec,
+) -> "Optional[Dict[str, Any]]":
+    """``{"enabled": bool, "profile": Optional[str]}`` when the
+    predictor carries planner annotations, None otherwise. The ONE
+    parser shared by admission validation and the reconciler's planner
+    tick, strict at apply time: ``seldon.io/planner`` takes only
+    "true"/"false" (a typo'd value means the operator believes the
+    loop is closed, so it fails the apply instead of silently serving
+    hand-tuned), ``seldon.io/planner-profile`` requires the planner to
+    be enabled (an orphan profile path is the same operator error),
+    and the graph must contain a GENERATE_SERVER unit (every knob the
+    planner actuates is a generate-scheduler knob)."""
+    ann = spec.annotations or {}
+    raw = ann.get(ANNOTATION_PLANNER)
+    profile = ann.get(ANNOTATION_PLANNER_PROFILE)
+    if raw is None:
+        if profile is not None:
+            raise GraphSpecError(
+                f"predictor {spec.name!r}: {ANNOTATION_PLANNER_PROFILE} "
+                f"without {ANNOTATION_PLANNER}: \"true\" — an orphan "
+                "profile closes no loop"
+            )
+        return None
+    val = str(raw).strip().lower()
+    if val not in ("true", "false"):
+        raise GraphSpecError(
+            f"predictor {spec.name!r}: {ANNOTATION_PLANNER} must be "
+            f'"true" or "false", got {raw!r}'
+        )
+    enabled = val == "true"
+    if profile is not None and not enabled:
+        raise GraphSpecError(
+            f"predictor {spec.name!r}: {ANNOTATION_PLANNER_PROFILE} "
+            f"set while {ANNOTATION_PLANNER} is \"false\""
+        )
+    if enabled and not any(
+        u.implementation == "GENERATE_SERVER" for u in spec.graph.walk()
+    ):
+        raise GraphSpecError(
+            f"predictor {spec.name!r}: {ANNOTATION_PLANNER} needs a "
+            "GENERATE_SERVER unit (the planner actuates "
+            "generate-scheduler knobs)"
+        )
+    return {
+        "enabled": enabled,
+        "profile": str(profile).strip() if profile is not None else None,
+    }
+
+
 def inject_tenants_param(spec_dict: Dict, tenants: str) -> Dict:
     """Append ``tenants`` to every GENERATE_SERVER node of a
     predictor-spec dict (the reconciler's injection half of the
@@ -577,6 +634,9 @@ def validate_predictor(spec: PredictorSpec) -> None:
     # tenants annotation: strict-at-apply (a typo'd SLO class must not
     # misroute a tenant's traffic at serve time)
     parse_tenants_annotation(spec)
+    # planner annotations: strict-at-apply (a typo'd flag must not
+    # leave the operator believing the serving loop is closed)
+    parse_planner_annotations(spec)
 
 
 def validate_deployment(predictors: List[PredictorSpec]) -> None:

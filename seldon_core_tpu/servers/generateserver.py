@@ -1615,6 +1615,49 @@ class GenerateServer(SeldonComponent):
                 self.batcher.weight_version if self.batcher else None,
         }
 
+    def retune(self, knobs: Dict[str, Any], origin: str = "planner",
+               wait_s: float = 10.0) -> Dict[str, Any]:
+        """Actuate a live scheduler retune through the safe path (the
+        engine's ``POST /retune`` route and the reconciler's planner
+        tick both land here): stage via ContinuousBatcher.retune() —
+        synchronous typed validation against the boot compile census —
+        then wait for the scheduler to apply it at a poll boundary.
+        Returns ``{"changed": {knob: [old, new]}, "census": {...}}``;
+        RetuneError propagates to the caller (the route maps it to a
+        409-class refusal, the same contract as out-of-census configs)."""
+        from ..serving.continuous import RetuneError
+
+        if self.batcher is None:
+            raise RuntimeError("retune before load(): no batcher")
+        if not isinstance(knobs, dict):
+            raise RetuneError(
+                f"knobs must be an object, got {type(knobs).__name__}"
+            )
+        fut = self.batcher.retune(origin=str(origin), **knobs)
+        changed = fut.result(timeout=wait_s)
+        return {
+            "changed": changed,
+            "census": self.batcher.retune_census(),
+            "origin": str(origin),
+        }
+
+    def retune_census(self) -> Optional[Dict[str, Any]]:
+        """The loaded batcher's boot compile census (None before load)
+        — the planner prunes its profile walk to in-census configs."""
+        return (
+            self.batcher.retune_census()
+            if self.batcher is not None else None
+        )
+
+    def serving_config(self) -> Optional[Dict[str, Any]]:
+        """The batcher's CURRENT profile-axis knob values (None before
+        load) — ships in the /fleet payload so the reconciler's planner
+        tick can diff the cost model's pick against what is serving."""
+        return (
+            self.batcher.serving_config()
+            if self.batcher is not None else None
+        )
+
     def tags(self) -> Dict:
         return {"server": "generateserver"}
 
@@ -1716,6 +1759,13 @@ class GenerateServer(SeldonComponent):
             out.append(delta("gen_shed_total", s["shed"]))
         if s.get("weight_swaps"):
             out.append(delta("gen_weight_swaps", s["weight_swaps"]))
+        if s.get("planner_retunes"):
+            # autonomic planner actuations that landed at a poll
+            # boundary — engine_metrics maps this to the first-class
+            # seldon_engine_planner_retunes series (rate > a few per
+            # minute = the planner is thrashing; flight_report renders
+            # the matching planner_retune records with a DIAGNOSIS)
+            out.append(delta("gen_planner_retunes", s["planner_retunes"]))
         # fault-tolerance counters + the first-class health gauge: the
         # engine sink maps the counters to seldon_engine_batcher_restarts
         # / _peer_ejections / _degraded_local_prefill (engine_metrics

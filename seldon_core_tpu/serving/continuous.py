@@ -103,6 +103,15 @@ class BudgetExceeded(PromptTooLong):
     lower ``max_new_tokens``."""
 
 
+class RetuneError(ValueError):
+    """A :meth:`ContinuousBatcher.retune` request named a knob value
+    outside the boot-time compile census (or an unknown/ill-typed knob).
+    Typed and raised synchronously on the caller thread BEFORE anything
+    is staged: a config the warm() pass did not precompile would stall
+    the scheduler tens of seconds mid-traffic, so the planner's
+    out-of-census proposals are refused here, never half-applied."""
+
+
 class BatcherDead(RuntimeError):
     """The continuous batcher's scheduler loop is not serving: it died
     (in-flight work at crash time), its crash-loop budget is exhausted
@@ -239,6 +248,25 @@ class _DrainJob:
     ``resume`` — for the caller to hand to a peer."""
 
     future: Future = dataclasses.field(default_factory=Future)
+
+
+@dataclasses.dataclass
+class _RetuneJob:
+    """A validated live knob retune (autonomic planner actuation): the
+    scheduler applies it at the next poll boundary — the same staging
+    discipline as :class:`_SwapJob`/:class:`_DrainJob`, so a knob flip
+    can never tear a live burst (the loop snapshots ``_fused_k`` once
+    per poll) or race a chunked prefill (a ``prefill_chunk`` change
+    waits until the in-flight chunk jobs drain). ``knobs`` holds the
+    canonicalized target values; validation already happened on the
+    caller thread (:class:`RetuneError` on refusal)."""
+
+    knobs: Dict[str, Any]
+    origin: str = "planner"
+    future: Future = dataclasses.field(default_factory=Future)
+    # polls spent deferring (chunked prefills in flight while the job
+    # changes prefill_chunk) — flight-recorder attribution
+    waited_polls: int = 0
 
 
 @dataclasses.dataclass
@@ -678,6 +706,32 @@ class ContinuousBatcher:
             "drains": 0, "checkpoint_exports": 0, "migrations": 0,
             "migrated_resumes": 0, "swap_preemptions": 0,
         })
+        # -- planner retune (autonomic serving planner) -------------------
+        # retune() stages a validated _RetuneJob; the scheduler applies
+        # it at a poll boundary. The census snapshot records which
+        # executables warm() will compile — derived from the SAME boot
+        # knobs warm() reads — so a later retune can be checked against
+        # what actually exists instead of stalling the loop on a compile.
+        self._retune_lock = threading.Lock()
+        self._pending_retune: Optional[_RetuneJob] = None
+        _census_fks: List[int] = []
+        if self._fused_k > 0:
+            _cfk = self._fused_k
+            _clo = min(self._k, self._fused_k)
+            while _cfk >= _clo:
+                _census_fks.append(_cfk)
+                _cfk //= 2
+        self._retune_census: Dict[str, Any] = {
+            # fused Ks warm() compiles: pow2s in [min(k, fused), fused]
+            "fused_ks": tuple(sorted(_census_fks)),
+            # group-burst variants exist only when boot depth_groups > 1
+            "depth_groups": self.depth_groups,
+            # chunk executables exist only for the boot chunk size
+            "prefill_chunk": self.prefill_chunk,
+            # warm()'s attention-bucket overhang covered this depth
+            "pipeline_depth": self.pipeline_depth,
+        }
+        self.stats["planner_retunes"] = 0
 
         # -- device state ----------------------------------------------------
         # The persistent KV cache lives UNSTACKED: per-layer [S, KV, T, Dh]
@@ -2337,6 +2391,281 @@ class ContinuousBatcher:
         if not swap.future.done():
             swap.future.set_exception(
                 RuntimeError("weight swap cancelled before the flip")
+            )
+        return True
+
+    # knobs retune() accepts; everything else (slots, steps_per_poll,
+    # speculate_tokens, cache geometry) would invalidate compiled
+    # executables or reallocate device state and is refused typed
+    RETUNABLE_KNOBS = (
+        "fused_steps_per_dispatch", "depth_groups",
+        "depth_group_split_bytes", "prefill_chunk", "pipeline_depth",
+        "admit_queue_limit", "pressure_high", "pressure_low",
+    )
+
+    def retune_census(self) -> Dict[str, Any]:
+        """The boot-time compile census a retune is validated against:
+        which fused Ks warm() compiled, whether group-burst variants
+        exist, the one chunk size with precompiled executables, and the
+        warmed pipeline depth. The planner reads this to prune its
+        search space to configs this member can actually flip to."""
+        return dict(self._retune_census)
+
+    def serving_config(self) -> Dict[str, Any]:
+        """The CURRENT values of the profile-grid config axes
+        (planning/artifact.py CONFIG_KEYS) — unlike the boot census
+        these move with every applied retune. The planner diffs the
+        cost model's pick against this to decide whether a retune is
+        even needed."""
+        return {
+            "slots": int(self.slots),
+            "prefill_chunk": int(self.prefill_chunk or 0),
+            "fused_steps_per_dispatch": int(
+                self.fused_steps_per_dispatch or 0
+            ),
+            "depth_groups": int(self.depth_groups or 0),
+            "depth_group_split_bytes": int(self._group_split_bytes or 0),
+            "kv_tier_bytes": int(
+                getattr(self._kv_tier, "budget_bytes", 0) or 0
+            ),
+        }
+
+    @caller_thread
+    def retune(self, origin: str = "planner", **knobs) -> Future:
+        """Stage a live retune of scheduler knobs; returns a Future
+        resolving to ``{knob: [old, new]}`` for the knobs that actually
+        changed once the scheduler applies the job at a poll boundary.
+
+        Thread-safe, callable under traffic — the autonomic planner's
+        ONE actuation path into the hot loop. Same staging discipline as
+        swap/drain: nothing changes on the caller thread; the scheduler
+        applies every knob together at the top of a poll, where no burst
+        is mid-dispatch (the loop snapshots ``_fused_k`` once per poll)
+        and — for a ``prefill_chunk`` change — only once in-flight
+        chunked prefills have drained. Byte identity is preserved by
+        construction: every retunable knob already carries an
+        on-vs-off/byte-identity contract (fused decode, depth grouping,
+        chunked prefill, pressure, admission caps), so a mid-run retune
+        produces the same tokens as booting with the new values.
+
+        Validation is synchronous and typed (:class:`RetuneError`):
+        a value outside the boot compile census — a fused K warm() never
+        compiled, depth grouping on a member booted without group
+        variants, a chunk size with no precompiled chunk executables, a
+        pipeline deepening past the warmed attention overhang — is
+        refused HERE, before staging, so the scheduler can never be
+        asked to compile mid-traffic.
+        """
+        self._check_alive()
+        if not knobs:
+            raise RetuneError("retune called with no knobs")
+        unknown = set(knobs) - set(self.RETUNABLE_KNOBS)
+        if unknown:
+            raise RetuneError(
+                f"unknown/unretunable knob(s) {sorted(unknown)}; "
+                f"retunable: {list(self.RETUNABLE_KNOBS)}"
+            )
+        census = self._retune_census
+        target: Dict[str, Any] = {}
+
+        def _int(name, lo=0):
+            try:
+                v = int(knobs[name])
+            except (TypeError, ValueError):
+                raise RetuneError(
+                    f"{name} must be an int, got {knobs[name]!r}"
+                ) from None
+            if v < lo:
+                raise RetuneError(f"{name} must be >= {lo}, got {v}")
+            return v
+
+        if "fused_steps_per_dispatch" in knobs:
+            raw = _int("fused_steps_per_dispatch")
+            fk = raw
+            while fk & (fk - 1):
+                fk &= fk - 1
+            if fk > 0 and self._spec_burst_fn is not None:
+                raise RetuneError(
+                    "fused decode cannot be enabled under speculative "
+                    "decoding (no fused executables exist in spec mode)"
+                )
+            if fk > 0 and fk not in census["fused_ks"]:
+                raise RetuneError(
+                    f"fused_steps_per_dispatch={raw} (pow2 floor {fk}) "
+                    f"is outside the boot compile census "
+                    f"{list(census['fused_ks'])}; only warmed Ks (or 0) "
+                    "can be retuned to"
+                )
+            target["fused_steps_per_dispatch"] = (raw, fk)
+        if "depth_groups" in knobs:
+            dg = _int("depth_groups")
+            if dg > 1 and census["depth_groups"] <= 1:
+                raise RetuneError(
+                    "depth_groups>1 requires group-burst variants, which "
+                    "warm() only compiles when the member boots with "
+                    "depth_groups>1"
+                )
+            target["depth_groups"] = dg
+        if "depth_group_split_bytes" in knobs:
+            # pure host-side cost-model parameter: no executable depends
+            # on it, any non-negative value is in census
+            target["depth_group_split_bytes"] = _int(
+                "depth_group_split_bytes"
+            )
+        if "prefill_chunk" in knobs:
+            pc = _int("prefill_chunk")
+            if pc not in (0, census["prefill_chunk"]):
+                raise RetuneError(
+                    f"prefill_chunk={pc} has no precompiled chunk "
+                    f"executables; census allows 0 or "
+                    f"{census['prefill_chunk']}"
+                )
+            target["prefill_chunk"] = pc
+        if "pipeline_depth" in knobs:
+            pd = _int("pipeline_depth", lo=1)
+            if pd > census["pipeline_depth"]:
+                raise RetuneError(
+                    f"pipeline_depth={pd} exceeds the warmed depth "
+                    f"{census['pipeline_depth']} (warm()'s attention "
+                    "overhang only covered the boot depth)"
+                )
+            target["pipeline_depth"] = pd
+        if "admit_queue_limit" in knobs:
+            target["admit_queue_limit"] = _int("admit_queue_limit")
+        if "pressure_high" in knobs or "pressure_low" in knobs:
+            try:
+                high = float(knobs.get(
+                    "pressure_high", self._pressure.high_frac
+                ))
+                low = float(knobs.get(
+                    "pressure_low", self._pressure.low_frac
+                ))
+            except (TypeError, ValueError):
+                raise RetuneError(
+                    "pressure watermarks must be floats"
+                ) from None
+            if not (0.0 < high <= 1.0):
+                raise RetuneError(
+                    f"pressure_high {high} not in (0, 1]"
+                )
+            if not (0.0 < low <= high):
+                raise RetuneError(
+                    f"pressure_low {low} must be in (0, high={high}]"
+                )
+            target["pressure_high"] = high
+            target["pressure_low"] = low
+        with self._retune_lock:
+            if self._pending_retune is not None:
+                raise RetuneError("a retune is already pending")
+            job = _RetuneJob(knobs=target, origin=str(origin))
+            self._pending_retune = job
+        # the loop must be alive to apply the job, traffic or not
+        self.start()
+        return job.future
+
+    @scheduler_only
+    def _do_retune(self, job: _RetuneJob) -> None:
+        """Apply a staged retune (scheduler thread, poll boundary). Runs
+        under ``_retune_lock`` for the same cancel-vs-apply atomicity as
+        :meth:`_do_swap`. A job that changes ``prefill_chunk`` DEFERS
+        while chunked prefills are in flight — their staged slabs and
+        offsets were planned at the old chunk size."""
+        with self._retune_lock:
+            if self._pending_retune is not job:
+                return
+            new_pc = job.knobs.get("prefill_chunk")
+            if (
+                new_pc is not None
+                and new_pc != self.prefill_chunk
+                and self._chunked
+            ):
+                job.waited_polls += 1
+                return
+            changed: Dict[str, List[Any]] = {}
+
+            def _apply(name, old, new, setter):
+                if old != new:
+                    changed[name] = [old, new]
+                setter(new)
+
+            for name, val in job.knobs.items():
+                if name == "fused_steps_per_dispatch":
+                    raw, fk = val
+                    if self._fused_k != fk:
+                        changed[name] = [self._fused_k, fk]
+                        # device stop/budget registers re-upload before
+                        # the next fused dispatch
+                        self._fused_sync = False
+                    self.fused_steps_per_dispatch = raw
+                    self._fused_k = fk
+                elif name == "depth_groups":
+                    _apply(
+                        name, self.depth_groups, val,
+                        lambda v: setattr(self, "depth_groups", v),
+                    )
+                elif name == "depth_group_split_bytes":
+                    _apply(
+                        name, self._group_split_bytes, val,
+                        lambda v: setattr(self, "_group_split_bytes", v),
+                    )
+                elif name == "prefill_chunk":
+                    _apply(
+                        name, self.prefill_chunk, val,
+                        lambda v: setattr(self, "prefill_chunk", v),
+                    )
+                elif name == "pipeline_depth":
+                    _apply(
+                        name, self.pipeline_depth, val,
+                        lambda v: setattr(self, "pipeline_depth", v),
+                    )
+                elif name == "admit_queue_limit":
+                    _apply(
+                        name, self.admit_queue_limit, val,
+                        lambda v: setattr(self, "admit_queue_limit", v),
+                    )
+                elif name == "pressure_high":
+                    _apply(
+                        name, self._pressure.high_frac, val,
+                        lambda v: setattr(self._pressure, "high_frac", v),
+                    )
+                elif name == "pressure_low":
+                    _apply(
+                        name, self._pressure.low_frac, val,
+                        lambda v: setattr(self._pressure, "low_frac", v),
+                    )
+            self.stats["planner_retunes"] += 1
+            if self.flight is not None and self.flight.enabled:
+                self.flight.record({
+                    "type": "planner_retune",
+                    "origin": job.origin,
+                    "changed": changed,
+                    "waited_polls": job.waited_polls,
+                })
+            self._pending_retune = None
+        if changed:
+            logger.info(
+                "planner retune (%s): %s (deferred %d polls)",
+                job.origin,
+                ", ".join(
+                    f"{k} {o!r}->{n!r}" for k, (o, n) in changed.items()
+                ),
+                job.waited_polls,
+            )
+        if not job.future.done():
+            job.future.set_result(changed)
+
+    @caller_thread
+    def cancel_retune(self) -> bool:
+        """Abort a staged-but-not-yet-applied retune (e.g. a planner
+        tick superseded by a newer decision before the poll boundary).
+        Returns True when a pending job was cancelled."""
+        with self._retune_lock:
+            job, self._pending_retune = self._pending_retune, None
+        if job is None:
+            return False
+        if not job.future.done():
+            job.future.set_exception(
+                RetuneError("retune cancelled before the poll boundary")
             )
         return True
 
@@ -5076,6 +5405,14 @@ class ContinuousBatcher:
                 if dj is not None:
                     self._do_drain(dj, pending)
                     continue
+                # -- planner retune: apply staged knob changes HERE, at
+                # the top of the poll, before this poll's _fused_k
+                # snapshot and admissions read any knob — so one poll
+                # never sees a half-applied config. Unlocked read,
+                # GIL-atomic, same re-validation discipline as swap.
+                rj = self._pending_retune
+                if rj is not None:
+                    self._do_retune(rj)
                 swap = self._pending_swap
                 if swap is not None:
                     if swap.drain_lanes is None:

@@ -325,3 +325,27 @@ def test_bench_multitenant_scenario_anchor():
     assert '"page_ins": pager["page_ins"]' in mb_src
     gen_src = open(os.path.join(root, "tools", "gen_arch_numbers.py")).read()
     assert "llm_1b_multitenant" in gen_src
+
+
+def test_bench_storm_scenario_anchor():
+    """The ``llm_1b_storm`` bench scenario is an acceptance artifact
+    (one seeded diurnal+burst trafficsim storm replayed against a
+    hand-tuned static config and a mistuned boot the autonomic planner
+    must converge mid-storm through the safe poll-boundary retune
+    path: convergence, greedy byte-identity across the retune, the
+    no-hang bound, and the post-retune TTFT p99 objective are read
+    from its entry): it must stay wired through BOTH model tiers, and
+    the numbers-table generator must know its key."""
+    import seldon_core_tpu.modelbench as modelbench
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    mb_src = open(modelbench.__file__).read()
+    assert mb_src.count('results["llm_1b_storm"]') >= 2  # tiny + chip
+    assert hasattr(modelbench, "bench_storm")
+    # the entry asserts the acceptance bits like prior scenarios
+    assert '"greedy_identical": greedy_identical' in mb_src
+    assert '"planner_converged": converged' in mb_src
+    assert '"slo_held": slo_held' in mb_src
+    assert '"retunes_applied"' in mb_src
+    gen_src = open(os.path.join(root, "tools", "gen_arch_numbers.py")).read()
+    assert "llm_1b_storm" in gen_src

@@ -478,6 +478,56 @@ def _fusion_lines(
     return lines
 
 
+def _planner_lines(retunes: List[Dict[str, Any]]) -> List[str]:
+    """Autonomic planner ``planner_retune`` records (operate.md
+    §"Autonomic planning"): knob changes the scheduler applied at poll
+    boundaries — with a DIAGNOSIS when the controller is thrashing (the
+    same knob rewritten over and over, or flipped straight back) rather
+    than converging."""
+    if not retunes:
+        return []
+    lines: List[str] = []
+    knob_counts: Dict[str, int] = {}
+    for r in retunes:
+        for knob in (r.get("changed") or {}):
+            knob_counts[knob] = knob_counts.get(knob, 0) + 1
+    deferred = sum(1 for r in retunes if r.get("waited_polls"))
+    knob_txt = ", ".join(
+        f"{k} x{n}" for k, n in sorted(knob_counts.items())
+    ) or "no knobs changed"
+    lines.append(
+        f"planner retunes: {len(retunes)} applied at poll boundaries "
+        f"({knob_txt})"
+        + (
+            f"; {deferred} deferred for in-flight chunked prefills"
+            if deferred else ""
+        )
+    )
+    thrash = []
+    for knob, n in sorted(knob_counts.items()):
+        trans = [
+            tuple(r["changed"][knob]) for r in retunes
+            if knob in (r.get("changed") or {})
+        ]
+        reverted = any(
+            trans[j][1] == trans[i][0]
+            for i in range(len(trans))
+            for j in range(i + 1, len(trans))
+        )
+        if n >= 3 or (n >= 2 and reverted):
+            thrash.append(knob)
+    if thrash:
+        lines.append(
+            f"DIAGNOSIS: planner retunes are THRASHING on "
+            f"{', '.join(thrash)} — the same knob keeps being rewritten "
+            "inside one ring window, so the decision table is "
+            "oscillating between configs instead of converging; raise "
+            "the planner's retune cooldown, or re-profile (two grid "
+            "points are priced closer than the live noise)"
+        )
+    return lines
+
+
 def _device_time_lines(
     polls: List[Dict[str, Any]],
     profiler: Dict[str, Any],
@@ -612,6 +662,9 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
     tenant_switches = [
         e for e in entries if e.get("type") == "tenant_switch"
     ]
+    planner_retunes = [
+        e for e in entries if e.get("type") == "planner_retune"
+    ]
     lines.append(
         f"recorded {dump.get('recorded_total', len(entries))} records "
         f"(ring holds {len(entries)}, dropped "
@@ -686,6 +739,7 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
         lines.extend(_device_time_lines(
             polls, dump.get("profiler") or {}, dump.get("slo_burn") or {}
         ))
+        lines.extend(_planner_lines(planner_retunes))
         return lines
 
     # -- batch composition --------------------------------------------------
@@ -808,6 +862,9 @@ def diagnose(dump: Dict[str, Any]) -> List[str]:
     lines.extend(_device_time_lines(
         polls, dump.get("profiler") or {}, dump.get("slo_burn") or {}
     ))
+
+    # -- autonomic planner retunes --------------------------------------------
+    lines.extend(_planner_lines(planner_retunes))
 
     # -- prefix cache ---------------------------------------------------------
     hits = sum(p.get("prefix_hits", 0) for p in polls)

@@ -345,6 +345,22 @@ def rows_from(mt, fronts):
                if gmt.get("greedy_identical") and gmt.get("sampled_identical")
                else ""),
         ))
+    gst = mt.get("llm_1b_storm") or {}
+    if gst:
+        pw = gst.get("planner") or {}
+        rows.append((
+            "generate(), autonomic planner storm",
+            f"{fmt(pw.get('tokens_per_s'))} tok/s planner-driven vs "
+            f"{fmt((gst.get('static') or {}).get('tokens_per_s'))} "
+            f"hand-tuned, {fmt(gst.get('retunes_applied'))} retune(s)",
+            "seeded diurnal+burst storm, mistuned boot"
+            + ("; converged to the hand-tuned config"
+               if gst.get("planner_converged") else "")
+            + ("; greedy bytes identical across the retune"
+               if gst.get("greedy_identical") else "")
+            + ("; post-retune TTFT p99 under objective"
+               if gst.get("slo_held") else ""),
+        ))
     g1l = mt.get("llm_1b_long") or {}
     if g1l:
         mbu = f", MBU {g1l['mbu_pct']}%" if g1l.get("mbu_pct") is not None else ""
